@@ -1,0 +1,23 @@
+"""chunkflow-tpu: TPU-native chunk-wise 3D image processing framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+seung-lab/chunkflow (reference: /root/reference): decompose petascale 3D
+volumes into overlapping chunk tasks, distribute them through a queue, and on
+each worker run a composable pipeline of operators whose hot path — patch-wise
+convnet inference with bump-weighted overlap blending — is a single
+jit-compiled XLA program resident in TPU HBM.
+"""
+
+__version__ = "0.1.0"
+
+from chunkflow_tpu.core.cartesian import Cartesian
+from chunkflow_tpu.core.bbox import BoundingBox, BoundingBoxes
+from chunkflow_tpu.chunk.base import Chunk
+
+__all__ = [
+    "Cartesian",
+    "BoundingBox",
+    "BoundingBoxes",
+    "Chunk",
+    "__version__",
+]

@@ -1,0 +1,14 @@
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+from chunkflow_tpu.chunk.image import Image
+from chunkflow_tpu.chunk.affinity_map import AffinityMap
+from chunkflow_tpu.chunk.segmentation import Segmentation
+from chunkflow_tpu.chunk.probability_map import ProbabilityMap
+
+__all__ = [
+    "Chunk",
+    "LayerType",
+    "Image",
+    "AffinityMap",
+    "Segmentation",
+    "ProbabilityMap",
+]

@@ -1,0 +1,36 @@
+"""Affinity map chunk (parity: reference chunk/affinity_map/base.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+class AffinityMap(Chunk):
+    """3-channel float 4D chunk of zyx boundary affinities."""
+
+    def __init__(self, array, **kwargs):
+        kwargs.setdefault("layer_type", LayerType.AFFINITY_MAP)
+        super().__init__(array, **kwargs)
+        if self.ndim != 4:
+            raise ValueError("affinity maps are 4D (c, z, y, x)")
+
+    def quantize(self, mode: str = "xy") -> Chunk:
+        """Compress to a uint8 grayscale thumbnail chunk.
+
+        ``xy``: mean of the y and x affinity channels; ``z``: z channel only.
+        """
+        arr = np.asarray(self.array)
+        if mode == "xy":
+            gray = arr[1:3].mean(axis=0)
+        elif mode == "z":
+            gray = arr[0]
+        else:
+            raise ValueError(f"unknown quantize mode {mode!r}")
+        gray = np.clip(gray * 255.0, 0, 255).astype(np.uint8)
+        return Chunk(
+            gray,
+            voxel_offset=self.voxel_offset,
+            voxel_size=self.voxel_size,
+            layer_type=LayerType.IMAGE,
+        )

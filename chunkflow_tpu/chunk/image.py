@@ -1,0 +1,53 @@
+"""Grayscale image chunk (parity: reference chunk/image/base.py).
+
+Contrast normalization is reimplemented as a vectorized per-section
+percentile stretch (jnp-friendly) rather than the reference's pre-computed
+lookup-table files; the lookup-table path can be added when histogram
+sidecar files are in play.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+class Image(Chunk):
+    def __init__(self, array, **kwargs):
+        kwargs.setdefault("layer_type", LayerType.IMAGE)
+        super().__init__(array, **kwargs)
+
+    def inference(self, inferencer) -> Chunk:
+        """Run patch-wise convnet inference over this image."""
+        return inferencer(self)
+
+    def normalize_contrast(
+        self,
+        lower_clip_fraction: float = 0.01,
+        upper_clip_fraction: float = 0.01,
+        minval: int = 1,
+        maxval: int = 255,
+        per_section: bool = True,
+    ) -> "Image":
+        """Percentile contrast stretch, per z-section by default.
+
+        Mirrors the intent of the reference's histogram-lookup normalization
+        (image/base.py:93-133): clip the darkest/brightest fractions and
+        stretch the remainder to [minval, maxval].
+        """
+        arr = np.asarray(self.array).astype(np.float32)
+        lo_q = lower_clip_fraction * 100.0
+        hi_q = 100.0 - upper_clip_fraction * 100.0
+        # per z-section (and per channel for 4D): reduce over the trailing
+        # (y, x) axes; otherwise over the whole array
+        axes = (-2, -1) if per_section else tuple(range(-3, 0))
+        lows = np.percentile(arr, lo_q, axis=axes, keepdims=True)
+        highs = np.percentile(arr, hi_q, axis=axes, keepdims=True)
+        scale = (maxval - minval) / np.maximum(highs - lows, 1e-6)
+        out = np.clip((arr - lows) * scale + minval, minval, maxval)
+        dtype = self.dtype if np.dtype(self.dtype).kind in "iu" else np.uint8
+        return Image(
+            out.astype(dtype),
+            voxel_offset=self.voxel_offset,
+            voxel_size=self.voxel_size,
+        )

@@ -1,0 +1,14 @@
+from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+from chunkflow_tpu.core.bbox import (
+    BoundingBox,
+    BoundingBoxes,
+    PhysicalBoundingBox,
+)
+
+__all__ = [
+    "Cartesian",
+    "to_cartesian",
+    "BoundingBox",
+    "BoundingBoxes",
+    "PhysicalBoundingBox",
+]

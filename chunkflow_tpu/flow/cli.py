@@ -1,0 +1,402 @@
+"""The chained-command CLI: a pipeline is a shell command.
+
+Parity target: reference flow/flow.py (62 chained click commands) +
+lib/flow.py (chained group machinery). Each subcommand returns a stage
+callable; the group's result callback wires them into one lazy generator
+chain (see runtime.py) and drains it.
+
+Example:
+    chunkflow create-chunk --size 64 512 512 \
+        inference --framework identity --input-patch-size 20 256 256 \
+        save-h5 --file-name /tmp/out.h5
+"""
+from __future__ import annotations
+
+import sys
+
+import click
+import numpy as np
+
+from chunkflow_tpu.chunk import Chunk, Image, Segmentation
+from chunkflow_tpu.core.bbox import BoundingBox, BoundingBoxes
+from chunkflow_tpu.flow.runtime import (
+    DEFAULT_CHUNK_NAME,
+    PipelineState,
+    generator,
+    operator,
+    process_stream,
+)
+
+state = PipelineState()
+
+
+class CartesianType(click.ParamType):
+    """Accept one int (broadcast) or three ints for a zyx triple."""
+
+    name = "zyx"
+
+    def convert(self, value, param, ctx):
+        return value
+
+
+def cartesian_option(*names, default=None, required=False, help=""):
+    return click.option(
+        *names, type=int, nargs=3, default=default, required=required, help=help
+    )
+
+
+@click.group(chain=True)
+@click.option("--mip", type=int, default=0, help="storage hierarchy level")
+@click.option("--dry-run/--real-run", default=False)
+@click.option("--verbose", "-v", count=True)
+def main(mip, dry_run, verbose):
+    """chunkflow-tpu: compose chunk operators into a pipeline."""
+    state.mip = mip
+    state.dry_run = dry_run
+    state.verbose = verbose
+
+
+@main.result_callback()
+def run_pipeline(stages, mip, dry_run, verbose):
+    count = process_stream(stages, verbose=verbose)
+    if verbose:
+        print(f"pipeline drained {count} task(s)")
+
+
+# ---------------------------------------------------------------------------
+# task sources
+# ---------------------------------------------------------------------------
+@main.command("generate-tasks")
+@cartesian_option("--chunk-size", "-c", required=True, help="task chunk size")
+@cartesian_option("--overlap", default=(0, 0, 0), help="chunk overlap")
+@cartesian_option("--roi-start", default=(0, 0, 0))
+@cartesian_option("--roi-stop", default=None)
+@cartesian_option("--grid-size", default=None)
+@click.option("--task-file", type=str, default=None, help="write tasks to .txt/.npy instead of streaming")
+@click.option("--queue-name", "-q", type=str, default=None, help="push tasks to a queue (file://dir or sqs://name)")
+@click.option("--task-index-start", type=int, default=None)
+@click.option("--task-index-stop", type=int, default=None)
+def generate_tasks_cmd(chunk_size, overlap, roi_start, roi_stop, grid_size,
+                       task_file, queue_name, task_index_start, task_index_stop):
+    """Fan the seed task into a grid of bbox tasks."""
+
+    @generator
+    def stage(task):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=chunk_size,
+            overlap=overlap,
+            roi_start=roi_start,
+            roi_stop=roi_stop if roi_stop and any(roi_stop) else None,
+            grid_size=grid_size if grid_size and any(grid_size) else None,
+        )
+        boxes = list(bboxes)
+        if task_index_start is not None or task_index_stop is not None:
+            boxes = boxes[task_index_start:task_index_stop]
+        if task_file is not None:
+            BoundingBoxes(boxes).to_file(task_file)
+            print(f"wrote {len(boxes)} tasks to {task_file}")
+            return
+        if queue_name is not None:
+            from chunkflow_tpu.parallel.queues import open_queue
+
+            queue = open_queue(queue_name)
+            queue.send_messages([b.string for b in boxes])
+            print(f"pushed {len(boxes)} tasks to {queue_name}")
+            return
+        from chunkflow_tpu.flow.runtime import new_task
+
+        for bbox in boxes:
+            t = new_task()
+            t["bbox"] = bbox
+            yield t
+
+    return stage()
+
+
+@main.command("fetch-task-from-queue")
+@click.option("--queue-name", "-q", type=str, required=True)
+@click.option("--visibility-timeout", type=int, default=1800)
+@click.option("--num", type=int, default=-1, help="max tasks to process (-1: drain)")
+def fetch_task_cmd(queue_name, visibility_timeout, num):
+    """Pull bbox tasks from a queue; ack via delete-task-in-queue."""
+
+    @generator
+    def stage(task):
+        from chunkflow_tpu.flow.runtime import new_task
+        from chunkflow_tpu.parallel.queues import open_queue
+
+        queue = open_queue(queue_name, visibility_timeout=visibility_timeout)
+        count = 0
+        for handle, body in queue:
+            t = new_task()
+            t["bbox"] = BoundingBox.from_string(body)
+            t["queue"] = queue
+            t["task_handle"] = handle
+            yield t
+            count += 1
+            if 0 <= num <= count:
+                break
+
+    return stage()
+
+
+@main.command("delete-task-in-queue")
+def delete_task_cmd():
+    """Ack the current task: delete it from its queue (commit point)."""
+
+    @operator
+    def stage(task):
+        queue = task.get("queue")
+        if queue is not None and not state.dry_run:
+            queue.delete(task["task_handle"])
+        return task
+
+    return stage(_name="delete-task-in-queue")
+
+
+# ---------------------------------------------------------------------------
+# chunk creation / I/O
+# ---------------------------------------------------------------------------
+@main.command("create-chunk")
+@cartesian_option("--size", "-s", default=(64, 64, 64))
+@click.option("--dtype", type=str, default="uint8")
+@click.option("--pattern", type=click.Choice(["sin", "random", "zero"]), default="sin")
+@cartesian_option("--voxel-offset", "-t", default=(0, 0, 0))
+@cartesian_option("--voxel-size", default=(1, 1, 1))
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def create_chunk_cmd(size, dtype, pattern, voxel_offset, voxel_size, output_chunk_name):
+    """Create a synthetic chunk (sin/random/zero pattern)."""
+
+    @operator
+    def stage(task):
+        task[output_chunk_name] = Chunk.create(
+            size=size,
+            dtype=np.dtype(dtype),
+            pattern=pattern,
+            voxel_offset=voxel_offset,
+            voxel_size=voxel_size,
+        )
+        return task
+
+    return stage(_name="create-chunk")
+
+
+@main.command("load-h5")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--dataset-path", type=str, default="main")
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+@cartesian_option("--voxel-offset", default=None)
+def load_h5_cmd(file_name, dataset_path, output_chunk_name, voxel_offset):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = Chunk.from_h5(
+            file_name,
+            dataset_path=dataset_path,
+            voxel_offset=voxel_offset if voxel_offset and any(v != 0 for v in voxel_offset) else None,
+            bbox=task.get("bbox"),
+        )
+        return task
+
+    return stage(_name="load-h5")
+
+
+@main.command("save-h5")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_h5_cmd(file_name, input_chunk_name):
+    @operator
+    def stage(task):
+        task[input_chunk_name].to_h5(file_name)
+        return task
+
+    return stage(_name="save-h5")
+
+
+@main.command("load-tif")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+@cartesian_option("--voxel-offset", default=(0, 0, 0))
+@click.option("--dtype", type=str, default=None)
+def load_tif_cmd(file_name, output_chunk_name, voxel_offset, dtype):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = Chunk.from_tif(
+            file_name,
+            voxel_offset=voxel_offset,
+            dtype=np.dtype(dtype) if dtype else None,
+        )
+        return task
+
+    return stage(_name="load-tif")
+
+
+@main.command("save-tif")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_tif_cmd(file_name, input_chunk_name):
+    @operator
+    def stage(task):
+        task[input_chunk_name].to_tif(file_name)
+        return task
+
+    return stage(_name="save-tif")
+
+
+# ---------------------------------------------------------------------------
+# flow control
+# ---------------------------------------------------------------------------
+@main.command("skip-all-zero")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def skip_all_zero_cmd(input_chunk_name):
+    """Drop the task if the chunk is entirely zero."""
+
+    @operator
+    def stage(task):
+        if task[input_chunk_name].all_zero():
+            return None
+        return task
+
+    return stage(_name="skip-all-zero")
+
+
+@main.command("skip-none")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def skip_none_cmd(input_chunk_name):
+    @operator
+    def stage(task):
+        if task.get(input_chunk_name) is None:
+            return None
+        return task
+
+    return stage(_name="skip-none")
+
+
+@main.command("delete-var")
+@click.option("--var-names", "-v", type=str, required=True, help="comma-separated task keys")
+def delete_var_cmd(var_names):
+    """Release chunks mid-pipeline to bound memory."""
+
+    @operator
+    def stage(task):
+        for name in var_names.split(","):
+            task.pop(name.strip(), None)
+        return task
+
+    return stage(_name="delete-var")
+
+
+@main.command("copy-var")
+@click.option("--from-name", "-f", type=str, required=True)
+@click.option("--to-name", "-t", type=str, required=True)
+def copy_var_cmd(from_name, to_name):
+    @operator
+    def stage(task):
+        task[to_name] = task[from_name]
+        return task
+
+    return stage(_name="copy-var")
+
+
+# ---------------------------------------------------------------------------
+# compute
+# ---------------------------------------------------------------------------
+@main.command("crop-margin")
+@cartesian_option("--margin-size", "-m", default=None)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def crop_margin_cmd(margin_size, input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        if margin_size and any(margin_size):
+            cropped = chunk.crop_margin(margin_size)
+        elif task.get("bbox") is not None:
+            cropped = chunk.cutout(task["bbox"])
+        else:
+            raise click.UsageError("need --margin-size or a task bbox")
+        task[output_chunk_name] = cropped
+        return task
+
+    return stage(_name="crop-margin")
+
+
+@main.command("threshold")
+@click.option("--threshold", "-t", type=float, default=0.5)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def threshold_cmd(threshold, input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = task[input_chunk_name].threshold(threshold)
+        return task
+
+    return stage(_name="threshold")
+
+
+@main.command("connected-components")
+@click.option("--threshold", "-t", type=float, default=0.5)
+@click.option("--connectivity", "-c", type=click.Choice(["6", "18", "26"]), default="26")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def connected_components_cmd(threshold, connectivity, input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = task[input_chunk_name].connected_component(
+            threshold=threshold, connectivity=int(connectivity)
+        )
+        return task
+
+    return stage(_name="connected-components")
+
+
+@main.command("channel-voting")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def channel_voting_cmd(input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = task[input_chunk_name].channel_voting()
+        return task
+
+    return stage(_name="channel-voting")
+
+
+@main.command("normalize-contrast")
+@click.option("--lower-clip-fraction", type=float, default=0.01)
+@click.option("--upper-clip-fraction", type=float, default=0.01)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def normalize_contrast_cmd(lower_clip_fraction, upper_clip_fraction, input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        img = task[input_chunk_name]
+        if not isinstance(img, Image):
+            img = Image(img.array, voxel_offset=img.voxel_offset, voxel_size=img.voxel_size)
+        task[output_chunk_name] = img.normalize_contrast(
+            lower_clip_fraction=lower_clip_fraction,
+            upper_clip_fraction=upper_clip_fraction,
+        )
+        return task
+
+    return stage(_name="normalize-contrast")
+
+
+@main.command("evaluate-segmentation")
+@click.option("--segmentation-chunk-name", "-s", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--groundtruth-chunk-name", "-g", type=str, required=True)
+def evaluate_segmentation_cmd(segmentation_chunk_name, groundtruth_chunk_name):
+    @operator
+    def stage(task):
+        seg = task[segmentation_chunk_name]
+        if not isinstance(seg, Segmentation):
+            seg = Segmentation.from_chunk(seg)
+        scores = seg.evaluate(task[groundtruth_chunk_name])
+        print("segmentation evaluation:", scores)
+        task["evaluation"] = scores
+        return task
+
+    return stage(_name="evaluate-segmentation")
+
+
+if __name__ == "__main__":
+    main()

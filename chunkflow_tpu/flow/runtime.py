@@ -1,0 +1,99 @@
+"""The pipeline runtime: a lazy chain of task generators.
+
+Parity target: reference lib/flow.py:26-105 — the entire "runtime" is a
+chain of Python generators threading a task dict through operator stages.
+One task is resident per worker at a time, so memory is bounded by chunk
+size. Setting the task to ``None`` skips all downstream work (every
+operator guards on it), which is how skip/short-circuit operators compose.
+
+A task is a plain dict:
+    {'log': {'timer': {...}}, 'bbox': BoundingBox, '<chunk_name>': Chunk, ...}
+"""
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, Dict, Iterable, Iterator, Optional
+
+DEFAULT_CHUNK_NAME = "chunk"
+
+
+def new_task() -> dict:
+    return {"log": {"timer": {}, "compute_device": ""}}
+
+
+class PipelineState:
+    """Global flags shared by all stages of one CLI invocation."""
+
+    def __init__(self):
+        self.mip = 0
+        self.dry_run = False
+        self.verbose = 0
+        self.operators: Dict[str, object] = {}
+
+
+def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
+    """Wire stage callables into one generator chain and drain it.
+
+    Each stage maps an iterator of tasks to an iterator of tasks.
+    Returns the number of tasks that reached the end of the pipeline.
+    """
+    stream: Iterator[dict] = iter([new_task()])
+    for stage in stages:
+        stream = stage(stream)
+    count = 0
+    for task in stream:
+        count += 1
+        if verbose and task is not None and task.get("log"):
+            timers = task["log"]["timer"]
+            total = sum(timers.values())
+            print(f"task complete; time per op (s): {timers} total={total:.3f}")
+    return count
+
+
+def operator(func: Callable) -> Callable:
+    """Decorate a per-task operator: ``func(task, **kwargs) -> task``.
+
+    The wrapped callable takes the upstream iterator and yields processed
+    tasks, timing itself into ``task['log']['timer'][name]``. ``None`` tasks
+    pass through untouched (skip semantics).
+    """
+
+    @functools.wraps(func)
+    def wrapper(**kwargs):
+        name = kwargs.pop("_name", func.__name__)
+
+        def stage(stream: Iterator[Optional[dict]]):
+            for task in stream:
+                if task is not None:
+                    start = time.time()
+                    task = func(task, **kwargs)
+                    if task is not None:
+                        task["log"]["timer"][name] = time.time() - start
+                yield task
+
+        return stage
+
+    return wrapper
+
+
+def generator(func: Callable) -> Callable:
+    """Decorate a task source: ``func(task, **kwargs) -> iterator of tasks``.
+
+    Runs once per upstream task (usually the single seed task) and may yield
+    many downstream tasks — this is how ``generate-tasks`` fans one seed into
+    a task grid.
+    """
+
+    @functools.wraps(func)
+    def wrapper(**kwargs):
+        def stage(stream: Iterator[Optional[dict]]):
+            for task in stream:
+                if task is None:
+                    yield task
+                    continue
+                yield from func(task, **kwargs)
+
+        return stage
+
+    return wrapper

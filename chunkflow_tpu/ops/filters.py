@@ -1,0 +1,25 @@
+"""Smoothing filters (parity: reference chunk/base.py gaussian_filter_2d)."""
+from __future__ import annotations
+
+import numpy as np
+from scipy import ndimage
+
+from chunkflow_tpu.chunk.base import Chunk
+
+
+def gaussian_filter_2d(chunk: Chunk, sigma: float = 1.0) -> Chunk:
+    """Per-z-section 2D gaussian blur (does not mix z slices)."""
+    arr = np.asarray(chunk.array)
+    spatial_sigma = (0.0, sigma, sigma)
+    if arr.ndim == 4:
+        sigma_nd = (0.0,) + spatial_sigma
+    else:
+        sigma_nd = spatial_sigma
+    out = ndimage.gaussian_filter(arr.astype(np.float32), sigma=sigma_nd)
+    return chunk._with_array(out.astype(arr.dtype))
+
+
+def median_filter(chunk: Chunk, size: int = 3) -> Chunk:
+    arr = np.asarray(chunk.array)
+    out = ndimage.median_filter(arr, size=(1, size, size) if arr.ndim == 3 else (1, 1, size, size))
+    return chunk._with_array(out)

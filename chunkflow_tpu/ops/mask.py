@@ -1,0 +1,62 @@
+"""Multi-resolution masking (parity: reference flow/mask.py + chunk.maskout).
+
+A mask chunk stored at a coarser mip multiplies a finer chunk: each mask
+voxel covers an integer factor block. Implemented by nearest-neighbor
+upsampling the mask with jnp.repeat — a memory-light broadcast the compiler
+fuses with the multiply.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.cartesian import Cartesian
+
+
+def upsample_factor(fine: Chunk, coarse: Chunk) -> Cartesian:
+    factor = coarse.voxel_size / fine.voxel_size
+    if any(f != int(f) or f < 1 for f in factor):
+        raise ValueError(
+            f"mask voxel size {coarse.voxel_size} must be an integer multiple "
+            f"of chunk voxel size {fine.voxel_size}"
+        )
+    return factor.astype_int()
+
+
+def maskout(chunk: Chunk, mask: Chunk, inverse: bool = False) -> Chunk:
+    """Multiply ``chunk`` by a (possibly coarser-resolution) binary mask."""
+    factor = upsample_factor(chunk, mask)
+    mask_arr = jnp.asarray(mask.array)
+    if mask_arr.ndim == 4:
+        mask_arr = mask_arr[0]
+    binary = mask_arr != 0
+    if inverse:
+        binary = ~binary
+
+    # chunk start relative to the mask origin, in fine (chunk-res) voxels
+    phys_delta = (
+        chunk.voxel_offset * chunk.voxel_size - mask.voxel_offset * mask.voxel_size
+    )
+    fine_start = (phys_delta / chunk.voxel_size).floor()
+    coarse_start = fine_start // factor
+    # sub-voxel phase: fine voxels to trim after upsampling (handles chunk
+    # starts that are not aligned to the coarse mask grid)
+    phase = fine_start - coarse_start * factor
+    shape = (phase + chunk.shape[-3:]).ceildiv(factor)
+    sl = tuple(slice(s, s + n) for s, n in zip(coarse_start, shape))
+    binary = binary[sl]
+
+    for axis, f in enumerate(factor):
+        if f > 1:
+            binary = jnp.repeat(binary, f, axis=axis)
+    binary = binary[
+        tuple(slice(p, p + s) for p, s in zip(phase, chunk.shape[-3:]))
+    ]
+
+    arr = jnp.asarray(chunk.array)
+    if arr.ndim == 4:
+        binary = binary[None, ...]
+    out = arr * binary.astype(arr.dtype)
+    result = np.asarray(out) if not chunk.is_on_device else out
+    return chunk._with_array(result)

@@ -1,0 +1,49 @@
+"""Segmentation id remapping — the fastremap (C++) equivalent.
+
+Vectorized numpy (np.unique/searchsorted); O(n log n) but allocation-light.
+Parity: fastremap.renumber / remap / mask usage in reference
+chunk/segmentation.py:69-109.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def renumber(arr: np.ndarray, start_id: int = 1) -> Tuple[np.ndarray, Dict[int, int]]:
+    """Relabel ids to a compact range [start_id, ...); 0 stays 0.
+
+    Returns the relabeled array and the old->new mapping.
+    """
+    ids = np.unique(arr)
+    nonzero = ids[ids != 0]
+    new_ids = np.arange(start_id, start_id + nonzero.size, dtype=arr.dtype)
+    lookup = np.zeros(ids.size, dtype=arr.dtype)
+    lookup[np.searchsorted(ids, nonzero)] = new_ids
+    out = lookup[np.searchsorted(ids, arr)]
+    mapping = {int(o): int(n) for o, n in zip(nonzero, new_ids)}
+    return out.astype(arr.dtype), mapping
+
+
+def remap(arr: np.ndarray, mapping: Dict[int, int], preserve_missing: bool = True) -> np.ndarray:
+    """Apply an explicit old->new id mapping."""
+    if not mapping:
+        return arr.copy()
+    keys = np.array(sorted(mapping.keys()), dtype=arr.dtype)
+    vals = np.array([mapping[int(k)] for k in keys], dtype=arr.dtype)
+    idx = np.searchsorted(keys, arr)
+    idx = np.clip(idx, 0, keys.size - 1)
+    found = keys[idx] == arr
+    out = np.where(found, vals[idx], arr if preserve_missing else 0)
+    return out.astype(arr.dtype)
+
+
+def unique_ids(arr: np.ndarray, return_counts: bool = False):
+    """Nonzero unique ids (and counts)."""
+    if return_counts:
+        ids, counts = np.unique(arr, return_counts=True)
+        keep = ids != 0
+        return ids[keep], counts[keep]
+    ids = np.unique(arr)
+    return ids[ids != 0]

@@ -1,0 +1,17 @@
+"""Thresholding (parity: reference chunk/base.py threshold op)."""
+from __future__ import annotations
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+def threshold(chunk: Chunk, value: float, dtype=np.uint8) -> Chunk:
+    """Binarize a probability/affinity chunk at ``value``."""
+    arr = (np.asarray(chunk.array) > value).astype(dtype)
+    return Chunk(
+        arr,
+        voxel_offset=chunk.voxel_offset,
+        voxel_size=chunk.voxel_size,
+        layer_type=LayerType.PROBABILITY_MAP if np.dtype(dtype).kind == "f" else LayerType.IMAGE,
+    )

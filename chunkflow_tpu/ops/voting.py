@@ -1,0 +1,45 @@
+"""Channel voting ops for semantic multi-channel predictions.
+
+Parity: reference chunk/base.py channel_voting (:672-683) and
+mask_using_last_channel (:685-689). Implemented with jnp so they fuse when
+run on device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+def channel_voting(chunk: Chunk) -> Chunk:
+    """argmax over channels + 1 (label 0 reserved for background)."""
+    if chunk.ndim != 4:
+        raise ValueError("channel voting needs a 4D (c, z, y, x) chunk")
+    arr = jnp.asarray(chunk.array)
+    out = (jnp.argmax(arr, axis=0) + 1).astype(jnp.uint8)
+    if not chunk.is_on_device:
+        out = np.asarray(out)
+    return Chunk(
+        out,
+        voxel_offset=chunk.voxel_offset,
+        voxel_size=chunk.voxel_size,
+        layer_type=LayerType.SEGMENTATION,
+    )
+
+
+def mask_using_last_channel(chunk: Chunk, threshold: float = 0.3) -> Chunk:
+    """Zero out voxels where the last channel (e.g. myelin) exceeds threshold."""
+    if chunk.ndim != 4:
+        raise ValueError("needs a 4D (c, z, y, x) chunk")
+    arr = jnp.asarray(chunk.array)
+    mask = arr[-1] <= threshold
+    out = arr[:-1] * mask[None, ...].astype(arr.dtype)
+    if not chunk.is_on_device:
+        out = np.asarray(out)
+    return Chunk(
+        out,
+        voxel_offset=chunk.voxel_offset,
+        voxel_size=chunk.voxel_size,
+        layer_type=chunk.layer_type,
+    )

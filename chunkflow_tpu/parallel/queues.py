@@ -1,0 +1,215 @@
+"""Task queues: the distributed communication backend.
+
+Parity target: reference lib/aws/sqs_queue.py — a queue of bbox strings with
+visibility timeout, ack-after-write commit, and batch send. Workers never
+talk to each other; the queue plus object storage is the whole protocol
+(communication-free task parallelism — the right design for chunked
+inference, kept here deliberately instead of collectives).
+
+Backends:
+- ``memory://name``  — in-process, for tests (fixes the reference's
+  untestable-SQS gap);
+- ``file:///dir``    — a directory of task files with atomic rename claims
+  and mtime-based visibility timeout; safe across processes/hosts on a
+  shared filesystem (SLURM-style clusters);
+- ``sqs://name``     — AWS SQS via boto3, gated on the library being
+  importable (not baked into this image).
+"""
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class QueueBase:
+    """handle/body iteration + ack protocol shared by all backends."""
+
+    def send_messages(self, bodies: List[str]) -> None:
+        raise NotImplementedError
+
+    def receive(self) -> Optional[Tuple[str, str]]:
+        """One (handle, body) or None when empty."""
+        raise NotImplementedError
+
+    def delete(self, handle: str) -> None:
+        """Ack: permanently remove a claimed task (the commit point)."""
+        raise NotImplementedError
+
+    # polling iteration with bounded retries on empty
+    max_empty_retries = 3
+    retry_sleep = 1.0
+
+    def __iter__(self) -> Iterator[Tuple[str, str]]:
+        empty = 0
+        while True:
+            item = self.receive()
+            if item is None:
+                empty += 1
+                if empty > self.max_empty_retries:
+                    return
+                time.sleep(self.retry_sleep)
+                continue
+            empty = 0
+            yield item
+
+
+class MemoryQueue(QueueBase):
+    """In-process queue with visibility timeout semantics."""
+
+    _registry: Dict[str, "MemoryQueue"] = {}
+
+    def __init__(self, name: str, visibility_timeout: float = 1800.0):
+        self.name = name
+        self.visibility_timeout = visibility_timeout
+        self.pending: Dict[str, str] = {}
+        self.invisible: Dict[str, Tuple[str, float]] = {}
+        self.retry_sleep = 0.01
+
+    @classmethod
+    def open(cls, name: str, visibility_timeout: float = 1800.0) -> "MemoryQueue":
+        if name not in cls._registry:
+            cls._registry[name] = cls(name, visibility_timeout)
+        return cls._registry[name]
+
+    def send_messages(self, bodies: List[str]) -> None:
+        for body in bodies:
+            self.pending[uuid.uuid4().hex] = body
+
+    def _requeue_expired(self) -> None:
+        now = time.time()
+        expired = [h for h, (_, t) in self.invisible.items()
+                   if now - t > self.visibility_timeout]
+        for h in expired:
+            body, _ = self.invisible.pop(h)
+            self.pending[h] = body
+
+    def receive(self) -> Optional[Tuple[str, str]]:
+        self._requeue_expired()
+        if not self.pending:
+            return None
+        handle, body = next(iter(self.pending.items()))
+        del self.pending[handle]
+        self.invisible[handle] = (body, time.time())
+        return handle, body
+
+    def delete(self, handle: str) -> None:
+        self.invisible.pop(handle, None)
+        self.pending.pop(handle, None)
+
+    def __len__(self) -> int:
+        self._requeue_expired()
+        return len(self.pending)
+
+
+class FileQueue(QueueBase):
+    """Directory-backed queue; atomic rename is the claim operation.
+
+    Layout: ``<dir>/pending/<id>`` holds the body; claiming renames it to
+    ``<dir>/claimed/<id>``; delete removes the claimed file. A janitor pass
+    returns claimed files older than the visibility timeout to pending —
+    so crashed workers' tasks reappear, same as SQS.
+    """
+
+    def __init__(self, directory: str, visibility_timeout: float = 1800.0):
+        self.dir = directory
+        self.pending_dir = os.path.join(directory, "pending")
+        self.claimed_dir = os.path.join(directory, "claimed")
+        os.makedirs(self.pending_dir, exist_ok=True)
+        os.makedirs(self.claimed_dir, exist_ok=True)
+        self.visibility_timeout = visibility_timeout
+
+    def send_messages(self, bodies: List[str]) -> None:
+        for body in bodies:
+            name = uuid.uuid4().hex
+            tmp = os.path.join(self.dir, f".tmp-{name}")
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.rename(tmp, os.path.join(self.pending_dir, name))
+
+    def _requeue_expired(self) -> None:
+        now = time.time()
+        for name in os.listdir(self.claimed_dir):
+            path = os.path.join(self.claimed_dir, name)
+            try:
+                if now - os.path.getmtime(path) > self.visibility_timeout:
+                    os.rename(path, os.path.join(self.pending_dir, name))
+            except OSError:
+                pass  # another janitor/worker won the race
+
+    def receive(self) -> Optional[Tuple[str, str]]:
+        self._requeue_expired()
+        for name in sorted(os.listdir(self.pending_dir)):
+            src = os.path.join(self.pending_dir, name)
+            dst = os.path.join(self.claimed_dir, name)
+            try:
+                os.rename(src, dst)  # atomic claim
+            except OSError:
+                continue  # raced with another worker
+            os.utime(dst)
+            with open(dst) as f:
+                return name, f.read()
+        return None
+
+    def delete(self, handle: str) -> None:
+        try:
+            os.remove(os.path.join(self.claimed_dir, handle))
+        except FileNotFoundError:
+            pass
+
+    def __len__(self) -> int:
+        return len(os.listdir(self.pending_dir))
+
+
+class SQSQueue(QueueBase):
+    """AWS SQS backend (requires boto3 + credentials; not in this image)."""
+
+    def __init__(self, name: str, visibility_timeout: int = 1800):
+        try:
+            import boto3
+        except ImportError as e:
+            raise RuntimeError(
+                "sqs:// queues need boto3, which is not installed; "
+                "use file:// or memory:// queues instead"
+            ) from e
+        self.client = boto3.client("sqs")
+        resp = self.client.create_queue(
+            QueueName=name,
+            Attributes={"VisibilityTimeout": str(visibility_timeout)},
+        )
+        self.queue_url = resp["QueueUrl"]
+
+    def send_messages(self, bodies: List[str]) -> None:
+        for i in range(0, len(bodies), 10):  # SQS batch limit
+            entries = [
+                {"Id": str(j), "MessageBody": body}
+                for j, body in enumerate(bodies[i : i + 10])
+            ]
+            self.client.send_message_batch(
+                QueueUrl=self.queue_url, Entries=entries
+            )
+
+    def receive(self) -> Optional[Tuple[str, str]]:
+        resp = self.client.receive_message(
+            QueueUrl=self.queue_url, MaxNumberOfMessages=1, WaitTimeSeconds=20
+        )
+        messages = resp.get("Messages", [])
+        if not messages:
+            return None
+        msg = messages[0]
+        return msg["ReceiptHandle"], msg["Body"]
+
+    def delete(self, handle: str) -> None:
+        self.client.delete_message(QueueUrl=self.queue_url, ReceiptHandle=handle)
+
+
+def open_queue(spec: str, visibility_timeout: float = 1800.0) -> QueueBase:
+    """Open a queue from a ``scheme://name`` spec (bare paths mean file://)."""
+    if spec.startswith("memory://"):
+        return MemoryQueue.open(spec[len("memory://"):], visibility_timeout)
+    if spec.startswith("sqs://"):
+        return SQSQueue(spec[len("sqs://"):], int(visibility_timeout))
+    if spec.startswith("file://"):
+        spec = spec[len("file://"):]
+    return FileQueue(spec, visibility_timeout)

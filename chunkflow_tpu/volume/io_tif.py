@@ -1,0 +1,37 @@
+"""Multi-page TIFF I/O via Pillow (tifffile is not in the image).
+
+Parity: reference chunk/base.py from_tif/to_tif (:208-264). z-sections map
+to TIFF pages.
+"""
+from __future__ import annotations
+
+import numpy as np
+from PIL import Image as PILImage
+
+
+def write_tif(chunk, path: str) -> str:
+    arr = np.asarray(chunk.array)
+    if arr.ndim == 4:
+        if arr.shape[0] != 1:
+            raise ValueError("TIFF export supports single-channel chunks only")
+        arr = arr[0]
+    pages = [PILImage.fromarray(section) for section in arr]
+    pages[0].save(path, save_all=True, append_images=pages[1:])
+    return path
+
+
+def read_tif(path: str, voxel_offset=None, voxel_size=None, dtype=None):
+    from chunkflow_tpu.chunk.base import Chunk
+
+    img = PILImage.open(path)
+    sections = []
+    try:
+        while True:
+            sections.append(np.asarray(img))
+            img.seek(img.tell() + 1)
+    except EOFError:
+        pass
+    arr = np.stack(sections, axis=0)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return Chunk(arr, voxel_offset=voxel_offset, voxel_size=voxel_size)

@@ -1,0 +1,133 @@
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.core.cartesian import Cartesian
+
+
+def test_create_patterns():
+    sin = Chunk.create((8, 8, 8), dtype=np.uint8, pattern="sin")
+    assert sin.shape == (8, 8, 8)
+    assert sin.dtype == np.uint8
+    zero = Chunk.create((4, 4, 4), pattern="zero")
+    assert zero.all_zero()
+    rand = Chunk.create((4, 4, 4), dtype=np.float32, pattern="random")
+    assert not rand.all_zero()
+    multi = Chunk.create((4, 4, 4), dtype=np.float32, nchannels=3)
+    assert multi.shape == (3, 4, 4, 4)
+    assert multi.nchannels == 3
+
+
+def test_layer_type_inference():
+    assert Chunk(np.zeros((4, 4, 4), dtype=np.uint8)).is_image
+    assert Chunk(np.zeros((4, 4, 4), dtype=np.uint32)).is_segmentation
+    assert Chunk(np.zeros((3, 4, 4, 4), dtype=np.float32)).is_affinity_map
+    assert Chunk(np.zeros((4, 4, 4), dtype=np.float32)).is_probability_map
+
+
+def test_bbox_and_geometry():
+    c = Chunk.create((8, 8, 8), voxel_offset=(10, 20, 30))
+    assert c.voxel_offset == Cartesian(10, 20, 30)
+    assert c.bbox == BoundingBox((10, 20, 30), (18, 28, 38))
+    sub = c.cutout(BoundingBox((12, 22, 32), (14, 24, 34)))
+    assert sub.shape == (2, 2, 2)
+    assert sub.voxel_offset == Cartesian(12, 22, 32)
+    np.testing.assert_array_equal(sub.array, np.asarray(c.array)[2:4, 2:4, 2:4])
+    with pytest.raises(ValueError):
+        c.cutout(BoundingBox((0, 0, 0), (4, 4, 4)))
+
+
+def test_save_and_blend():
+    base = Chunk(np.zeros((8, 8, 8), dtype=np.float32))
+    patch = Chunk(
+        np.ones((4, 4, 4), dtype=np.float32), voxel_offset=(2, 2, 2)
+    )
+    base.save(patch)
+    assert base.array[2:6, 2:6, 2:6].sum() == 64
+    base.blend(patch)
+    assert base.array[3, 3, 3] == 2.0
+    assert base.array[0, 0, 0] == 0.0
+
+
+def test_crop_margin():
+    c = Chunk.create((8, 8, 8), voxel_offset=(0, 0, 0))
+    cropped = c.crop_margin((2, 2, 2))
+    assert cropped.shape == (4, 4, 4)
+    assert cropped.voxel_offset == Cartesian(2, 2, 2)
+    # 4d
+    c4 = Chunk.create((8, 8, 8), dtype=np.float32, nchannels=2)
+    cropped4 = c4.crop_margin((1, 2, 3))
+    assert cropped4.shape == (2, 6, 4, 2)
+
+
+def test_ufunc_keeps_metadata():
+    c = Chunk.create((4, 4, 4), dtype=np.float32, voxel_offset=(1, 2, 3))
+    doubled = c * 2.0
+    assert isinstance(doubled, Chunk)
+    assert doubled.voxel_offset == Cartesian(1, 2, 3)
+    np.testing.assert_allclose(np.asarray(doubled.array), np.asarray(c.array) * 2)
+    summed = c + c
+    assert isinstance(summed, Chunk)
+    # reduction escapes the wrapper
+    assert isinstance(np.sum(c), (np.floating, float, np.ndarray))
+
+
+def test_inplace_ufunc():
+    c = Chunk(np.full((4, 4, 4), 4.0, dtype=np.float32))
+    c /= 2.0
+    assert isinstance(c, Chunk)
+    assert float(np.asarray(c.array)[0, 0, 0]) == 2.0
+
+
+def test_transpose():
+    arr = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    c = Chunk(arr, voxel_offset=(1, 2, 3), voxel_size=(40, 4, 4))
+    t = c.transpose()
+    assert t.shape == (4, 3, 2)
+    assert t.voxel_offset == Cartesian(3, 2, 1)
+    assert t.voxel_size == Cartesian(4, 4, 40)
+    np.testing.assert_array_equal(np.asarray(t.array), arr.transpose(2, 1, 0))
+
+
+def test_h5_roundtrip(tmp_path):
+    c = Chunk.create(
+        (6, 6, 6), dtype=np.float32, voxel_offset=(4, 5, 6), voxel_size=(40, 4, 4)
+    )
+    path = str(tmp_path / "chunk.h5")
+    c.to_h5(path)
+    loaded = Chunk.from_h5(path)
+    assert loaded.voxel_offset == c.voxel_offset
+    assert loaded.voxel_size == c.voxel_size
+    np.testing.assert_array_equal(np.asarray(loaded.array), np.asarray(c.array))
+    # windowed read
+    window = BoundingBox((5, 6, 7), (7, 8, 9))
+    sub = Chunk.from_h5(path, bbox=window)
+    assert sub.voxel_offset == Cartesian(5, 6, 7)
+    np.testing.assert_array_equal(
+        np.asarray(sub.array), np.asarray(c.cutout(window).array)
+    )
+
+
+def test_tif_roundtrip(tmp_path):
+    c = Chunk.create((4, 8, 8), dtype=np.uint8)
+    path = str(tmp_path / "chunk.tif")
+    c.to_tif(path)
+    loaded = Chunk.from_tif(path)
+    np.testing.assert_array_equal(np.asarray(loaded.array), np.asarray(c.array))
+
+
+def test_device_roundtrip():
+    c = Chunk.create((4, 4, 4), dtype=np.float32)
+    d = c.device()
+    assert d.is_on_device
+    back = d.host()
+    assert not back.is_on_device
+    np.testing.assert_array_equal(np.asarray(back.array), np.asarray(c.array))
+
+
+def test_pad_to():
+    c = Chunk.create((3, 5, 7), dtype=np.float32)
+    p = c.pad_to((4, 8, 8))
+    assert p.shape == (4, 8, 8)
+    np.testing.assert_array_equal(np.asarray(p.array)[:3, :5, :7], np.asarray(c.array))

@@ -1,0 +1,122 @@
+import numpy as np
+
+from chunkflow_tpu.chunk import AffinityMap, Image, ProbabilityMap, Segmentation
+from chunkflow_tpu.chunk.base import Chunk
+
+
+def test_image_normalize_contrast():
+    rng = np.random.default_rng(0)
+    arr = (rng.random((4, 32, 32)) * 100 + 50).astype(np.uint8)
+    img = Image(arr)
+    normed = img.normalize_contrast()
+    out = np.asarray(normed.array)
+    assert out.max() > 200  # stretched up
+    assert out.min() >= 1
+
+
+def test_affinity_quantize():
+    aff = AffinityMap(np.random.default_rng(0).random((3, 4, 8, 8)).astype(np.float32))
+    q = aff.quantize()
+    assert q.shape == (4, 8, 8)
+    assert q.dtype == np.uint8
+    qz = aff.quantize(mode="z")
+    np.testing.assert_array_equal(
+        np.asarray(qz.array),
+        np.clip(np.asarray(aff.array)[0] * 255, 0, 255).astype(np.uint8),
+    )
+
+
+def test_segmentation_evaluate_self_is_perfect():
+    rng = np.random.default_rng(0)
+    seg = Segmentation(rng.integers(0, 5, (8, 8, 8)).astype(np.uint32))
+    scores = seg.evaluate(seg)
+    assert scores["rand_index"] == 1.0
+    assert scores["adjusted_rand_index"] == 1.0
+    assert abs(scores["voi_split"]) < 1e-9
+    assert abs(scores["voi_merge"]) < 1e-9
+
+
+def test_segmentation_evaluate_different():
+    rng = np.random.default_rng(0)
+    a = Segmentation(rng.integers(1, 5, (8, 8, 8)).astype(np.uint32))
+    b = Segmentation(rng.integers(1, 5, (8, 8, 8)).astype(np.uint32))
+    scores = a.evaluate(b)
+    assert scores["rand_index"] < 1.0
+    assert scores["voi_split"] > 0
+
+
+def test_segmentation_renumber_and_masks():
+    arr = np.array([[[0, 5], [5, 9]], [[9, 9], [0, 2]]], dtype=np.uint32)
+    seg = Segmentation(arr)
+    renum = seg.renumber()
+    ids = set(np.unique(np.asarray(renum.array)).tolist())
+    assert ids == {0, 1, 2, 3}
+    offset = seg.renumber(base_id=100)
+    assert set(np.unique(np.asarray(offset.array)).tolist()) == {0, 101, 102, 103}
+
+    dusted = seg.mask_fragments(2)
+    assert 2 not in np.unique(np.asarray(dusted.array))  # id 2 has 1 voxel
+    assert 9 in np.unique(np.asarray(dusted.array))
+
+    kept = seg.mask_except([5])
+    assert set(np.unique(np.asarray(kept.array)).tolist()) == {0, 5}
+
+
+def test_probability_detect_points():
+    arr = np.zeros((8, 16, 16), dtype=np.float32)
+    arr[4, 4, 4] = 1.0
+    arr[4, 12, 12] = 0.9
+    pm = ProbabilityMap(arr, voxel_offset=(100, 0, 0))
+    points, conf = pm.detect_points(min_distance=2, threshold_rel=0.3)
+    assert points.shape[0] == 2
+    assert [104, 4, 4] in points.tolist()
+    assert conf.max() == 1.0
+
+
+def test_channel_voting():
+    arr = np.zeros((3, 2, 2, 2), dtype=np.float32)
+    arr[1] = 1.0  # channel 1 wins everywhere
+    c = Chunk(arr)
+    voted = c.channel_voting()
+    assert voted.shape == (2, 2, 2)
+    assert np.all(np.asarray(voted.array) == 2)
+
+
+def test_mask_using_last_channel():
+    arr = np.ones((3, 2, 2, 2), dtype=np.float32)
+    arr[-1, 0] = 0.0  # below threshold -> kept
+    c = Chunk(arr)
+    masked = c.mask_using_last_channel(threshold=0.3)
+    assert masked.shape == (2, 2, 2, 2)
+    out = np.asarray(masked.array)
+    assert np.all(out[:, 0] == 1.0)
+    assert np.all(out[:, 1] == 0.0)
+
+
+def test_connected_components():
+    arr = np.zeros((4, 8, 8), dtype=np.float32)
+    arr[0:2, 0:2, 0:2] = 0.9
+    arr[2:4, 6:8, 6:8] = 0.9
+    c = Chunk(arr)
+    seg = c.connected_component(threshold=0.5)
+    labels = np.asarray(seg.array)
+    assert seg.is_segmentation
+    assert labels.max() == 2
+    assert labels[0, 0, 0] != labels[3, 7, 7]
+    assert labels[0, 0, 0] != 0
+
+
+def test_maskout_multiresolution():
+    chunk = Chunk(
+        np.ones((4, 8, 8), dtype=np.float32),
+        voxel_offset=(0, 0, 0),
+        voxel_size=(1, 1, 1),
+    )
+    # mask at 2x coarser in y/x
+    mask_arr = np.ones((4, 4, 4), dtype=np.uint8)
+    mask_arr[:, 0, 0] = 0
+    mask = Chunk(mask_arr, voxel_size=(1, 2, 2))
+    out = chunk.maskout(mask)
+    arr = np.asarray(out.array)
+    assert arr[0, 0, 0] == 0 and arr[0, 1, 1] == 0
+    assert arr[0, 2, 2] == 1

@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax import.
+
+Sharding tests run against 8 virtual CPU devices so multi-chip layouts are
+validated without TPU pod hardware; the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

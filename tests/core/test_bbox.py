@@ -1,0 +1,159 @@
+import numpy as np
+import pytest
+
+from chunkflow_tpu.core.bbox import (
+    BoundingBox,
+    BoundingBoxes,
+    PhysicalBoundingBox,
+)
+from chunkflow_tpu.core.cartesian import Cartesian
+
+
+def test_basic_properties():
+    b = BoundingBox((0, 0, 0), (4, 8, 16))
+    assert b.shape == Cartesian(4, 8, 16)
+    assert b.voxel_count == 4 * 8 * 16
+    assert b.is_valid()
+    assert b.slices == (slice(0, 4), slice(0, 8), slice(0, 16))
+    assert b.string == "0-4_0-8_0-16"
+
+
+def test_string_roundtrip():
+    b = BoundingBox((16384, 86294, 121142), (16492, 88342, 123190))
+    assert BoundingBox.from_string(b.string) == b
+    # log-file style with channel prefix and extension
+    parsed = BoundingBox.from_string(
+        "0-3_16384-16492_86294-88342_121142-123190.json"
+    )
+    assert parsed == b
+    with pytest.raises(ValueError):
+        BoundingBox.from_string("nonsense")
+
+
+def test_from_delta_and_slices():
+    b = BoundingBox.from_delta((1, 2, 3), (10, 10, 10))
+    assert b.stop == Cartesian(11, 12, 13)
+    assert BoundingBox.from_slices(b.slices) == b
+
+
+def test_union_intersection_contains():
+    a = BoundingBox((0, 0, 0), (10, 10, 10))
+    b = BoundingBox((5, 5, 5), (15, 15, 15))
+    assert a.union(b) == BoundingBox((0, 0, 0), (15, 15, 15))
+    assert a.intersection(b) == BoundingBox((5, 5, 5), (10, 10, 10))
+    assert a.overlaps(b)
+    assert not a.overlaps(BoundingBox((20, 20, 20), (30, 30, 30)))
+    assert a.contains(BoundingBox((1, 1, 1), (9, 9, 9)))
+    assert not a.contains(b)
+    assert a.contains_point((9, 9, 9))
+    assert not a.contains_point((10, 0, 0))
+
+
+def test_adjust_and_translate():
+    b = BoundingBox((10, 10, 10), (20, 20, 20))
+    grown = b.adjust(2)
+    assert grown == BoundingBox((8, 8, 8), (22, 22, 22))
+    assert grown.adjust((-2, -2, -2)) == b
+    assert b.translate((1, 2, 3)) == BoundingBox((11, 12, 13), (21, 22, 23))
+
+
+def test_alignment():
+    b = BoundingBox((0, 64, 128), (64, 128, 192))
+    assert b.is_aligned_with((64, 64, 64))
+    assert not b.is_aligned_with((64, 64, 60))
+    unaligned = BoundingBox((1, 65, 127), (63, 130, 200))
+    snapped = unaligned.snap_to_blocks((64, 64, 64), outward=True)
+    assert snapped == BoundingBox((0, 64, 64), (64, 192, 256))
+    assert snapped.is_aligned_with((64, 64, 64))
+
+
+def test_decompose():
+    b = BoundingBox((0, 0, 0), (4, 4, 8))
+    blocks = b.decompose((2, 4, 4))
+    assert len(blocks) == 4
+    # blocks tile the box exactly
+    assert sum(blk.voxel_count for blk in blocks) == b.voxel_count
+    union = blocks[0]
+    for blk in blocks[1:]:
+        union = union.union(blk)
+    assert union == b
+    with pytest.raises(ValueError):
+        b.decompose((3, 3, 3))
+
+
+def test_array_roundtrip():
+    b = BoundingBox((1, 2, 3), (4, 5, 6))
+    assert BoundingBox.from_array(b.to_array()) == b
+
+
+class TestBoundingBoxes:
+    def test_grid_no_overlap(self):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4), roi_start=(0, 0, 0), roi_stop=(8, 8, 8)
+        )
+        assert len(bboxes) == 8
+        assert bboxes.grid_size == Cartesian(2, 2, 2)
+        starts = {b.start for b in bboxes}
+        assert Cartesian(0, 0, 0) in starts and Cartesian(4, 4, 4) in starts
+
+    def test_grid_with_overlap(self):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4),
+            overlap=(2, 2, 2),
+            roi_start=(0, 0, 0),
+            roi_stop=(8, 8, 8),
+        )
+        # stride 2: need ceil((8-2)/2)=3 per axis
+        assert bboxes.grid_size == Cartesian(3, 3, 3)
+        assert len(bboxes) == 27
+        # chunks cover the ROI
+        union = bboxes[0]
+        for b in bboxes:
+            union = union.union(b)
+        assert union.contains(BoundingBox((0, 0, 0), (8, 8, 8)))
+
+    def test_grid_size_override(self):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4), grid_size=(1, 2, 3), roi_start=(0, 0, 0)
+        )
+        assert len(bboxes) == 6
+
+    def test_bounded_clamps_to_roi(self):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4),
+            roi_start=(0, 0, 0),
+            roi_stop=(6, 6, 6),
+            bounded=True,
+        )
+        roi = BoundingBox((0, 0, 0), (6, 6, 6))
+        for b in bboxes:
+            assert roi.contains(b)
+
+    def test_aligned_block_size_snaps_roi(self):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4),
+            roi_start=(1, 1, 1),
+            roi_stop=(7, 7, 7),
+            aligned_block_size=(4, 4, 4),
+        )
+        assert bboxes.roi == BoundingBox((0, 0, 0), (8, 8, 8))
+
+    def test_file_roundtrip(self, tmp_path):
+        bboxes = BoundingBoxes.from_manual_setup(
+            chunk_size=(4, 4, 4), roi_start=(0, 0, 0), roi_stop=(8, 8, 8)
+        )
+        npy = tmp_path / "tasks.npy"
+        txt = tmp_path / "tasks.txt"
+        bboxes.to_file(str(npy))
+        bboxes.to_file(str(txt))
+        assert BoundingBoxes.from_file(str(npy)) == bboxes
+        assert BoundingBoxes.from_file(str(txt)) == bboxes
+
+
+def test_physical_bbox_rescale():
+    pb = PhysicalBoundingBox((0, 0, 0), (8, 8, 8), voxel_size=(40, 4, 4))
+    # downsample xy by 2 -> coords halve in xy
+    other = pb.to_voxel_size((40, 8, 8))
+    assert other.start == Cartesian(0, 0, 0)
+    assert other.stop == Cartesian(8, 4, 4)
+    assert pb.physical_stop == Cartesian(320, 32, 32)

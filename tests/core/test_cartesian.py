@@ -1,0 +1,83 @@
+import numpy as np
+import pytest
+
+from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+
+
+def test_construction_and_fields():
+    c = Cartesian(1, 2, 3)
+    assert c.z == 1 and c.y == 2 and c.x == 3
+    assert tuple(c) == (1, 2, 3)
+    assert Cartesian.from_collection([4, 5, 6]) == Cartesian(4, 5, 6)
+    assert Cartesian.from_collection(np.array([4, 5, 6])) == Cartesian(4, 5, 6)
+
+
+def test_arithmetic_with_scalar():
+    c = Cartesian(2, 4, 6)
+    assert c + 1 == Cartesian(3, 5, 7)
+    assert c - 1 == Cartesian(1, 3, 5)
+    assert c * 2 == Cartesian(4, 8, 12)
+    assert c // 2 == Cartesian(1, 2, 3)
+    assert c / 2 == Cartesian(1.0, 2.0, 3.0)
+    assert c % 4 == Cartesian(2, 0, 2)
+    assert 1 + c == Cartesian(3, 5, 7)
+    assert 10 - c == Cartesian(8, 6, 4)
+
+
+def test_arithmetic_with_triple():
+    a = Cartesian(1, 2, 3)
+    b = Cartesian(10, 20, 30)
+    assert a + b == Cartesian(11, 22, 33)
+    assert b - a == Cartesian(9, 18, 27)
+    assert a * b == Cartesian(10, 40, 90)
+    assert b // a == Cartesian(10, 10, 10)
+    assert a + (1, 1, 1) == Cartesian(2, 3, 4)
+
+
+def test_negation_and_inverse():
+    c = Cartesian(1, 2, 4)
+    assert -c == Cartesian(-1, -2, -4)
+    assert ~c == Cartesian(1.0, 0.5, 0.25)
+
+
+def test_comparisons_are_elementwise_all():
+    assert Cartesian(1, 1, 1) < Cartesian(2, 2, 2)
+    assert not (Cartesian(1, 3, 1) < Cartesian(2, 2, 2))
+    assert Cartesian(2, 2, 2) <= Cartesian(2, 2, 2)
+    assert Cartesian(3, 3, 3) > Cartesian(2, 2, 2)
+    assert Cartesian(1, 2, 3) == Cartesian(1, 2, 3)
+    assert Cartesian(1, 2, 3) != Cartesian(1, 2, 4)
+
+
+def test_rounding_and_ceildiv():
+    c = Cartesian(1.2, 2.5, 3.9)
+    assert c.ceil() == Cartesian(2, 3, 4)
+    assert c.floor() == Cartesian(1, 2, 3)
+    assert Cartesian(10, 11, 12).ceildiv(4) == Cartesian(3, 3, 3)
+    assert Cartesian(8, 8, 8).ceildiv(4) == Cartesian(2, 2, 2)
+
+
+def test_min_max_prod():
+    a = Cartesian(1, 5, 3)
+    b = Cartesian(2, 4, 3)
+    assert a.maximum(b) == Cartesian(2, 5, 3)
+    assert a.minimum(b) == Cartesian(1, 4, 3)
+    assert a.prod() == 15
+    assert a.all_positive()
+    assert not Cartesian(0, 1, 1).all_positive()
+
+
+def test_numpy_bridge():
+    c = Cartesian(1, 2, 3)
+    np.testing.assert_array_equal(c.vec, np.array([1, 2, 3]))
+    # NamedTuple indexes like a sequence
+    assert c[0] == 1
+
+
+def test_to_cartesian():
+    assert to_cartesian(None) is None
+    assert to_cartesian((1, 2, 3)) == Cartesian(1, 2, 3)
+    c = Cartesian(1, 2, 3)
+    assert to_cartesian(c) is c
+    with pytest.raises(ValueError):
+        Cartesian.from_collection([1, 2])

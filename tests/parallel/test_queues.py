@@ -1,0 +1,76 @@
+import os
+import time
+
+from chunkflow_tpu.parallel.queues import FileQueue, MemoryQueue, open_queue
+
+
+class TestMemoryQueue:
+    def test_send_receive_delete(self):
+        q = MemoryQueue("t1", visibility_timeout=100)
+        q.send_messages(["a", "b", "c"])
+        assert len(q) == 3
+        handle, body = q.receive()
+        assert body in {"a", "b", "c"}
+        assert len(q) == 2  # claimed message is invisible
+        q.delete(handle)
+        bodies = {q.receive()[1], q.receive()[1]}
+        assert len(bodies) == 2
+        assert q.receive() is None
+
+    def test_visibility_timeout_requeues(self):
+        q = MemoryQueue("t2", visibility_timeout=0.05)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        assert q.receive() is None
+        time.sleep(0.1)
+        handle2, body = q.receive()  # crashed-worker task reappears
+        assert body == "task"
+        q.delete(handle2)
+        time.sleep(0.1)
+        assert q.receive() is None
+
+    def test_iteration_drains(self):
+        q = MemoryQueue("t3")
+        q.retry_sleep = 0.01
+        q.send_messages([str(i) for i in range(5)])
+        seen = []
+        for handle, body in q:
+            seen.append(body)
+            q.delete(handle)
+        assert sorted(seen) == [str(i) for i in range(5)]
+
+
+class TestFileQueue:
+    def test_send_receive_delete(self, tmp_path):
+        q = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q.send_messages(["0-4_0-4_0-4", "4-8_0-4_0-4"])
+        assert len(q) == 2
+        handle, body = q.receive()
+        assert body.count("_") == 2
+        assert len(q) == 1
+        q.delete(handle)
+        assert not os.path.exists(os.path.join(q.claimed_dir, handle))
+
+    def test_crashed_worker_task_reappears(self, tmp_path):
+        q = FileQueue(str(tmp_path / "q"), visibility_timeout=0.05)
+        q.send_messages(["task"])
+        q.receive()  # claim without ack = simulated crash
+        assert len(q) == 0
+        time.sleep(0.1)
+        handle, body = q.receive()
+        assert body == "task"
+
+    def test_two_workers_no_double_claim(self, tmp_path):
+        q1 = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q2 = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q1.send_messages(["a", "b"])
+        r1 = q1.receive()
+        r2 = q2.receive()
+        assert r1[1] != r2[1]
+        assert q1.receive() is None
+
+
+def test_open_queue_schemes(tmp_path):
+    assert isinstance(open_queue("memory://x"), MemoryQueue)
+    assert isinstance(open_queue(str(tmp_path / "fq")), FileQueue)
+    assert isinstance(open_queue("file://" + str(tmp_path / "fq2")), FileQueue)
