@@ -1,0 +1,74 @@
+"""Headline benchmark: 3D affinity patch-inference throughput per chip.
+
+Metric (reference-canonical, flow/log_summary.py): Mvoxel/s of output
+produced by the fused patch-inference engine — here on a 64x512x512 chunk
+with the production-style patch config (input 20x256x256, overlap 4x64x64,
+3 affinity channels, Flax 3D UNet).
+
+Baseline: the only measured GPU datapoint in the reference repo — its
+committed production logs (tests/data/log/*.json): aff-inference on a
+108x2048x2048 chunk in ~273 s on a TITAN X (Pascal) = 1.66 Mvoxel/s.
+``vs_baseline`` is measured_Mvoxel_per_s / 1.66.
+
+Prints ONE JSON line.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_MVOX_S = 1.66  # TITAN X (Pascal), reference tests/data/log fixtures
+
+CHUNK_SIZE = (64, 512, 512)
+INPUT_PATCH = (20, 256, 256)
+OUTPUT_OVERLAP = (4, 64, 64)
+BATCH_SIZE = 2
+NUM_OUT = 3
+
+
+def main():
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random(CHUNK_SIZE, dtype=np.float32))
+
+    inferencer = Inferencer(
+        input_patch_size=INPUT_PATCH,
+        output_patch_overlap=OUTPUT_OVERLAP,
+        num_output_channels=NUM_OUT,
+        framework="flax",
+        batch_size=BATCH_SIZE,
+        crop_output_margin=False,
+    )
+
+    # warmup: trace + compile + first run
+    out = inferencer(chunk)
+    np.asarray(out.array)
+
+    times = []
+    for _ in range(3):
+        start = time.perf_counter()
+        out = inferencer(chunk)
+        np.asarray(out.array)  # force host sync
+        times.append(time.perf_counter() - start)
+
+    elapsed = min(times)
+    voxels = float(np.prod(CHUNK_SIZE))
+    mvox_s = voxels / elapsed / 1e6
+    print(
+        json.dumps(
+            {
+                "metric": "affinity_inference_throughput",
+                "value": round(mvox_s, 2),
+                "unit": "Mvoxel/s/chip",
+                "vs_baseline": round(mvox_s / BASELINE_MVOX_S, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,15 +30,6 @@ from chunkflow_tpu.flow.runtime import (
 state = PipelineState()
 
 
-class CartesianType(click.ParamType):
-    """Accept one int (broadcast) or three ints for a zyx triple."""
-
-    name = "zyx"
-
-    def convert(self, value, param, ctx):
-        return value
-
-
 def cartesian_option(*names, default=None, required=False, help=""):
     return click.option(
         *names, type=int, nargs=3, default=default, required=required, help=help
@@ -300,6 +291,61 @@ def copy_var_cmd(from_name, to_name):
 # ---------------------------------------------------------------------------
 # compute
 # ---------------------------------------------------------------------------
+@main.command("inference")
+@cartesian_option("--input-patch-size", "-p", required=True)
+@cartesian_option("--output-patch-size", default=None)
+@cartesian_option("--output-patch-overlap", default=(0, 0, 0))
+@click.option("--num-output-channels", type=int, default=3)
+@click.option("--num-input-channels", type=int, default=1)
+@click.option(
+    "--framework", "-f",
+    type=click.Choice(["identity", "flax", "jax", "pytorch", "universal"]),
+    default="flax",
+)
+@click.option("--model-path", "-m", type=str, default="")
+@click.option("--weight-path", "-w", type=str, default=None, help=".pt/.msgpack/orbax weights")
+@click.option("--batch-size", "-b", type=int, default=1)
+@click.option("--augment/--no-augment", default=False, help="8x test-time augmentation")
+@click.option("--crop-output-margin/--no-crop-output-margin", default=True)
+@click.option("--mask-myelin-threshold", type=float, default=None)
+@click.option("--dtype", type=click.Choice(["float32", "bfloat16"]), default="float32")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
+                  num_output_channels, num_input_channels, framework,
+                  model_path, weight_path, batch_size, augment,
+                  crop_output_margin, mask_myelin_threshold, dtype,
+                  input_chunk_name, output_chunk_name):
+    """Patch-wise convnet inference with bump-weighted overlap blending."""
+    from chunkflow_tpu.inference import Inferencer
+
+    # one Inferencer (and its compiled program cache) shared across tasks
+    inferencer = Inferencer(
+        input_patch_size=input_patch_size,
+        output_patch_size=output_patch_size if output_patch_size and any(output_patch_size) else None,
+        output_patch_overlap=output_patch_overlap,
+        num_output_channels=num_output_channels,
+        num_input_channels=num_input_channels,
+        framework=framework,
+        model_path=model_path,
+        weight_path=weight_path,
+        batch_size=batch_size,
+        augment=augment,
+        crop_output_margin=crop_output_margin,
+        mask_myelin_threshold=mask_myelin_threshold,
+        dtype=dtype,
+        dry_run=state.dry_run,
+    )
+
+    @operator
+    def stage(task):
+        task[output_chunk_name] = inferencer(task[input_chunk_name])
+        task["log"]["compute_device"] = inferencer.compute_device
+        return task
+
+    return stage(_name="inference")
+
+
 @main.command("crop-margin")
 @cartesian_option("--margin-size", "-m", default=None)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)

@@ -1,0 +1,3 @@
+from chunkflow_tpu.inference.inferencer import Inferencer
+
+__all__ = ["Inferencer"]

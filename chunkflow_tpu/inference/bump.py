@@ -1,0 +1,69 @@
+"""Bump-function patch weighting for seamless overlap blending.
+
+Parity target: reference flow/divid_conquer/patch/patch_mask.py — the "wu"
+bump ``exp(-1/(1-z^2) - 1/(1-y^2) - 1/(1-x^2))`` evaluated on the open
+(-1, 1)^3 grid, conditioned into float32 range, with the sum-to-one
+normalization invariant for overlapped tiling.
+
+Computed once per patch size on host in float64 (the raw bump spans ~1e-190
+at 256-wide patches, far below float32), affinely rescaled to [1, 1e6], and
+cast to float32 for device use. The fused inference program divides the
+blended output by the accumulated weight mask, so any monotone conditioning
+of the bump preserves exactness.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def bump_map(patch_size: Tuple[int, int, int]) -> np.ndarray:
+    """Raw bump weights, float32, conditioned to [1, 1e6]."""
+    coords = [np.linspace(-1.0, 1.0, s + 2)[1:-1] for s in patch_size]
+    zz, yy, xx = np.meshgrid(*coords, indexing="ij")
+    with np.errstate(under="ignore"):
+        bump = np.exp(
+            -1.0 / (1.0 - zz ** 2)
+            - 1.0 / (1.0 - yy ** 2)
+            - 1.0 / (1.0 - xx ** 2)
+        )
+    # affine conditioning into float32-friendly range; relative ordering of
+    # weights is preserved, which is all reciprocal normalization needs
+    lo, hi = bump.min(), bump.max()
+    bump = (bump - lo) / (hi - lo) * (1e6 - 1.0) + 1.0
+    return bump.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def normalized_patch_mask(
+    patch_size: Tuple[int, int, int], overlap: Tuple[int, int, int]
+) -> np.ndarray:
+    """Bump mask pre-normalized so overlapped tiling sums to exactly 1.
+
+    Simulates a 3x3x3 neighborhood of patches at stride ``size - overlap``
+    accumulating bump weights, then divides the center patch's bump by the
+    accumulated sum. Interior voxels of an infinite tiling then satisfy
+    ``sum of overlapping masks == 1`` (the reference's make_patch_mask
+    invariant, patch_mask.py:43-46).
+    """
+    patch_size = tuple(patch_size)
+    overlap = tuple(overlap)
+    stride = tuple(p - o for p, o in zip(patch_size, overlap))
+    bump = bump_map(patch_size).astype(np.float64)
+    # accumulate 27 shifted copies around the center patch
+    buf_shape = tuple(p + 2 * s for p, s in zip(patch_size, stride))
+    buf = np.zeros(buf_shape, dtype=np.float64)
+    for dz in range(3):
+        for dy in range(3):
+            for dx in range(3):
+                start = (dz * stride[0], dy * stride[1], dx * stride[2])
+                sl = tuple(
+                    slice(st, st + p) for st, p in zip(start, patch_size)
+                )
+                buf[sl] += bump
+    center = tuple(slice(s, s + p) for s, p in zip(stride, patch_size))
+    mask = bump / buf[center]
+    return mask.astype(np.float32)

@@ -1,0 +1,87 @@
+"""The fused gather-forward-blend body shared by single- and multi-chip paths.
+
+This is the pure function version of the hot loop (reference inferencer.py
+:404-455 + chunk/base.py:792-807, redesigned as one XLA program): scan over
+patch batches, vmap(dynamic_slice) gather, engine forward, bump multiply,
+fori_loop scatter-add into output + weight buffers. ``Inferencer`` runs it
+per chip; ``parallel.distributed`` wraps it in shard_map and psums the
+buffers over the mesh.
+"""
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+
+def build_local_blend(
+    forward: Callable,
+    num_input_channels: int,
+    num_output_channels: int,
+    input_patch_size: Tuple[int, int, int],
+    output_patch_size: Tuple[int, int, int],
+    batch_size: int,
+    bump,
+):
+    """Returns ``local_blend(chunk, in_starts, out_starts, valid, params)``
+    -> (out, weight): weighted partial sums over the patches given (padded
+    entries carry validity 0 and contribute nothing)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ci = num_input_channels
+    co = num_output_channels
+    pin = tuple(input_patch_size)
+    pout = tuple(output_patch_size)
+    bump = jnp.asarray(bump)
+
+    def local_blend(chunk, in_starts, out_starts, valid, params):
+        zyx = chunk.shape[1:]
+        num_batches = in_starts.shape[0] // batch_size
+        out0 = jnp.zeros((co,) + zyx, dtype=jnp.float32)
+        w0 = jnp.zeros(zyx, dtype=jnp.float32)
+
+        def step(carry, b):
+            out, weight = carry
+            i0 = b * batch_size
+            s_in = lax.dynamic_slice(in_starts, (i0, 0), (batch_size, 3))
+            s_out = lax.dynamic_slice(out_starts, (i0, 0), (batch_size, 3))
+            v = lax.dynamic_slice(valid, (i0,), (batch_size,))
+
+            patches = jax.vmap(
+                lambda s: lax.dynamic_slice(
+                    chunk, (0, s[0], s[1], s[2]), (ci,) + pin
+                )
+            )(s_in)
+            preds = forward(params, patches)
+            weighted = preds * bump[None, None] * v[:, None, None, None, None]
+            wpatch = bump[None] * v[:, None, None, None]
+
+            def blend_one(j, ow):
+                out, weight = ow
+                s = s_out[j]
+                at4 = (0, s[0], s[1], s[2])
+                cur = lax.dynamic_slice(out, at4, (co,) + pout)
+                out = lax.dynamic_update_slice(out, cur + weighted[j], at4)
+                at3 = (s[0], s[1], s[2])
+                curw = lax.dynamic_slice(weight, at3, pout)
+                weight = lax.dynamic_update_slice(weight, curw + wpatch[j], at3)
+                return out, weight
+
+            out, weight = lax.fori_loop(
+                0, batch_size, blend_one, (out, weight)
+            )
+            return (out, weight), None
+
+        (out, weight), _ = lax.scan(step, (out0, w0), jnp.arange(num_batches))
+        return out, weight
+
+    return local_blend
+
+
+def normalize_blend(out, weight):
+    """Reciprocal weight normalization; zero where nothing was predicted."""
+    import jax.numpy as jnp
+
+    return jnp.where(
+        weight[None] > 0, out / jnp.maximum(weight[None], 1e-20), 0.0
+    )

@@ -1,0 +1,304 @@
+"""Neuroglancer-precomputed volume storage on tensorstore.
+
+Parity target: reference volume.py PrecomputedVolume (:41-209) — a zyx
+C-order facade over xyz F-order precomputed storage, with mip levels,
+existence checks for skip logic, and auto dtype conversion. The reference
+wraps CloudVolume; here the modern equivalent (tensorstore) provides the
+storage driver (the reference itself was moving this way,
+plugins/load_tensorstore.py), and the off-by-transpose hazard the reference
+acknowledges (SURVEY §7 "zyx C-order vs xyz F-order") is confined to this
+one module: everything outside sees czyx Chunks.
+
+Storage layout note: chunks aligned to the storage block size never share a
+file, so parallel writers cannot conflict — the write-safety contract that
+replaces locking (reference docs "block ... ensures no writing conflict").
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+
+_LAYER_TO_PRECOMPUTED = {
+    LayerType.IMAGE: "image",
+    LayerType.AFFINITY_MAP: "image",
+    LayerType.PROBABILITY_MAP: "image",
+    LayerType.SEGMENTATION: "segmentation",
+    LayerType.UNKNOWN: "image",
+}
+
+
+def _kvstore_spec(path: str) -> dict:
+    if path.startswith("file://"):
+        return {"driver": "file", "path": path[len("file://"):]}
+    if path.startswith("gs://"):
+        bucket, _, rest = path[len("gs://"):].partition("/")
+        return {"driver": "gcs", "bucket": bucket, "path": rest}
+    if path.startswith("s3://"):
+        bucket, _, rest = path[len("s3://"):].partition("/")
+        return {"driver": "s3", "bucket": bucket, "path": rest}
+    # bare filesystem path
+    return {"driver": "file", "path": path}
+
+
+def _local_root(path: str) -> Optional[str]:
+    spec = _kvstore_spec(path)
+    return spec["path"] if spec["driver"] == "file" else None
+
+
+class PrecomputedVolume:
+    """One precomputed layer (all mips), czyx semantics."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.kvstore = _kvstore_spec(path)
+        self._stores = {}
+        self._info = None
+
+    # ------------------------------------------------------------------
+    @property
+    def info(self) -> dict:
+        if self._info is None:
+            local = _local_root(self.path)
+            if local is not None:
+                with open(os.path.join(local, "info")) as f:
+                    self._info = json.load(f)
+            else:
+                import tensorstore as ts
+
+                kv = ts.KvStore.open(self.kvstore).result()
+                self._info = json.loads(kv.read("info").result().value)
+        return self._info
+
+    @property
+    def num_mips(self) -> int:
+        return len(self.info["scales"])
+
+    @property
+    def num_channels(self) -> int:
+        return self.info["num_channels"]
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self.info["data_type"])
+
+    @property
+    def layer_type(self) -> LayerType:
+        return (
+            LayerType.SEGMENTATION
+            if self.info["type"] == "segmentation"
+            else LayerType.IMAGE
+        )
+
+    def scale(self, mip: int) -> dict:
+        return self.info["scales"][mip]
+
+    def voxel_size(self, mip: int = 0) -> Cartesian:
+        # precomputed resolution is xyz; we are zyx
+        return Cartesian(*reversed(self.scale(mip)["resolution"]))
+
+    def voxel_offset(self, mip: int = 0) -> Cartesian:
+        return Cartesian(*reversed(self.scale(mip).get("voxel_offset", (0, 0, 0))))
+
+    def volume_size(self, mip: int = 0) -> Cartesian:
+        return Cartesian(*reversed(self.scale(mip)["size"]))
+
+    def block_size(self, mip: int = 0) -> Cartesian:
+        return Cartesian(*reversed(self.scale(mip)["chunk_sizes"][0]))
+
+    def bounds(self, mip: int = 0) -> BoundingBox:
+        start = self.voxel_offset(mip)
+        return BoundingBox(start, start + self.volume_size(mip))
+
+    # ------------------------------------------------------------------
+    def _store(self, mip: int):
+        if mip not in self._stores:
+            import tensorstore as ts
+
+            self._stores[mip] = ts.open(
+                {
+                    "driver": "neuroglancer_precomputed",
+                    "kvstore": self.kvstore,
+                    "scale_index": mip,
+                }
+            ).result()
+        return self._stores[mip]
+
+    def cutout(
+        self,
+        bbox: BoundingBox,
+        mip: int = 0,
+        fill_missing: bool = True,
+    ) -> Chunk:
+        """Read a czyx chunk in global voxel coordinates at ``mip``.
+
+        tensorstore reads absent storage blocks as zeros (the reference's
+        fill_missing=True semantics); pass ``fill_missing=False`` to instead
+        raise when any covering block is absent (strict mode).
+        """
+        if not fill_missing and not self.has_all_blocks(bbox, mip=mip):
+            raise FileNotFoundError(
+                f"missing storage blocks under {self.path} for {bbox} "
+                f"at mip {mip} (strict read)"
+            )
+        store = self._store(mip)
+        sl_xyz = tuple(reversed(bbox.slices))  # zyx -> xyz
+        arr = store[sl_xyz + (slice(None),)].read().result()
+        # xyzc -> czyx
+        arr = np.ascontiguousarray(np.transpose(arr, (3, 2, 1, 0)))
+        if arr.shape[0] == 1:
+            arr = arr[0]
+        return Chunk(
+            arr,
+            voxel_offset=bbox.start,
+            voxel_size=self.voxel_size(mip),
+            layer_type=self.layer_type,
+        )
+
+    def save(self, chunk: Chunk, mip: int = 0) -> None:
+        """Write a chunk at its global offset (czyx -> xyzc)."""
+        store = self._store(mip)
+        arr = np.asarray(chunk.array)
+        if arr.ndim == 3:
+            arr = arr[None]
+        arr = arr.astype(self.dtype, copy=False)
+        arr_xyzc = np.transpose(arr, (3, 2, 1, 0))
+        sl_xyz = tuple(reversed(chunk.bbox.slices))
+        store[sl_xyz + (slice(None),)] = arr_xyzc
+
+    # ------------------------------------------------------------------
+    def block_names(self, bbox: BoundingBox, mip: int = 0) -> List[str]:
+        """Storage object names of the blocks covering ``bbox``."""
+        scale = self.scale(mip)
+        key = scale["key"]
+        block = self.block_size(mip)
+        offset = self.voxel_offset(mip)
+        size = self.volume_size(mip)
+        snapped = bbox.snap_to_blocks(block, offset=offset, outward=True)
+        names = []
+        for blk in snapped.decompose(block):
+            # clamp the last blocks to the volume bounds like the storage does
+            clamped = blk.clamp(self.bounds(mip))
+            if not clamped.is_valid():
+                continue
+            s, e = clamped.start, clamped.stop
+            names.append(f"{key}/{s.x}-{e.x}_{s.y}-{e.y}_{s.z}-{e.z}")
+        return names
+
+    def has_all_blocks(self, bbox: BoundingBox, mip: int = 0) -> bool:
+        """Existence check for skip logic (resume support).
+
+        True iff every storage block covering ``bbox`` already exists, so a
+        re-submitted task can be skipped (reference volume.py:194-209).
+        """
+        local = _local_root(self.path)
+        names = self.block_names(bbox, mip)
+        if local is not None:
+            return all(os.path.exists(os.path.join(local, n)) for n in names)
+        import tensorstore as ts
+
+        kv = ts.KvStore.open(self.kvstore).result()
+        for name in names:
+            if kv.read(name).result().state == "missing":
+                return False
+        return True
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def create(
+        cls,
+        path: str,
+        volume_size,          # zyx at mip 0
+        voxel_size,           # zyx nm at mip 0
+        voxel_offset=(0, 0, 0),
+        num_channels: int = 1,
+        dtype="uint8",
+        layer_type: str = "image",
+        block_size=(64, 64, 64),   # zyx
+        num_mips: int = 1,
+        downsample_factor=(1, 2, 2),  # zyx per mip
+    ) -> "PrecomputedVolume":
+        """Create the info file with a mip pyramid (create_new_info parity)."""
+        volume_size = to_cartesian(volume_size)
+        voxel_size = to_cartesian(voxel_size)
+        voxel_offset = to_cartesian(voxel_offset)
+        block = to_cartesian(block_size)
+        factor = to_cartesian(downsample_factor)
+
+        scales = []
+        size = volume_size
+        res = voxel_size
+        offset = voxel_offset
+        for _ in range(num_mips):
+            key = f"{res.x}_{res.y}_{res.z}"
+            scales.append(
+                {
+                    "key": key,
+                    "size": [size.x, size.y, size.z],
+                    "resolution": [res.x, res.y, res.z],
+                    "voxel_offset": [offset.x, offset.y, offset.z],
+                    "chunk_sizes": [[block.x, block.y, block.z]],
+                    "encoding": "raw",
+                }
+            )
+            size = size.ceildiv(factor)
+            offset = offset // factor
+            res = res * factor
+
+        info = {
+            "type": layer_type,
+            "data_type": str(np.dtype(dtype)),
+            "num_channels": num_channels,
+            "scales": scales,
+        }
+        local = _local_root(path)
+        if local is not None:
+            os.makedirs(local, exist_ok=True)
+            with open(os.path.join(local, "info"), "w") as f:
+                json.dump(info, f)
+        else:
+            import tensorstore as ts
+
+            kv = ts.KvStore.open(_kvstore_spec(path)).result()
+            kv.write("info", json.dumps(info).encode()).result()
+        vol = cls(path)
+        vol._info = info
+        return vol
+
+    @classmethod
+    def from_chunk(cls, chunk: Chunk, path: str, **kwargs) -> "PrecomputedVolume":
+        """Create a volume sized/typed like ``chunk`` and write it (test
+        fixture helper, analog of CloudVolume.from_numpy)."""
+        vol = cls.create(
+            path,
+            volume_size=chunk.shape[-3:],
+            voxel_size=chunk.voxel_size,
+            voxel_offset=chunk.voxel_offset,
+            num_channels=chunk.nchannels,
+            dtype=chunk.dtype,
+            layer_type=_LAYER_TO_PRECOMPUTED[chunk.layer_type],
+            **kwargs,
+        )
+        vol.save(chunk, mip=0)
+        return vol
+
+
+def load_chunk_or_volume(path: str, mip: int = 0, bbox: Optional[BoundingBox] = None):
+    """Open a storage path: h5/tif/npy files load as Chunks, directories as
+    PrecomputedVolume (cut out ``bbox`` if given). Reference volume.py:217."""
+    if path.endswith(".h5"):
+        return Chunk.from_h5(path, bbox=bbox)
+    if path.endswith((".tif", ".tiff")):
+        return Chunk.from_tif(path)
+    if path.endswith(".npy"):
+        return Chunk.from_npy(path)
+    vol = PrecomputedVolume(path)
+    if bbox is not None:
+        return vol.cutout(bbox, mip=mip)
+    return vol

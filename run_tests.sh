@@ -1,0 +1,8 @@
+#!/bin/bash
+# Run the test suite on a clean 8-device virtual CPU mesh.
+# PALLAS_AXON_POOL_IPS must be unset: with it set, the TPU-tunnel site hook
+# intercepts every jax init, slowing CPU tests ~20x and wedging the
+# single-client tunnel if tests run concurrently with TPU work.
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m pytest tests/ "$@"

@@ -1,0 +1,79 @@
+import numpy as np
+
+from chunkflow_tpu.inference.bump import bump_map, normalized_patch_mask
+from chunkflow_tpu.inference.patching import (
+    enumerate_patches,
+    pad_to_batch,
+    starts_1d,
+)
+
+
+def test_bump_map_properties():
+    bump = bump_map((8, 16, 16))
+    assert bump.shape == (8, 16, 16)
+    assert bump.dtype == np.float32
+    assert bump.min() >= 1.0
+    assert bump.max() <= 1e6 + 1
+    # maximum at the center
+    assert bump[4, 8, 8] == bump.max()
+    # symmetric
+    np.testing.assert_allclose(bump, bump[::-1, :, :], rtol=1e-5)
+    np.testing.assert_allclose(bump, bump[:, ::-1, :], rtol=1e-5)
+
+
+def test_normalized_mask_sums_to_one_when_tiled():
+    """The reference's make_patch_mask invariant (patch_mask.py:43-46):
+    masks of overlapping patches must sum to 1 in the covered interior."""
+    patch = (8, 8, 8)
+    overlap = (4, 4, 4)
+    mask = normalized_patch_mask(patch, overlap).astype(np.float64)
+    stride = tuple(p - o for p, o in zip(patch, overlap))
+    # tile a 5x5x5 patch grid
+    shape = tuple(4 * s + p for s, p in zip(stride, patch))
+    buf = np.zeros(shape)
+    for i in range(5):
+        for j in range(5):
+            for k in range(5):
+                start = (i * stride[0], j * stride[1], k * stride[2])
+                sl = tuple(slice(s, s + p) for s, p in zip(start, patch))
+                buf[sl] += mask
+    # interior (one patch margin in from each face) must be exactly 1
+    interior = buf[
+        patch[0] : -patch[0], patch[1] : -patch[1], patch[2] : -patch[2]
+    ]
+    np.testing.assert_allclose(interior, 1.0, atol=1e-6)
+
+
+def test_starts_1d_snapping():
+    assert starts_1d(32, 16, 8) == [0, 8, 16]
+    assert starts_1d(30, 16, 8) == [0, 8, 14]  # last snapped flush
+    assert starts_1d(16, 16, 8) == [0]
+    import pytest
+
+    with pytest.raises(ValueError):
+        starts_1d(8, 16, 8)
+
+
+def test_enumerate_patches_geometry():
+    grid = enumerate_patches(
+        (32, 32, 32),
+        input_patch_size=(16, 16, 16),
+        output_patch_size=(12, 12, 12),
+        output_patch_overlap=(4, 4, 4),
+    )
+    assert grid.crop_margin == (2, 2, 2)
+    # stride 8: starts [0, 8, 16] per axis
+    assert grid.num_patches == 27
+    np.testing.assert_array_equal(
+        grid.output_starts, grid.input_starts + 2
+    )
+    assert grid.input_starts.max() == 16
+
+
+def test_pad_to_batch():
+    grid = enumerate_patches((32, 32, 32), (16, 16, 16))
+    assert grid.num_patches == 8
+    in_starts, out_starts, valid = pad_to_batch(grid, 3)
+    assert in_starts.shape[0] == 9
+    assert valid.sum() == 8
+    assert valid[-1] == 0

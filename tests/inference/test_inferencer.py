@@ -1,0 +1,171 @@
+"""The identity oracle: identity-net through the whole fused blend path
+(patch gather -> forward -> bump multiply -> scatter-add -> reciprocal
+normalization) must reproduce the input exactly (up to float32).
+
+Mirrors reference tests/flow/divid_conquer/test_inferencer.py, including the
+non-aligned chunk case, plus paths the reference cannot test exactly (edges
+are exact here because the weight mask normalizes the whole chunk).
+"""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.cartesian import Cartesian
+from chunkflow_tpu.inference import Inferencer
+
+
+def _random_chunk(size, offset=(0, 0, 0), seed=0):
+    rng = np.random.default_rng(seed)
+    return Chunk(
+        rng.random(size).astype(np.float32),
+        voxel_offset=offset,
+        voxel_size=(1, 1, 1),
+    )
+
+
+def _assert_identity(out, chunk, margin):
+    expected = chunk.crop_margin(margin) if any(margin) else chunk
+    assert out.voxel_offset == expected.voxel_offset
+    assert out.shape[-3:] == expected.shape[-3:]
+    got = np.asarray(out.array)
+    if got.ndim == 4:
+        got = got[0]
+    np.testing.assert_allclose(
+        got, np.asarray(expected.array), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_identity_aligned_no_margin():
+    chunk = _random_chunk((32, 32, 32))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    _assert_identity(out, chunk, (0, 0, 0))
+
+
+def test_identity_with_crop_margin():
+    chunk = _random_chunk((32, 32, 32), offset=(10, 20, 30))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_size=(12, 12, 12),
+        output_patch_overlap=(4, 4, 4),
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    _assert_identity(out, chunk, (2, 2, 2))
+
+
+def test_identity_nonaligned_chunk():
+    # 30x44x50 does not tile with 16-patches at stride 8: edge snapping +
+    # weight normalization must still give exact reconstruction
+    chunk = _random_chunk((30, 44, 50))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    _assert_identity(out, chunk, (0, 0, 0))
+
+
+def test_identity_batched():
+    chunk = _random_chunk((32, 32, 32))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        framework="identity",
+        batch_size=5,  # 27 patches pad to 30
+    )
+    out = inferencer(chunk)
+    _assert_identity(out, chunk, (0, 0, 0))
+
+
+def test_identity_multichannel_output():
+    chunk = _random_chunk((24, 24, 24))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    assert out.shape[0] == 3
+    assert out.is_affinity_map
+    for c in range(3):
+        np.testing.assert_allclose(
+            np.asarray(out.array)[c],
+            np.asarray(chunk.array),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+
+def test_identity_tta():
+    chunk = _random_chunk((24, 24, 24))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        framework="identity",
+        augment=True,
+    )
+    out = inferencer(chunk)
+    # identity is equivariant to flips/transpose, so TTA is still identity
+    _assert_identity(out, chunk, (0, 0, 0))
+
+
+def test_uint8_input_normalized():
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.integers(0, 255, (24, 24, 24)).astype(np.uint8))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_overlap=(8, 8, 8),
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    got = np.asarray(out.array)
+    expected = np.asarray(chunk.array).astype(np.float32) / 255.0
+    np.testing.assert_allclose(got.squeeze(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_all_zero_short_circuit():
+    chunk = Chunk(np.zeros((24, 24, 24), dtype=np.float32))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        output_patch_size=(12, 12, 12),
+        framework="identity",
+    )
+    out = inferencer(chunk)
+    assert out.all_zero()
+    assert out.shape[-3:] == (20, 20, 20)
+    assert out.voxel_offset == Cartesian(2, 2, 2)
+
+
+def test_dry_run():
+    chunk = _random_chunk((24, 24, 24))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16),
+        framework="identity",
+        dry_run=True,
+    )
+    out = inferencer(chunk)
+    assert out.shape[-3:] == (24, 24, 24)
+    assert out.all_zero()
+
+
+def test_patch_larger_than_chunk_raises():
+    chunk = _random_chunk((8, 8, 8))
+    inferencer = Inferencer(
+        input_patch_size=(16, 16, 16), framework="identity"
+    )
+    with pytest.raises(ValueError):
+        inferencer(chunk)
+
+
+def test_tta_requires_square_patches():
+    with pytest.raises(ValueError):
+        Inferencer(
+            input_patch_size=(16, 32, 16), framework="identity", augment=True
+        )

@@ -1,0 +1,83 @@
+"""End-to-end .pt migration parity: a torch twin of UNet3D -> converter ->
+flax UNet3D must produce the same output (MSE well under the 1e-4 parity
+target from BASELINE.md)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import jax
+import jax.numpy as jnp
+
+from chunkflow_tpu.models import unet3d
+from chunkflow_tpu.models.converter import torch_to_flax
+
+FEATS = (4, 6, 8)
+DOWNS = ((1, 2, 2), (2, 2, 2))
+
+
+class TorchConvBlock(torch.nn.Module):
+    """Definition order mirrors execution order (the converter contract)."""
+
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv1 = torch.nn.Conv3d(cin, cout, 3, padding=1)
+        self.norm1 = torch.nn.InstanceNorm3d(cout, eps=1e-5, affine=True)
+        self.conv2 = torch.nn.Conv3d(cout, cout, 3, padding=1)
+        self.norm2 = torch.nn.InstanceNorm3d(cout, eps=1e-5, affine=True)
+        self.cin = cin
+        self.cout = cout
+
+    def forward(self, x):
+        r = x
+        x = torch.nn.functional.elu(self.norm1(self.conv1(x)))
+        x = self.norm2(self.conv2(x))
+        if self.cin == self.cout:
+            x = x + r
+        return torch.nn.functional.elu(x)
+
+
+class TorchUNet(torch.nn.Module):
+    def __init__(self, cin=1, cout=3):
+        super().__init__()
+        self.conv_in = torch.nn.Conv3d(cin, FEATS[0], (1, 5, 5), padding=(0, 2, 2))
+        self.enc0 = TorchConvBlock(FEATS[0], FEATS[0])
+        self.enc1 = TorchConvBlock(FEATS[0], FEATS[1])
+        self.bridge = TorchConvBlock(FEATS[1], FEATS[2])
+        self.up1 = torch.nn.ConvTranspose3d(FEATS[2], FEATS[1], DOWNS[1], stride=DOWNS[1])
+        self.dec1 = TorchConvBlock(FEATS[1], FEATS[1])
+        self.up0 = torch.nn.ConvTranspose3d(FEATS[1], FEATS[0], DOWNS[0], stride=DOWNS[0])
+        self.dec0 = TorchConvBlock(FEATS[0], FEATS[0])
+        self.conv_out = torch.nn.Conv3d(FEATS[0], cout, (1, 5, 5), padding=(0, 2, 2))
+
+    def forward(self, x):
+        x = self.conv_in(x)
+        s0 = self.enc0(x)
+        x = torch.nn.functional.max_pool3d(s0, DOWNS[0], stride=DOWNS[0])
+        s1 = self.enc1(x)
+        x = torch.nn.functional.max_pool3d(s1, DOWNS[1], stride=DOWNS[1])
+        x = self.bridge(x)
+        x = self.up1(x) + s1
+        x = self.dec1(x)
+        x = self.up0(x) + s0
+        x = self.dec0(x)
+        return torch.sigmoid(self.conv_out(x))
+
+
+def test_torch_unet_to_flax_parity(tmp_path):
+    tnet = TorchUNet().eval()
+    path = str(tmp_path / "weights.pt")
+    torch.save(tnet.state_dict(), path)
+
+    fnet = unet3d.UNet3D(
+        in_channels=1, out_channels=3,
+        feature_maps=FEATS, down_factors=DOWNS,
+    )
+    params = unet3d.init_or_load_params(fnet, path, (4, 16, 16), 1)
+
+    x = np.random.default_rng(0).random((2, 4, 16, 16, 1)).astype(np.float32)
+    with torch.no_grad():
+        expected = tnet(torch.from_numpy(np.moveaxis(x, -1, 1))).numpy()
+    got = np.asarray(fnet.apply({"params": params}, jnp.asarray(x)))
+    got = np.moveaxis(got, -1, 1)
+    mse = float(np.mean((got - expected) ** 2))
+    assert mse < 1e-8, f"torch->flax parity MSE {mse}"

@@ -1,0 +1,102 @@
+import numpy as np
+import pytest
+
+ts = pytest.importorskip("tensorstore")
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.core.cartesian import Cartesian
+from chunkflow_tpu.volume.precomputed import (
+    PrecomputedVolume,
+    load_chunk_or_volume,
+)
+
+
+@pytest.fixture
+def vol(tmp_path):
+    return PrecomputedVolume.create(
+        str(tmp_path / "vol"),
+        volume_size=(64, 64, 64),
+        voxel_size=(40, 4, 4),
+        voxel_offset=(0, 0, 0),
+        dtype="uint8",
+        block_size=(32, 32, 32),
+    )
+
+
+def test_create_metadata(vol):
+    assert vol.num_mips == 1
+    assert vol.dtype == np.uint8
+    assert vol.voxel_size(0) == Cartesian(40, 4, 4)
+    assert vol.volume_size(0) == Cartesian(64, 64, 64)
+    assert vol.block_size(0) == Cartesian(32, 32, 32)
+
+
+def test_save_cutout_roundtrip(vol):
+    chunk = Chunk.create((64, 64, 64), dtype=np.uint8, voxel_size=(40, 4, 4))
+    vol.save(chunk)
+    out = vol.cutout(BoundingBox((0, 0, 0), (64, 64, 64)))
+    np.testing.assert_array_equal(np.asarray(out.array), np.asarray(chunk.array))
+    assert out.voxel_size == Cartesian(40, 4, 4)
+
+    # windowed read keeps global coordinates
+    window = BoundingBox((10, 20, 30), (20, 40, 50))
+    sub = vol.cutout(window)
+    assert sub.voxel_offset == window.start
+    np.testing.assert_array_equal(
+        np.asarray(sub.array), np.asarray(chunk.cutout(window).array)
+    )
+
+
+def test_zyx_xyz_transpose_is_correct(vol):
+    """An asymmetric pattern must land transposed in xyz storage."""
+    arr = np.zeros((64, 64, 64), dtype=np.uint8)
+    arr[1, 2, 3] = 77  # z=1, y=2, x=3
+    vol.save(Chunk(arr, voxel_size=(40, 4, 4)))
+    store = vol._store(0)
+    raw = store[3, 2, 1, 0].read().result()  # x, y, z, channel
+    assert int(raw) == 77
+
+
+def test_has_all_blocks(vol):
+    chunk = Chunk.create((32, 32, 32), dtype=np.uint8, voxel_size=(40, 4, 4))
+    bbox = BoundingBox((0, 0, 0), (32, 32, 32))
+    assert not vol.has_all_blocks(bbox)
+    vol.save(chunk)
+    assert vol.has_all_blocks(bbox)
+    assert not vol.has_all_blocks(BoundingBox((0, 0, 0), (64, 64, 64)))
+
+
+def test_multichannel_volume(tmp_path):
+    rng = np.random.default_rng(0)
+    aff = Chunk(rng.random((3, 16, 16, 16)).astype(np.float32))
+    vol = PrecomputedVolume.from_chunk(
+        aff, str(tmp_path / "aff"), block_size=(8, 8, 8)
+    )
+    assert vol.num_channels == 3
+    out = vol.cutout(BoundingBox((0, 0, 0), (16, 16, 16)))
+    assert out.shape == (3, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(out.array), np.asarray(aff.array))
+
+
+def test_mip_pyramid_metadata(tmp_path):
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "pyr"),
+        volume_size=(64, 64, 64),
+        voxel_size=(40, 4, 4),
+        num_mips=3,
+        downsample_factor=(1, 2, 2),
+    )
+    assert vol.num_mips == 3
+    assert vol.voxel_size(1) == Cartesian(40, 8, 8)
+    assert vol.volume_size(2) == Cartesian(64, 16, 16)
+
+
+def test_load_chunk_or_volume(tmp_path, vol):
+    chunk = Chunk.create((8, 8, 8))
+    h5 = str(tmp_path / "c.h5")
+    chunk.to_h5(h5)
+    loaded = load_chunk_or_volume(h5)
+    assert isinstance(loaded, Chunk)
+    v = load_chunk_or_volume(vol.path)
+    assert isinstance(v, PrecomputedVolume)
