@@ -234,6 +234,148 @@ def save_tif_cmd(file_name, input_chunk_name):
 
 
 # ---------------------------------------------------------------------------
+# precomputed volumes
+# ---------------------------------------------------------------------------
+@main.command("create-info")
+@click.option("--volume-path", "-v", type=str, required=True)
+@cartesian_option("--volume-size", "-s", required=True)
+@cartesian_option("--voxel-size", default=(1, 1, 1))
+@cartesian_option("--voxel-offset", default=(0, 0, 0))
+@click.option("--num-channels", "-c", type=int, default=1)
+@click.option("--dtype", type=str, default="uint8")
+@click.option("--layer-type", type=click.Choice(["image", "segmentation"]), default="image")
+@cartesian_option("--block-size", default=(64, 64, 64))
+@click.option("--max-mip", type=int, default=0)
+@cartesian_option("--factor", default=(1, 2, 2))
+def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
+                    num_channels, dtype, layer_type, block_size, max_mip, factor):
+    """Create a precomputed volume info file (with mip pyramid)."""
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    @operator
+    def stage(task):
+        PrecomputedVolume.create(
+            volume_path,
+            volume_size=volume_size,
+            voxel_size=voxel_size,
+            voxel_offset=voxel_offset,
+            num_channels=num_channels,
+            dtype=dtype,
+            layer_type=layer_type,
+            block_size=block_size,
+            num_mips=max_mip + 1,
+            downsample_factor=factor,
+        )
+        return task
+
+    return stage(_name="create-info")
+
+
+@main.command("load-precomputed")
+@click.option("--volume-path", "-v", type=str, required=True)
+@click.option("--mip", type=int, default=None, help="defaults to global --mip")
+@cartesian_option("--expand-margin-size", "-e", default=(0, 0, 0))
+@click.option("--fill-missing/--no-fill-missing", default=True)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
+                         output_chunk_name):
+    """Cut out the task bbox (plus margins) from a precomputed volume."""
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    vol = PrecomputedVolume(volume_path)
+
+    @operator
+    def stage(task):
+        bbox = task["bbox"]
+        if expand_margin_size and any(expand_margin_size):
+            bbox = bbox.adjust(expand_margin_size)
+        task[output_chunk_name] = vol.cutout(
+            bbox,
+            mip=mip if mip is not None else state.mip,
+            fill_missing=fill_missing,
+        )
+        return task
+
+    return stage(_name="load-precomputed")
+
+
+@main.command("save-precomputed")
+@click.option("--volume-path", "-v", type=str, required=True)
+@click.option("--mip", type=int, default=None)
+@click.option("--upload-log/--no-upload-log", default=True)
+@click.option("--create-thumbnail/--no-create-thumbnail", default=False)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_precomputed_cmd(volume_path, mip, upload_log, create_thumbnail,
+                         input_chunk_name):
+    """Write the chunk to a precomputed volume (+ timing log sidecar)."""
+    import json
+    import os
+
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume, _local_root
+
+    vol = PrecomputedVolume(volume_path)
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        if state.dry_run:
+            return task
+        vol.save(chunk, mip=mip if mip is not None else state.mip)
+        if create_thumbnail:
+            from chunkflow_tpu.ops.downsample import pyramid
+
+            thumb = chunk
+            if thumb.ndim == 4:
+                from chunkflow_tpu.chunk import AffinityMap
+
+                thumb = AffinityMap(
+                    thumb.array,
+                    voxel_offset=thumb.voxel_offset,
+                    voxel_size=thumb.voxel_size,
+                ).quantize()
+            for level, down in enumerate(
+                pyramid(thumb, num_mips=vol.num_mips - 1), start=1
+            ):
+                vol.save(down, mip=level)
+        if upload_log:
+            local = _local_root(volume_path)
+            if local is not None:
+                log_dir = os.path.join(local, "log")
+                os.makedirs(log_dir, exist_ok=True)
+                record = {
+                    "timer": task["log"]["timer"],
+                    "compute_device": task["log"].get("compute_device", ""),
+                    "bbox": chunk.bbox.string,
+                }
+                with open(
+                    os.path.join(log_dir, f"{chunk.bbox.string}.json"), "w"
+                ) as f:
+                    json.dump(record, f)
+        return task
+
+    return stage(_name="save-precomputed")
+
+
+@main.command("log-summary")
+@click.option("--log-dir", "-l", type=str, required=True)
+@cartesian_option("--output-size", default=None)
+def log_summary_cmd(log_dir, output_size):
+    """Aggregate per-task timing logs into a throughput report."""
+    from chunkflow_tpu.flow.log_summary import print_summary
+
+    @generator
+    def stage(task):
+        print_summary(
+            log_dir,
+            output_size=output_size if output_size and any(output_size) else None,
+        )
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
+# ---------------------------------------------------------------------------
 # flow control
 # ---------------------------------------------------------------------------
 @main.command("skip-all-zero")
@@ -260,6 +402,72 @@ def skip_none_cmd(input_chunk_name):
         return task
 
     return stage(_name="skip-none")
+
+
+@main.command("skip-task-by-file")
+@click.option("--prefix", "-p", type=str, required=True, help="marker path prefix")
+@click.option("--suffix", "-s", type=str, default=".h5")
+def skip_task_by_file_cmd(prefix, suffix):
+    """Skip tasks whose marker/output file already exists (resume)."""
+    import os
+
+    @operator
+    def stage(task):
+        path = f"{prefix}{task['bbox'].string}{suffix}"
+        if os.path.exists(path):
+            return None
+        return task
+
+    return stage(_name="skip-task-by-file")
+
+
+@main.command("skip-task-by-blocks-in-volume")
+@click.option("--volume-path", "-v", type=str, required=True)
+@click.option("--mip", type=int, default=None)
+def skip_task_by_blocks_cmd(volume_path, mip):
+    """Skip tasks whose output blocks all exist in the volume (resume)."""
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    vol = PrecomputedVolume(volume_path)
+
+    @operator
+    def stage(task):
+        if vol.has_all_blocks(
+            task["bbox"], mip=mip if mip is not None else state.mip
+        ):
+            return None
+        return task
+
+    return stage(_name="skip-task-by-blocks-in-volume")
+
+
+@main.command("mark-complete")
+@click.option("--prefix", "-p", type=str, required=True)
+@click.option("--suffix", "-s", type=str, default=".done")
+def mark_complete_cmd(prefix, suffix):
+    """Touch a completion marker file for the task bbox."""
+    import os
+
+    @operator
+    def stage(task):
+        if not state.dry_run:
+            os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
+            with open(f"{prefix}{task['bbox'].string}{suffix}", "w"):
+                pass
+        return task
+
+    return stage(_name="mark-complete")
+
+
+@main.command("adjust-bbox")
+@cartesian_option("--corner-offset", required=True, help="grow(+)/shrink(-) both corners")
+def adjust_bbox_cmd(corner_offset):
+    @operator
+    def stage(task):
+        task["bbox"] = task["bbox"].adjust(corner_offset)
+        return task
+
+    return stage(_name="adjust-bbox")
 
 
 @main.command("delete-var")
@@ -425,6 +633,218 @@ def normalize_contrast_cmd(lower_clip_fraction, upper_clip_fraction, input_chunk
         return task
 
     return stage(_name="normalize-contrast")
+
+
+@main.command("mask")
+@click.option("--volume-path", "-v", type=str, required=True,
+              help="mask volume (its voxel size may be any integer multiple of the chunk's)")
+@click.option("--mip", type=int, default=0, help="scale index within the mask volume")
+@click.option("--inverse/--no-inverse", default=False)
+@click.option("--fill-missing/--no-fill-missing", default=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def mask_cmd(volume_path, mip, inverse, fill_missing, input_chunk_name, output_chunk_name):
+    """Multiply the chunk by a (usually coarser-resolution) mask volume."""
+    import math
+
+    from chunkflow_tpu.core.bbox import BoundingBox
+    from chunkflow_tpu.core.cartesian import Cartesian
+    from chunkflow_tpu.ops.mask import maskout
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    vol = PrecomputedVolume(volume_path)
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        factor = vol.voxel_size(mip) / chunk.voxel_size
+        start = Cartesian(
+            *(int(math.floor(s / f)) for s, f in zip(chunk.bbox.start, factor))
+        )
+        stop = Cartesian(
+            *(int(math.ceil(e / f)) for e, f in zip(chunk.bbox.stop, factor))
+        )
+        mask_chunk = vol.cutout(
+            BoundingBox(start, stop), mip=mip, fill_missing=fill_missing
+        )
+        task[output_chunk_name] = maskout(chunk, mask_chunk, inverse=inverse)
+        return task
+
+    return stage(_name="mask")
+
+
+@main.command("multiply")
+@click.option("--input-names", "-i", type=str, required=True, help="comma-separated: a,b")
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def multiply_cmd(input_names, output_chunk_name):
+    @operator
+    def stage(task):
+        a, b = (task[n.strip()] for n in input_names.split(","))
+        task[output_chunk_name] = a * b
+        return task
+
+    return stage(_name="multiply")
+
+
+@main.command("mask-out-objects")
+@click.option("--dust-size-threshold", "-d", type=int, default=0)
+@click.option("--selected-obj-ids", "-s", type=str, default=None, help="comma-separated keep list")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def mask_out_objects_cmd(dust_size_threshold, selected_obj_ids,
+                         input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        seg = task[input_chunk_name]
+        if not isinstance(seg, Segmentation):
+            seg = Segmentation.from_chunk(seg)
+        if dust_size_threshold:
+            seg = seg.mask_fragments(dust_size_threshold)
+        if selected_obj_ids:
+            ids = [int(x) for x in selected_obj_ids.split(",")]
+            seg = seg.mask_except(ids)
+        task[output_chunk_name] = seg
+        return task
+
+    return stage(_name="mask-out-objects")
+
+
+@main.command("quantize")
+@click.option("--mode", type=click.Choice(["xy", "z"]), default="xy")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def quantize_cmd(mode, input_chunk_name, output_chunk_name):
+    """Compress an affinity map into a uint8 thumbnail image."""
+    from chunkflow_tpu.chunk import AffinityMap
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        aff = AffinityMap(
+            chunk.array,
+            voxel_offset=chunk.voxel_offset,
+            voxel_size=chunk.voxel_size,
+        )
+        task[output_chunk_name] = aff.quantize(mode=mode)
+        return task
+
+    return stage(_name="quantize")
+
+
+@main.command("downsample")
+@cartesian_option("--factor", "-f", default=(1, 2, 2))
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def downsample_cmd(factor, input_chunk_name, output_chunk_name):
+    from chunkflow_tpu.ops.downsample import downsample
+
+    @operator
+    def stage(task):
+        task[output_chunk_name] = downsample(task[input_chunk_name], factor)
+        return task
+
+    return stage(_name="downsample")
+
+
+@main.command("downsample-upload")
+@click.option("--volume-path", "-v", type=str, required=True)
+@cartesian_option("--factor", "-f", default=(1, 2, 2))
+@click.option("--start-mip", type=int, default=1)
+@click.option("--stop-mip", type=int, default=None, help="exclusive; defaults to volume num_mips")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def downsample_upload_cmd(volume_path, factor, start_mip, stop_mip, input_chunk_name):
+    """Build a mip pyramid of the chunk and upload every level."""
+    from chunkflow_tpu.ops.downsample import downsample
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    vol = PrecomputedVolume(volume_path)
+
+    @operator
+    def stage(task):
+        stop = stop_mip if stop_mip is not None else vol.num_mips
+        current = task[input_chunk_name]
+        for level in range(1, stop):
+            current = downsample(current, factor)
+            if level >= start_mip and not state.dry_run:
+                vol.save(current, mip=level)
+        return task
+
+    return stage(_name="downsample-upload")
+
+
+@main.command("gaussian-filter")
+@click.option("--sigma", "-s", type=float, default=1.0)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def gaussian_filter_cmd(sigma, input_chunk_name, output_chunk_name):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = task[input_chunk_name].gaussian_filter_2d(sigma)
+        return task
+
+    return stage(_name="gaussian-filter")
+
+
+@main.command("plugin")
+@click.option("--name", "-n", "--file", "-f", type=str, required=True)
+@click.option("--input-names", "-i", type=str, default=DEFAULT_CHUNK_NAME, help="comma-separated task keys")
+@click.option("--output-names", "-o", type=str, default=DEFAULT_CHUNK_NAME, help="comma-separated task keys")
+@click.option("--args", "-a", type=str, default=None, help="k=v;k2=(1,2) plugin args")
+def plugin_cmd(name, input_names, output_names, args):
+    """Run a user plugin file: execute(*inputs, **args)."""
+    from chunkflow_tpu.flow.plugin import load_plugin, str_to_dict, wrap_outputs
+
+    execute = load_plugin(name)
+    kwargs = str_to_dict(args)
+
+    @operator
+    def stage(task):
+        inputs = [task[k.strip()] for k in input_names.split(",") if k.strip()]
+        outputs = execute(*inputs, **kwargs)
+        wrapped = wrap_outputs(outputs, inputs)
+        out_keys = [k.strip() for k in output_names.split(",") if k.strip()]
+        for key, value in zip(out_keys, wrapped):
+            task[key] = value
+        return task
+
+    return stage(_name=f"plugin-{name}")
+
+
+@main.command("save-pngs")
+@click.option("--output-path", "-o", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_pngs_cmd(output_path, input_chunk_name):
+    from chunkflow_tpu.volume.io_png import save_pngs
+
+    @operator
+    def stage(task):
+        save_pngs(task[input_chunk_name], output_path)
+        return task
+
+    return stage(_name="save-pngs")
+
+
+@main.command("load-png")
+@click.option("--path", "-p", type=str, required=True, help="directory of z-section pngs")
+@cartesian_option("--voxel-offset", default=(0, 0, 0))
+@click.option("--dtype", type=str, default=None)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
+    from chunkflow_tpu.volume.io_png import load_pngs
+
+    @operator
+    def stage(task):
+        import numpy as _np
+
+        task[output_chunk_name] = load_pngs(
+            path,
+            bbox=task.get("bbox"),
+            voxel_offset=voxel_offset,
+            dtype=_np.dtype(dtype) if dtype else None,
+        )
+        return task
+
+    return stage(_name="load-png")
 
 
 @main.command("evaluate-segmentation")

@@ -1,0 +1,95 @@
+"""Fleet-wide timing log aggregation.
+
+Parity: reference flow/log_summary.py — parse per-task JSON logs into a
+pandas frame, report mean/max/min/sum seconds per operator grouped by
+compute device, and the canonical throughput number in Mvoxel/s
+(voxels of output per mean task-second).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from chunkflow_tpu.core.bbox import BoundingBox
+
+
+def load_log_dir(log_dir: str) -> List[dict]:
+    records = []
+    for name in sorted(os.listdir(log_dir)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(log_dir, name)) as f:
+            record = json.load(f)
+        record.setdefault("_file", name)
+        try:
+            record["_bbox"] = BoundingBox.from_string(name)
+        except ValueError:
+            bbox_str = record.get("bbox")
+            record["_bbox"] = (
+                BoundingBox.from_string(bbox_str) if bbox_str else None
+            )
+        records.append(record)
+    return records
+
+
+def summarize(records: List[dict], output_size=None) -> "object":
+    import pandas as pd
+
+    rows = []
+    for record in records:
+        timer = record.get("timer", record.get("log", {}).get("timer", {}))
+        row = dict(timer)
+        row["compute_device"] = record.get(
+            "compute_device", record.get("log", {}).get("compute_device", "")
+        )
+        row["_total"] = sum(timer.values())
+        if record.get("_bbox") is not None:
+            row["_voxels"] = record["_bbox"].voxel_count
+        elif output_size is not None:
+            row["_voxels"] = int(np.prod(output_size))
+        rows.append(row)
+    frame = pd.DataFrame(rows)
+    grouped = frame.groupby("compute_device")
+    summary = grouped.agg(["mean", "max", "min", "sum", "count"])
+    return summary
+
+
+def print_summary(log_dir: str, output_size=None) -> None:
+    records = load_log_dir(log_dir)
+    if not records:
+        print(f"no task logs found in {log_dir}")
+        return
+    summary = summarize(records, output_size=output_size)
+    print(summary)
+    # canonical throughput: voxels per mean total task time
+    import pandas as pd
+
+    for device, group in pd.DataFrame(
+        [
+            {
+                "compute_device": r.get(
+                    "compute_device", r.get("log", {}).get("compute_device", "")
+                ),
+                "total": sum(
+                    r.get("timer", r.get("log", {}).get("timer", {})).values()
+                ),
+                "voxels": (
+                    r["_bbox"].voxel_count
+                    if r.get("_bbox") is not None
+                    else (int(np.prod(output_size)) if output_size else 0)
+                ),
+            }
+            for r in records
+        ]
+    ).groupby("compute_device"):
+        mean_time = group["total"].mean()
+        voxels = group["voxels"].mean()
+        if mean_time > 0 and voxels:
+            print(
+                f"device {device or '<unknown>'}: "
+                f"{voxels / mean_time / 1e6:.2f} Mvoxel/s "
+                f"({len(group)} tasks)"
+            )

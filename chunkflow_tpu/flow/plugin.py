@@ -1,0 +1,118 @@
+"""User plugin loading: any python file exposing ``execute(*chunks, **kw)``.
+
+Parity target: reference flow/plugin.py — search order is the working
+directory, the bundled plugins package, then ``$CHUNKFLOW_PLUGIN_DIR``;
+ndarray outputs are wrapped back into Chunks, fixing up the voxel offset
+when the plugin shrank the array symmetrically (e.g. valid-mode filtering).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.cartesian import Cartesian
+
+
+def find_plugin(name: str) -> str:
+    """Resolve a plugin name/path to a python file."""
+    if not name.endswith(".py"):
+        name = name + ".py"
+    candidates = [
+        name,
+        os.path.join(os.path.dirname(__file__), "..", "plugins", name),
+    ]
+    env_dir = os.environ.get("CHUNKFLOW_PLUGIN_DIR")
+    if env_dir:
+        candidates.append(os.path.join(env_dir, name))
+    for path in candidates:
+        if os.path.isfile(path):
+            return os.path.abspath(path)
+    raise FileNotFoundError(
+        f"plugin {name!r} not found in ./, bundled plugins, or "
+        f"$CHUNKFLOW_PLUGIN_DIR"
+    )
+
+
+def load_plugin(name: str):
+    path = find_plugin(name)
+    spec = importlib.util.spec_from_file_location(
+        f"chunkflow_plugin_{os.path.basename(path)[:-3]}", path
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    if not hasattr(module, "execute"):
+        raise AttributeError(f"plugin {path} has no execute() function")
+    return module.execute
+
+
+def str_to_dict(args: Optional[str]) -> dict:
+    """Parse the plugin arg mini-language ``k=3;k2=(1,2);k3=abc``."""
+    if not args:
+        return {}
+    out = {}
+    for item in args.split(";"):
+        if not item.strip():
+            continue
+        key, _, value = item.partition("=")
+        out[key.strip()] = _simplest_type(value.strip())
+    return out
+
+
+def _simplest_type(text: str):
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    if text.lower() in ("true", "false"):
+        return text.lower() == "true"
+    if text.startswith("(") and text.endswith(")"):
+        inner = text[1:-1].strip().rstrip(",")
+        if not inner:
+            return ()
+        return tuple(_simplest_type(t.strip()) for t in inner.split(","))
+    if text.startswith("[") and text.endswith("]"):
+        inner = text[1:-1].strip().rstrip(",")
+        if not inner:
+            return []
+        return [_simplest_type(t.strip()) for t in inner.split(",")]
+    return text
+
+
+def wrap_outputs(outputs, inputs: Sequence) -> List:
+    """Wrap plugin ndarray outputs as Chunks, inheriting metadata.
+
+    If the output's spatial shape shrank symmetrically vs the first input
+    chunk, the voxel offset shifts by the half-difference (the reference's
+    symmetric-crop fixup, plugin.py:19-26).
+    """
+    if outputs is None:
+        return []
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    template = next((i for i in inputs if isinstance(i, Chunk)), None)
+    wrapped = []
+    for out in outputs:
+        if isinstance(out, Chunk) or not isinstance(out, np.ndarray):
+            wrapped.append(out)
+            continue
+        if template is None or out.ndim not in (3, 4):
+            wrapped.append(out)
+            continue
+        in_shape = Cartesian.from_collection(template.shape[-3:])
+        out_shape = Cartesian.from_collection(out.shape[-3:])
+        shrink = in_shape - out_shape
+        offset = template.voxel_offset
+        if shrink != Cartesian.zeros() and shrink % 2 == Cartesian.zeros():
+            offset = offset + shrink // 2
+        wrapped.append(
+            Chunk(out, voxel_offset=offset, voxel_size=template.voxel_size)
+        )
+    return wrapped

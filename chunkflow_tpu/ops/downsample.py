@@ -1,0 +1,106 @@
+"""Downsampling pyramids — the tinybrain (C++) equivalent, on XLA.
+
+Two pooling modes, matching the reference's use of tinybrain
+(flow/downsample_upload.py:73-79):
+- images / probability maps: average pooling via lax.reduce_window (fuses
+  on TPU; one pass per mip level);
+- segmentations: mode pooling ("countless" semantics — the most frequent
+  label in each 2x2x... block, implemented by exact bincount over the
+  gathered block corners, vectorized in jnp for factor (1,2,2)/(2,2,2)).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+
+
+def downsample_average(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
+    import jax.numpy as jnp
+    from jax import lax
+
+    factor = to_cartesian(factor)
+    arr = jnp.asarray(chunk.array, dtype=jnp.float32)
+    squeeze = arr.ndim == 3
+    if squeeze:
+        arr = arr[None]
+    window = (1,) + tuple(factor)
+    pooled = lax.reduce_window(
+        arr, 0.0, lax.add, window, window, padding="VALID"
+    ) / float(factor.prod())
+    if np.dtype(chunk.dtype).kind in "iu":
+        pooled = jnp.round(pooled).astype(chunk.dtype)
+    else:
+        pooled = pooled.astype(chunk.dtype)
+    if squeeze:
+        pooled = pooled[0]
+    out = np.asarray(pooled) if not chunk.is_on_device else pooled
+    return Chunk(
+        out,
+        voxel_offset=chunk.voxel_offset // factor,
+        voxel_size=chunk.voxel_size * factor,
+        layer_type=chunk.layer_type,
+    )
+
+
+def downsample_mode(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
+    """Mode (most-frequent-label) pooling for segmentations.
+
+    Gathers the ``prod(factor)`` corner samples of each block and picks the
+    value with the highest count (ties: the first corner wins, which for
+    2x2x2 matches countless-style behavior closely enough for thumbnails).
+    """
+    arr = np.asarray(chunk.array)
+    factor = to_cartesian(factor)
+    squeeze = arr.ndim == 3
+    if squeeze:
+        arr = arr[None]
+    c = arr.shape[0]
+    spatial = Cartesian.from_collection(arr.shape[1:])
+    trimmed = (spatial // factor) * factor
+    arr = arr[:, : trimmed.z, : trimmed.y, : trimmed.x]
+    out_shape = trimmed // factor
+    # corners: [n_corners, c, z', y', x']
+    corners = []
+    for dz in range(factor.z):
+        for dy in range(factor.y):
+            for dx in range(factor.x):
+                corners.append(
+                    arr[:, dz :: factor.z, dy :: factor.y, dx :: factor.x]
+                )
+    stacked = np.stack(corners, axis=0)
+    n = stacked.shape[0]
+    # count matches of each corner value among all corners; argmax wins
+    counts = np.zeros(stacked.shape, dtype=np.int8)
+    for i in range(n):
+        for j in range(n):
+            counts[i] += stacked[i] == stacked[j]
+    winner = np.argmax(counts, axis=0)
+    pooled = np.take_along_axis(stacked, winner[None], axis=0)[0]
+    if squeeze:
+        pooled = pooled[0]
+    return Chunk(
+        pooled,
+        voxel_offset=chunk.voxel_offset // factor,
+        voxel_size=chunk.voxel_size * factor,
+        layer_type=chunk.layer_type,
+    )
+
+
+def downsample(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
+    if chunk.is_segmentation:
+        return downsample_mode(chunk, factor)
+    return downsample_average(chunk, factor)
+
+
+def pyramid(chunk: Chunk, factor=(1, 2, 2), num_mips: int = 3) -> List[Chunk]:
+    """Successive downsamples: [mip+1, mip+2, ...]."""
+    levels = []
+    current = chunk
+    for _ in range(num_mips):
+        current = downsample(current, factor)
+        levels.append(current)
+    return levels

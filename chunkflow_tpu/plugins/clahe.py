@@ -1,0 +1,15 @@
+"""Per-section CLAHE contrast enhancement (reference plugins/clahe.py)."""
+import numpy as np
+
+
+def execute(chunk, clip_limit: float = 2.0, tile_size: int = 8):
+    import cv2
+
+    arr = np.asarray(chunk.array)
+    if arr.dtype != np.uint8:
+        raise ValueError("CLAHE needs a uint8 image chunk")
+    clahe = cv2.createCLAHE(
+        clipLimit=clip_limit, tileGridSize=(tile_size, tile_size)
+    )
+    out = np.stack([clahe.apply(section) for section in arr], axis=0)
+    return out

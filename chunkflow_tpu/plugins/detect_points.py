@@ -1,0 +1,12 @@
+"""Peak detection plugin: probability map -> point list
+(reference plugins/detect_points.py)."""
+from chunkflow_tpu.chunk import ProbabilityMap
+
+
+def execute(chunk, min_distance: int = 15, threshold_rel: float = 0.3):
+    pm = ProbabilityMap.from_chunk(chunk)
+    points, confidences = pm.detect_points(
+        min_distance=min_distance, threshold_rel=threshold_rel
+    )
+    print(f"detected {points.shape[0]} points")
+    return points

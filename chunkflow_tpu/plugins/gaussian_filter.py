@@ -1,0 +1,5 @@
+"""2D gaussian blur plugin (reference plugins/gaussian_filter.py)."""
+
+
+def execute(chunk, sigma: float = 1.0):
+    return chunk.gaussian_filter_2d(sigma=sigma)

@@ -1,0 +1,9 @@
+"""Intensity inversion plugin (reference plugins/inverse.py)."""
+import numpy as np
+
+
+def execute(chunk):
+    arr = np.asarray(chunk.array)
+    if np.dtype(arr.dtype).kind in "iu":
+        return (np.iinfo(arr.dtype).max - arr).astype(arr.dtype)
+    return (arr.max() - arr).astype(arr.dtype)

@@ -1,0 +1,12 @@
+"""Map intensity range to [0, 1] float32 (reference plugins/mapto01.py)."""
+import numpy as np
+
+
+def execute(chunk):
+    arr = np.asarray(chunk.array).astype(np.float32)
+    lo, hi = float(arr.min()), float(arr.max())
+    if hi > lo:
+        arr = (arr - lo) / (hi - lo)
+    else:
+        arr = np.zeros_like(arr)
+    return arr

@@ -1,0 +1,9 @@
+"""Per-section median filter plugin (reference plugins/median_filter.py)."""
+import numpy as np
+from scipy import ndimage
+
+
+def execute(chunk, size: int = 3, mode: str = "reflect"):
+    arr = np.asarray(chunk.array)
+    kernel = (1, size, size) if arr.ndim == 3 else (1, 1, size, size)
+    return ndimage.median_filter(arr, size=kernel, mode=mode)

@@ -1,0 +1,6 @@
+"""Print the maximum segment id (reference plugins/print_max_id.py)."""
+import numpy as np
+
+
+def execute(chunk):
+    print(f"max id: {int(np.asarray(chunk.array).max())}")

@@ -1,0 +1,11 @@
+"""Print chunk statistics plugin (reference plugins/statistics.py)."""
+import numpy as np
+
+
+def execute(chunk):
+    arr = np.asarray(chunk.array)
+    print(
+        f"chunk {chunk.bbox.string}: dtype={arr.dtype} "
+        f"min={arr.min()} max={arr.max()} mean={arr.mean():.4f} "
+        f"nonzero={np.count_nonzero(arr)}/{arr.size}"
+    )

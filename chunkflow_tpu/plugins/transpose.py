@@ -1,0 +1,5 @@
+"""Spatial axis reversal plugin (reference plugins/transpose.py)."""
+
+
+def execute(chunk):
+    return chunk.transpose()

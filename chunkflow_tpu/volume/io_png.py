@@ -1,0 +1,59 @@
+"""PNG section stack I/O via Pillow (pyspng equivalent surface).
+
+Parity: reference flow/save_pngs.py (z-section export) and
+flow/load_pngs.py (stack -> chunk with bbox windowing).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import numpy as np
+from PIL import Image as PILImage
+
+from chunkflow_tpu.core.bbox import BoundingBox
+
+
+def save_pngs(chunk, output_path: str, name_prefix: str = "") -> None:
+    os.makedirs(output_path, exist_ok=True)
+    arr = np.asarray(chunk.array)
+    if arr.ndim == 4:
+        if arr.shape[0] != 1:
+            raise ValueError("PNG export needs a single-channel chunk")
+        arr = arr[0]
+    z0 = chunk.voxel_offset.z
+    for i, section in enumerate(arr):
+        PILImage.fromarray(section).save(
+            os.path.join(output_path, f"{name_prefix}{z0 + i:05d}.png")
+        )
+
+
+def load_pngs(
+    path: str,
+    bbox: Optional[BoundingBox] = None,
+    voxel_offset=(0, 0, 0),
+    dtype=None,
+):
+    """Load a directory of z-section PNGs (sorted by the number in the
+    filename) into a chunk, optionally windowed by ``bbox``."""
+    from chunkflow_tpu.chunk.base import Chunk
+
+    def section_index(name: str) -> int:
+        nums = re.findall(r"\d+", name)
+        return int(nums[-1]) if nums else 0
+
+    files = sorted(
+        (f for f in os.listdir(path) if f.lower().endswith(".png")),
+        key=section_index,
+    )
+    if not files:
+        raise FileNotFoundError(f"no .png files in {path}")
+    sections = [np.asarray(PILImage.open(os.path.join(path, f))) for f in files]
+    arr = np.stack(sections, axis=0)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    chunk = Chunk(arr, voxel_offset=voxel_offset)
+    if bbox is not None:
+        chunk = chunk.cutout(bbox)
+    return chunk

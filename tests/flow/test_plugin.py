@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.flow.plugin import (
+    find_plugin,
+    load_plugin,
+    str_to_dict,
+    wrap_outputs,
+)
+
+
+def test_str_to_dict():
+    assert str_to_dict(None) == {}
+    assert str_to_dict("a=3;b=2.5;c=hello") == {"a": 3, "b": 2.5, "c": "hello"}
+    assert str_to_dict("t=(1,2,3)") == {"t": (1, 2, 3)}
+    assert str_to_dict("l=[1,2]") == {"l": [1, 2]}
+    assert str_to_dict("flag=true") == {"flag": True}
+
+
+def test_find_bundled_plugin():
+    path = find_plugin("median_filter")
+    assert path.endswith("median_filter.py")
+    with pytest.raises(FileNotFoundError):
+        find_plugin("no_such_plugin_xyz")
+
+
+def test_load_and_run_bundled_plugins():
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.integers(0, 255, (4, 8, 8)).astype(np.uint8))
+
+    inverse = load_plugin("inverse")
+    out = inverse(chunk)
+    np.testing.assert_array_equal(out, 255 - np.asarray(chunk.array))
+
+    mapto01 = load_plugin("mapto01")
+    out = mapto01(chunk)
+    assert out.dtype == np.float32
+    assert out.max() <= 1.0
+
+    median = load_plugin("median_filter")
+    out = median(chunk, size=3)
+    assert out.shape == chunk.shape
+
+
+def test_custom_plugin_dir(tmp_path, monkeypatch):
+    plugin = tmp_path / "myplug.py"
+    plugin.write_text("def execute(chunk, scale=2):\n    return chunk.array * scale\n")
+    monkeypatch.setenv("CHUNKFLOW_PLUGIN_DIR", str(tmp_path))
+    execute = load_plugin("myplug")
+    chunk = Chunk(np.ones((2, 2, 2), dtype=np.float32))
+    out = execute(chunk, scale=3)
+    assert np.all(out == 3)
+
+
+def test_wrap_outputs_symmetric_crop_fixup():
+    chunk = Chunk(np.ones((8, 8, 8), dtype=np.float32), voxel_offset=(10, 10, 10))
+    shrunk = np.ones((4, 4, 4), dtype=np.float32)
+    wrapped = wrap_outputs(shrunk, [chunk])
+    assert len(wrapped) == 1
+    assert wrapped[0].voxel_offset.tuple == (12, 12, 12)
+
+    same = wrap_outputs(np.ones((8, 8, 8), dtype=np.float32), [chunk])
+    assert same[0].voxel_offset.tuple == (10, 10, 10)
+    # non-array output passes through
+    assert wrap_outputs("hello", [chunk]) == ["hello"]

@@ -1,0 +1,55 @@
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.cartesian import Cartesian
+from chunkflow_tpu.ops.downsample import (
+    downsample,
+    downsample_average,
+    downsample_mode,
+    pyramid,
+)
+
+
+def test_average_downsample_exact():
+    arr = np.arange(16, dtype=np.float32).reshape(1, 4, 4)
+    arr = np.broadcast_to(arr, (2, 4, 4)).copy()
+    chunk = Chunk(arr, voxel_offset=(0, 4, 8), voxel_size=(40, 4, 4))
+    down = downsample_average(chunk, (1, 2, 2))
+    assert down.shape == (2, 2, 2)
+    # block (0:2, 0:2) of row-major arange(16) in 4x4: mean of 0,1,4,5 = 2.5
+    assert float(np.asarray(down.array)[0, 0, 0]) == 2.5
+    assert down.voxel_size == Cartesian(40, 8, 8)
+    assert down.voxel_offset == Cartesian(0, 2, 4)
+
+
+def test_average_downsample_uint8_rounds():
+    chunk = Chunk(np.full((2, 4, 4), 3, dtype=np.uint8))
+    down = downsample_average(chunk, (2, 2, 2))
+    assert down.dtype == np.uint8
+    assert np.all(np.asarray(down.array) == 3)
+
+
+def test_mode_downsample_majority_wins():
+    arr = np.zeros((2, 4, 4), dtype=np.uint32)
+    arr[:, :2, :2] = 7  # 8 voxels of id 7 in first block
+    arr[0, 0, 0] = 3    # minority
+    seg = Chunk(arr)
+    down = downsample_mode(seg, (2, 2, 2))
+    assert down.shape == (1, 2, 2)
+    assert np.asarray(down.array)[0, 0, 0] == 7
+    assert np.asarray(down.array)[0, 1, 1] == 0
+
+
+def test_downsample_dispatches_by_layer():
+    seg = Chunk(np.ones((2, 2, 2), dtype=np.uint32))
+    img = Chunk(np.ones((2, 2, 2), dtype=np.uint8))
+    assert downsample(seg, (2, 2, 2)).dtype == np.uint32
+    assert downsample(img, (2, 2, 2)).dtype == np.uint8
+
+
+def test_pyramid_levels():
+    chunk = Chunk(np.ones((8, 16, 16), dtype=np.uint8))
+    levels = pyramid(chunk, (1, 2, 2), num_mips=3)
+    assert [tuple(l.shape) for l in levels] == [
+        (8, 8, 8), (8, 4, 4), (8, 2, 2)
+    ]
