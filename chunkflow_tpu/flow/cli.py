@@ -847,6 +847,51 @@ def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
     return stage(_name="load-png")
 
 
+@main.command("mesh")
+@click.option("--output-path", "-o", type=str, required=True)
+@click.option("--output-format", "-t", type=click.Choice(["precomputed", "obj", "ply"]), default="precomputed")
+@click.option("--ids", type=str, default=None, help="comma-separated object ids (default: all)")
+@click.option("--skip-ids", type=str, default=None)
+@click.option("--manifest/--no-manifest", default=False)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def mesh_cmd(output_path, output_format, ids, skip_ids, manifest, input_chunk_name):
+    """Mesh every object of a segmentation chunk (surface nets)."""
+    from chunkflow_tpu.flow.mesh import MeshOperator
+
+    op = MeshOperator(
+        output_path,
+        output_format=output_format,
+        ids=[int(x) for x in ids.split(",")] if ids else None,
+        skip_ids=tuple(int(x) for x in skip_ids.split(",")) if skip_ids else (),
+        manifest=manifest,
+    )
+
+    @operator
+    def stage(task):
+        count = op(task[input_chunk_name])
+        if state.verbose:
+            print(f"meshed {count} objects")
+        return task
+
+    return stage(_name="mesh")
+
+
+@main.command("mesh-manifest")
+@click.option("--mesh-dir", "-d", type=str, required=True)
+def mesh_manifest_cmd(mesh_dir):
+    """Aggregate per-chunk mesh fragments into object manifests."""
+    from chunkflow_tpu.flow.mesh import write_manifests
+
+    @generator
+    def stage(task):
+        count = write_manifests(mesh_dir)
+        print(f"wrote {count} mesh manifests")
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
 @main.command("evaluate-segmentation")
 @click.option("--segmentation-chunk-name", "-s", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--groundtruth-chunk-name", "-g", type=str, required=True)

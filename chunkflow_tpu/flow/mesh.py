@@ -1,0 +1,141 @@
+"""Meshing operator: segmentation chunk -> per-object mesh files.
+
+Parity target: reference flow/mesh.py (zmesh marching cubes -> simplified
+meshes -> obj/ply/precomputed) and flow/mesh_manifest.py (manifest
+aggregation). The mesher is the native surface-nets kernel; vertices are
+scaled to nanometers and offset into global coordinates (reference
+mesh.py:95), then written as:
+
+- ``precomputed``: legacy single-resolution fragment format — uint32
+  num_vertices, float32 xyz * n (nm), uint32 triangle indices — named
+  ``<obj_id>:0:<bbox>`` next to a ``<obj_id>:0`` manifest;
+- ``obj`` / ``ply``: one text file per object.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk
+
+
+def mesh_chunk(
+    seg: Chunk,
+    ids=None,
+    skip_ids=(),
+) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+    """Mesh every (selected) object: id -> (vertices_nm_xyz, faces)."""
+    from chunkflow_tpu import native
+
+    arr = np.asarray(seg.array)
+    if arr.ndim == 4:
+        arr = arr[0]
+    if ids is None:
+        ids = [int(i) for i in np.unique(arr) if i != 0]
+    voxel_size_xyz = np.asarray(tuple(reversed(seg.voxel_size)), dtype=np.float32)
+    offset_xyz = np.asarray(tuple(reversed(seg.voxel_offset)), dtype=np.float32)
+    meshes = {}
+    for obj_id in ids:
+        if obj_id in skip_ids:
+            continue
+        vertices, faces = native.mesh_object(arr, obj_id)
+        if vertices.shape[0] == 0:
+            continue
+        vertices = (vertices + offset_xyz) * voxel_size_xyz  # global nm
+        meshes[int(obj_id)] = (vertices, faces)
+    return meshes
+
+
+# ---------------------------------------------------------------------------
+# writers
+# ---------------------------------------------------------------------------
+def to_precomputed_bytes(vertices: np.ndarray, faces: np.ndarray) -> bytes:
+    header = struct.pack("<I", vertices.shape[0])
+    return (
+        header
+        + vertices.astype("<f4").tobytes()
+        + faces.astype("<u4").tobytes()
+    )
+
+
+def to_obj(vertices: np.ndarray, faces: np.ndarray) -> str:
+    lines = [f"v {v[0]} {v[1]} {v[2]}" for v in vertices]
+    lines += [f"f {f[0]+1} {f[1]+1} {f[2]+1}" for f in faces]
+    return "\n".join(lines) + "\n"
+
+
+def to_ply(vertices: np.ndarray, faces: np.ndarray) -> str:
+    header = (
+        "ply\nformat ascii 1.0\n"
+        f"element vertex {vertices.shape[0]}\n"
+        "property float x\nproperty float y\nproperty float z\n"
+        f"element face {faces.shape[0]}\n"
+        "property list uchar int vertex_index\nend_header\n"
+    )
+    body = "\n".join(f"{v[0]} {v[1]} {v[2]}" for v in vertices)
+    body += "\n" + "\n".join(f"3 {f[0]} {f[1]} {f[2]}" for f in faces)
+    return header + body + "\n"
+
+
+class MeshOperator:
+    def __init__(
+        self,
+        output_path: str,
+        output_format: str = "precomputed",
+        ids=None,
+        skip_ids=(),
+        manifest: bool = False,
+    ):
+        if output_format not in ("precomputed", "obj", "ply"):
+            raise ValueError(f"unknown mesh format {output_format!r}")
+        self.output_path = output_path
+        self.output_format = output_format
+        self.ids = ids
+        self.skip_ids = tuple(skip_ids)
+        self.manifest = manifest
+        os.makedirs(output_path, exist_ok=True)
+
+    def __call__(self, seg: Chunk) -> int:
+        meshes = mesh_chunk(seg, ids=self.ids, skip_ids=self.skip_ids)
+        bbox_str = seg.bbox.string
+        for obj_id, (vertices, faces) in meshes.items():
+            if self.output_format == "precomputed":
+                frag = f"{obj_id}:0:{bbox_str}"
+                with open(os.path.join(self.output_path, frag), "wb") as f:
+                    f.write(to_precomputed_bytes(vertices, faces))
+                if self.manifest:
+                    with open(
+                        os.path.join(self.output_path, f"{obj_id}:0"), "w"
+                    ) as f:
+                        json.dump({"fragments": [frag]}, f)
+            elif self.output_format == "obj":
+                path = os.path.join(self.output_path, f"{obj_id}_{bbox_str}.obj")
+                with open(path, "w") as f:
+                    f.write(to_obj(vertices, faces))
+            else:
+                path = os.path.join(self.output_path, f"{obj_id}_{bbox_str}.ply")
+                with open(path, "w") as f:
+                    f.write(to_ply(vertices, faces))
+        return len(meshes)
+
+
+def write_manifests(mesh_dir: str) -> int:
+    """Aggregate per-chunk fragments into ``{obj_id}:0`` manifests.
+
+    Parity: reference flow/mesh_manifest.py — after all mesh tasks finish,
+    list fragment files ``<id>:0:<bbox>`` and write one manifest per id
+    referencing all its fragments.
+    """
+    fragments: Dict[str, list] = {}
+    for name in os.listdir(mesh_dir):
+        parts = name.split(":")
+        if len(parts) == 3 and parts[1] == "0":
+            fragments.setdefault(parts[0], []).append(name)
+    for obj_id, frags in fragments.items():
+        with open(os.path.join(mesh_dir, f"{obj_id}:0"), "w") as f:
+            json.dump({"fragments": sorted(frags)}, f)
+    return len(fragments)

@@ -1,0 +1,193 @@
+// Affinity-graph watershed + hierarchical mean-affinity agglomeration.
+// Native equivalent of the waterz wheel used by the reference's
+// agglomerate plugin (chunkflow/plugins/agglomerate.py:35-43): turn a
+// 3-channel zyx affinity map into a segmentation. Priority-queue region
+// merging is inherently sequential — host-side by design (SURVEY §2.9).
+//
+// Algorithm:
+//  1. seeds: connected components of the graph restricted to edges with
+//     affinity >= t_high (strongly-connected cores);
+//  2. grow: process remaining edges in descending affinity order
+//     (bucket-sorted); an edge with exactly one labeled endpoint extends
+//     that region; edges below t_low never grow (those voxels stay 0);
+//  3. agglomerate: region adjacency graph scored by mean affinity of
+//     boundary edges; greedily merge pairs whose score >= merge_threshold.
+//     Scores are computed once on the initial watershed boundaries
+//     (single-shot agglomeration); incremental boundary rescoring after
+//     each merge is a planned refinement.
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <queue>
+#include <utility>
+#include <vector>
+
+namespace {
+
+struct UnionFind {
+  std::vector<uint32_t> parent;
+  explicit UnionFind(size_t n) : parent(n) {
+    for (size_t i = 0; i < n; ++i) parent[i] = static_cast<uint32_t>(i);
+  }
+  uint32_t find(uint32_t x) {
+    while (parent[x] != x) {
+      parent[x] = parent[parent[x]];
+      x = parent[x];
+    }
+    return x;
+  }
+  bool unite(uint32_t a, uint32_t b) {
+    a = find(a);
+    b = find(b);
+    if (a == b) return false;
+    if (b < a) std::swap(a, b);
+    parent[b] = a;
+    return true;
+  }
+};
+
+struct Edge {
+  float aff;
+  int64_t u, v;
+};
+
+// affinity channel c at voxel (z,y,x) connects it to the voxel one step
+// NEGATIVE along axis c (the common zyx affinity convention)
+inline int64_t flat(int64_t z, int64_t y, int64_t x, int64_t sy, int64_t sx) {
+  return (z * sy + y) * sx + x;
+}
+
+void collect_edges(const float* aff, int64_t sz, int64_t sy, int64_t sx,
+                   std::vector<Edge>& edges) {
+  const int64_t n = sz * sy * sx;
+  edges.reserve(3 * n);
+  for (int64_t z = 0; z < sz; ++z)
+    for (int64_t y = 0; y < sy; ++y)
+      for (int64_t x = 0; x < sx; ++x) {
+        const int64_t i = flat(z, y, x, sy, sx);
+        if (z > 0) edges.push_back({aff[i], i, flat(z - 1, y, x, sy, sx)});
+        if (y > 0) edges.push_back({aff[n + i], i, flat(z, y - 1, x, sy, sx)});
+        if (x > 0)
+          edges.push_back({aff[2 * n + i], i, flat(z, y, x - 1, sy, sx)});
+      }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out must hold sz*sy*sx uint32. Returns number of segments.
+uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
+                               int64_t sy, int64_t sx, float t_high,
+                               float t_low, float merge_threshold) {
+  const int64_t n = sz * sy * sx;
+  std::vector<Edge> edges;
+  collect_edges(aff, sz, sy, sx, edges);
+
+  // ---- 1: seeds = components of the >= t_high subgraph ----
+  UnionFind uf(n);
+  std::vector<uint8_t> active(n, 0);  // voxel belongs to some region
+  for (const Edge& e : edges) {
+    if (e.aff >= t_high) {
+      uf.unite(static_cast<uint32_t>(e.u), static_cast<uint32_t>(e.v));
+      active[e.u] = active[e.v] = 1;
+    }
+  }
+
+  // ---- 2: priority-flood growth (Prim-style): repeatedly attach the
+  // unlabeled voxel with the highest-affinity edge to any region ----
+  {
+    using QItem = std::pair<float, std::pair<int64_t, int64_t>>;
+    std::priority_queue<QItem> pq;
+    auto push_frontier = [&](int64_t labeled) {
+      const int64_t x = labeled % sx;
+      const int64_t y = (labeled / sx) % sy;
+      const int64_t z = labeled / (sx * sy);
+      // negative-direction edges stored at this voxel
+      if (z > 0 && !active[labeled - sy * sx])
+        pq.push({aff[labeled], {labeled, labeled - sy * sx}});
+      if (y > 0 && !active[labeled - sx])
+        pq.push({aff[n + labeled], {labeled, labeled - sx}});
+      if (x > 0 && !active[labeled - 1])
+        pq.push({aff[2 * n + labeled], {labeled, labeled - 1}});
+      // positive-direction edges stored at the neighbor
+      if (z + 1 < sz && !active[labeled + sy * sx])
+        pq.push({aff[labeled + sy * sx], {labeled, labeled + sy * sx}});
+      if (y + 1 < sy && !active[labeled + sx])
+        pq.push({aff[n + labeled + sx], {labeled, labeled + sx}});
+      if (x + 1 < sx && !active[labeled + 1])
+        pq.push({aff[2 * n + labeled + 1], {labeled, labeled + 1}});
+    };
+    for (int64_t i = 0; i < n; ++i)
+      if (active[i]) push_frontier(i);
+    while (!pq.empty()) {
+      const auto [a, pair] = pq.top();
+      pq.pop();
+      if (a < t_low) break;  // descending queue: nothing above t_low left
+      const auto [u, v] = pair;
+      if (active[v]) continue;  // already claimed by a stronger edge
+      uf.unite(static_cast<uint32_t>(u), static_cast<uint32_t>(v));
+      active[v] = 1;
+      push_frontier(v);
+    }
+  }
+
+  // compact region ids
+  std::vector<uint32_t> ids(n, 0);
+  uint32_t nseg = 0;
+  {
+    std::vector<uint32_t> remap(n, 0);
+    for (int64_t i = 0; i < n; ++i) {
+      if (!active[i]) continue;
+      const uint32_t root = uf.find(static_cast<uint32_t>(i));
+      if (remap[root] == 0) remap[root] = ++nseg;
+      ids[i] = remap[root];
+    }
+  }
+
+  // ---- 3: mean-affinity agglomeration on the region graph ----
+  if (merge_threshold > 0.0f && nseg > 1) {
+    // accumulate boundary statistics between regions
+    std::map<std::pair<uint32_t, uint32_t>, std::pair<double, int64_t>> bnd;
+    for (const Edge& e : edges) {
+      uint32_t a = ids[e.u], b = ids[e.v];
+      if (a == 0 || b == 0 || a == b) continue;
+      if (b < a) std::swap(a, b);
+      auto& s = bnd[{a, b}];
+      s.first += e.aff;
+      s.second += 1;
+    }
+    UnionFind ruf(nseg + 1);
+    using QItem = std::pair<float, std::pair<uint32_t, uint32_t>>;
+    std::priority_queue<QItem> queue;
+    for (const auto& kv : bnd) {
+      const float score =
+          static_cast<float>(kv.second.first / kv.second.second);
+      queue.push({score, kv.first});
+    }
+    while (!queue.empty()) {
+      const auto [score, pair] = queue.top();
+      queue.pop();
+      if (score < merge_threshold) break;
+      const uint32_t ra = ruf.find(pair.first), rb = ruf.find(pair.second);
+      if (ra == rb) continue;
+      ruf.unite(ra, rb);
+      // lazy: stale queue entries resolve to already-merged roots and skip
+    }
+    std::vector<uint32_t> remap(nseg + 1, 0);
+    uint32_t finalc = 0;
+    for (uint32_t s = 1; s <= nseg; ++s) {
+      const uint32_t root = ruf.find(s);
+      if (remap[root] == 0) remap[root] = ++finalc;
+      remap[s] = remap[root];
+    }
+    for (int64_t i = 0; i < n; ++i) out[i] = remap[ids[i]];
+    return finalc;
+  }
+
+  std::memcpy(out, ids.data(), n * sizeof(uint32_t));
+  return nseg;
+}
+
+}  // extern "C"

@@ -24,13 +24,34 @@ def _structure(connectivity: int) -> np.ndarray:
     raise ValueError(f"connectivity must be 6, 18 or 26, got {connectivity}")
 
 
+def _native():
+    try:
+        from chunkflow_tpu import native
+
+        if native.available():
+            return native
+    except Exception:
+        pass
+    return None
+
+
 def label_binary(binary: np.ndarray, connectivity: int = 26) -> np.ndarray:
+    native = _native()
+    if native is not None:
+        labels, _ = native.connected_components(
+            binary.astype(np.uint8), connectivity
+        )
+        return labels
     labels, _ = ndimage.label(binary, structure=_structure(connectivity))
     return labels.astype(np.uint32)
 
 
 def label_multivalue(arr: np.ndarray, connectivity: int = 26) -> np.ndarray:
     """Label each distinct-value region separately (cc3d semantics)."""
+    native = _native()
+    if native is not None:
+        labels, _ = native.connected_components(arr, connectivity)
+        return labels
     out = np.zeros(arr.shape, dtype=np.uint32)
     next_id = 0
     structure = _structure(connectivity)

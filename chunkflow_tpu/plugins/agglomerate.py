@@ -1,0 +1,27 @@
+"""Affinity -> segmentation via native watershed + mean-affinity
+agglomeration (reference plugins/agglomerate.py, waterz equivalent)."""
+import numpy as np
+
+from chunkflow_tpu import native
+from chunkflow_tpu.chunk import Segmentation
+
+
+def execute(
+    affs,
+    threshold: float = 0.7,
+    aff_threshold_low: float = 0.0001,
+    aff_threshold_high: float = 0.9999,
+):
+    arr = np.asarray(affs.array, dtype=np.float32)
+    if arr.ndim != 4 or arr.shape[0] != 3:
+        raise ValueError(f"need [3, z, y, x] affinity chunk, got {arr.shape}")
+    seg, count = native.watershed_agglomerate(
+        arr,
+        t_high=aff_threshold_high,
+        t_low=aff_threshold_low,
+        merge_threshold=threshold,
+    )
+    print(f"agglomerate: {count} segments")
+    return Segmentation(
+        seg, voxel_offset=affs.voxel_offset, voxel_size=affs.voxel_size
+    )
