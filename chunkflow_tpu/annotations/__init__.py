@@ -1,0 +1,4 @@
+from chunkflow_tpu.annotations.synapses import Synapses
+from chunkflow_tpu.annotations.point_cloud import PointCloud
+
+__all__ = ["Synapses", "PointCloud"]

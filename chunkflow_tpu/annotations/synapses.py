@@ -1,0 +1,289 @@
+"""Synapse annotations: T-bars (pre) and their post-synaptic partners.
+
+Parity target: reference synapses.py (:19-794) — pre is an [N, 3] int32
+zyx array, post is [M, 4] int32 (pre_index, z, y, x), with optional
+confidences and user attributions; JSON/HDF5 round trips; KDTree distance
+queries (pre->post distances, redundant-post detection, per-neuron
+duplicate detection against a segmentation); bbox cropping with pre-index
+remapping.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+
+
+class Synapses:
+    def __init__(
+        self,
+        pre: np.ndarray,
+        post: Optional[np.ndarray] = None,
+        pre_confidence: Optional[np.ndarray] = None,
+        post_confidence: Optional[np.ndarray] = None,
+        resolution=(1, 1, 1),
+        users: Optional[List[str]] = None,
+    ):
+        pre = np.asarray(pre, dtype=np.int32)
+        if pre.ndim != 2 or pre.shape[1] != 3:
+            raise ValueError(f"pre must be [N, 3] zyx, got {pre.shape}")
+        if post is not None:
+            post = np.asarray(post, dtype=np.int32)
+            if post.ndim != 2 or post.shape[1] != 4:
+                raise ValueError(f"post must be [M, 4] (pre_idx, z, y, x)")
+            if post.size and (
+                post[:, 0].min() < 0 or post[:, 0].max() >= pre.shape[0]
+            ):
+                raise ValueError("post pre_index out of range")
+        if pre_confidence is not None:
+            pre_confidence = np.asarray(pre_confidence, dtype=np.float32)
+            assert pre_confidence.shape[0] == pre.shape[0]
+        self.pre = pre
+        self.post = post
+        self.pre_confidence = pre_confidence
+        self.post_confidence = (
+            np.asarray(post_confidence, dtype=np.float32)
+            if post_confidence is not None
+            else None
+        )
+        self.resolution = to_cartesian(resolution)
+        self.users = users
+
+    # ---- basic properties ---------------------------------------------
+    @property
+    def pre_num(self) -> int:
+        return self.pre.shape[0]
+
+    @property
+    def post_num(self) -> int:
+        return 0 if self.post is None else self.post.shape[0]
+
+    def __len__(self) -> int:
+        return self.pre_num
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Synapses):
+            return NotImplemented
+        same_pre = np.array_equal(self.pre, other.pre)
+        same_post = (
+            (self.post is None) == (other.post is None)
+        ) and (self.post is None or np.array_equal(self.post, other.post))
+        return same_pre and same_post
+
+    @property
+    def pre_bbox(self) -> BoundingBox:
+        start = Cartesian(*self.pre.min(axis=0).tolist())
+        stop = Cartesian(*(self.pre.max(axis=0) + 1).tolist())
+        return BoundingBox(start, stop)
+
+    @property
+    def post_positions(self) -> np.ndarray:
+        return self.post[:, 1:] if self.post is not None else np.zeros((0, 3))
+
+    def post_indices_of_pre(self, pre_index: int) -> np.ndarray:
+        if self.post is None:
+            return np.zeros((0,), dtype=np.int64)
+        return np.nonzero(self.post[:, 0] == pre_index)[0]
+
+    @property
+    def pre_with_post_num(self) -> int:
+        if self.post is None:
+            return 0
+        return np.unique(self.post[:, 0]).size
+
+    # ---- queries (KDTree) ---------------------------------------------
+    def distances_from_pre_to_post(self) -> np.ndarray:
+        """Physical distance of each post partner to its own T-bar."""
+        if self.post is None or self.post_num == 0:
+            return np.zeros((0,), dtype=np.float32)
+        res = self.resolution.vec
+        pre_pos = self.pre[self.post[:, 0]] * res
+        post_pos = self.post[:, 1:] * res
+        return np.linalg.norm(post_pos - pre_pos, axis=1)
+
+    def find_redundant_post(self, distance_threshold: float) -> np.ndarray:
+        """Indices of posts closer than threshold to an earlier post of the
+        SAME T-bar (duplicate annotations; reference find_redundent_post)."""
+        from scipy.spatial import KDTree
+
+        if self.post is None or self.post_num == 0:
+            return np.zeros((0,), dtype=np.int64)
+        redundant = []
+        res = self.resolution.vec
+        for pre_index in np.unique(self.post[:, 0]):
+            indices = np.nonzero(self.post[:, 0] == pre_index)[0]
+            if indices.size < 2:
+                continue
+            positions = self.post[indices, 1:] * res
+            tree = KDTree(positions)
+            pairs = tree.query_pairs(distance_threshold)
+            for a, b in pairs:
+                redundant.append(indices[max(a, b)])
+        return np.unique(np.asarray(redundant, dtype=np.int64))
+
+    def find_duplicate_post_on_same_neuron(self, seg) -> np.ndarray:
+        """Posts of one T-bar landing on the same segment id (reference
+        per-neuron duplicate detection against a Segmentation)."""
+        if self.post is None or self.post_num == 0:
+            return np.zeros((0,), dtype=np.int64)
+        arr = np.asarray(seg.array)
+        offset = seg.voxel_offset.vec
+        duplicates = []
+        for pre_index in np.unique(self.post[:, 0]):
+            indices = np.nonzero(self.post[:, 0] == pre_index)[0]
+            if indices.size < 2:
+                continue
+            coords = self.post[indices, 1:] - offset
+            valid = np.all(
+                (coords >= 0) & (coords < np.asarray(arr.shape)), axis=1
+            )
+            seen: Dict[int, int] = {}
+            for local_i, ok in zip(indices[valid], coords[valid]):
+                seg_id = int(arr[tuple(ok)])
+                if seg_id == 0:
+                    continue
+                if seg_id in seen:
+                    duplicates.append(local_i)
+                else:
+                    seen[seg_id] = local_i
+        return np.asarray(sorted(set(duplicates)), dtype=np.int64)
+
+    # ---- editing -------------------------------------------------------
+    def filter_by_bbox(self, bbox: BoundingBox) -> "Synapses":
+        """Keep T-bars inside bbox (and their posts), remapping pre indices."""
+        keep = np.all(
+            (self.pre >= np.asarray(bbox.start))
+            & (self.pre < np.asarray(bbox.stop)),
+            axis=1,
+        )
+        new_index = np.full(self.pre_num, -1, dtype=np.int64)
+        new_index[keep] = np.arange(int(keep.sum()))
+        post = None
+        post_conf = None
+        if self.post is not None:
+            post_keep = keep[self.post[:, 0]]
+            post = self.post[post_keep].copy()
+            post[:, 0] = new_index[post[:, 0]]
+            if self.post_confidence is not None:
+                post_conf = self.post_confidence[post_keep]
+        return Synapses(
+            self.pre[keep],
+            post=post,
+            pre_confidence=(
+                self.pre_confidence[keep]
+                if self.pre_confidence is not None
+                else None
+            ),
+            post_confidence=post_conf,
+            resolution=self.resolution,
+        )
+
+    def remove_pre_without_post(self) -> "Synapses":
+        if self.post is None:
+            return self
+        has_post = np.zeros(self.pre_num, dtype=bool)
+        has_post[np.unique(self.post[:, 0])] = True
+        new_index = np.full(self.pre_num, -1, dtype=np.int64)
+        new_index[has_post] = np.arange(int(has_post.sum()))
+        post = self.post.copy()
+        post[:, 0] = new_index[post[:, 0]]
+        return Synapses(
+            self.pre[has_post],
+            post=post,
+            pre_confidence=(
+                self.pre_confidence[has_post]
+                if self.pre_confidence is not None
+                else None
+            ),
+            post_confidence=self.post_confidence,
+            resolution=self.resolution,
+        )
+
+    # ---- I/O -----------------------------------------------------------
+    def to_json(self, path: str) -> str:
+        data = {
+            "resolution": list(self.resolution),
+            "pre": self.pre.tolist(),
+        }
+        if self.post is not None:
+            data["post"] = self.post.tolist()
+        if self.pre_confidence is not None:
+            data["pre_confidence"] = self.pre_confidence.tolist()
+        if self.post_confidence is not None:
+            data["post_confidence"] = self.post_confidence.tolist()
+        if self.users is not None:
+            data["users"] = self.users
+        with open(path, "w") as f:
+            json.dump(data, f)
+        return path
+
+    @classmethod
+    def from_json(cls, path: str) -> "Synapses":
+        with open(path) as f:
+            data = json.load(f)
+        return cls(
+            np.asarray(data["pre"], dtype=np.int32),
+            post=(
+                np.asarray(data["post"], dtype=np.int32)
+                if "post" in data
+                else None
+            ),
+            pre_confidence=data.get("pre_confidence"),
+            post_confidence=data.get("post_confidence"),
+            resolution=tuple(data.get("resolution", (1, 1, 1))),
+            users=data.get("users"),
+        )
+
+    def to_h5(self, path: str) -> str:
+        import h5py
+
+        with h5py.File(path, "w") as f:
+            f.create_dataset("pre", data=self.pre)
+            if self.post is not None:
+                f.create_dataset("post", data=self.post)
+            if self.pre_confidence is not None:
+                f.create_dataset("pre_confidence", data=self.pre_confidence)
+            if self.post_confidence is not None:
+                f.create_dataset("post_confidence", data=self.post_confidence)
+            f.create_dataset("resolution", data=self.resolution.vec)
+        return path
+
+    @classmethod
+    def from_h5(cls, path: str) -> "Synapses":
+        import h5py
+
+        with h5py.File(path, "r") as f:
+            return cls(
+                f["pre"][()],
+                post=f["post"][()] if "post" in f else None,
+                pre_confidence=(
+                    f["pre_confidence"][()] if "pre_confidence" in f else None
+                ),
+                post_confidence=(
+                    f["post_confidence"][()] if "post_confidence" in f else None
+                ),
+                resolution=(
+                    tuple(f["resolution"][()].tolist())
+                    if "resolution" in f
+                    else (1, 1, 1)
+                ),
+            )
+
+    @classmethod
+    def from_file(cls, path: str) -> "Synapses":
+        if path.endswith(".json"):
+            return cls.from_json(path)
+        if path.endswith((".h5", ".hdf5")):
+            return cls.from_h5(path)
+        raise ValueError(f"unsupported synapse file format: {path}")
+
+    def to_file(self, path: str) -> str:
+        if path.endswith(".json"):
+            return self.to_json(path)
+        if path.endswith((".h5", ".hdf5")):
+            return self.to_h5(path)
+        raise ValueError(f"unsupported synapse file format: {path}")

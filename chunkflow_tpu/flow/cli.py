@@ -376,6 +376,223 @@ def log_summary_cmd(log_dir, output_size):
 
 
 # ---------------------------------------------------------------------------
+# annotations / misc I/O
+# ---------------------------------------------------------------------------
+@main.command("load-synapses")
+@click.option("--file-name", "-f", type=str, required=True, help=".json or .h5")
+@click.option("--output-name", "-o", type=str, default="synapses")
+def load_synapses_cmd(file_name, output_name):
+    from chunkflow_tpu.annotations.synapses import Synapses
+
+    @operator
+    def stage(task):
+        synapses = Synapses.from_file(file_name)
+        if task.get("bbox") is not None:
+            synapses = synapses.filter_by_bbox(task["bbox"])
+        task[output_name] = synapses
+        return task
+
+    return stage(_name="load-synapses")
+
+
+@main.command("save-synapses")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-name", "-i", type=str, default="synapses")
+def save_synapses_cmd(file_name, input_name):
+    @operator
+    def stage(task):
+        task[input_name].to_file(file_name)
+        return task
+
+    return stage(_name="save-synapses")
+
+
+@main.command("save-points")
+@click.option("--file-name", "-f", type=str, required=True, help=".h5 or .npy")
+@click.option("--input-name", "-i", type=str, default="points")
+def save_points_cmd(file_name, input_name):
+    from chunkflow_tpu.annotations.point_cloud import PointCloud
+
+    @operator
+    def stage(task):
+        points = task[input_name]
+        if not isinstance(points, PointCloud):
+            points = PointCloud(np.asarray(points))
+        if file_name.endswith(".npy"):
+            points.to_npy(file_name)
+        else:
+            points.to_h5(file_name)
+        return task
+
+    return stage(_name="save-points")
+
+
+@main.command("load-skeleton")
+@click.option("--file-name", "-f", type=str, required=True, help=".swc file")
+@click.option("--output-name", "-o", type=str, default="skeleton")
+def load_skeleton_cmd(file_name, output_name):
+    from chunkflow_tpu.annotations.skeleton import Skeleton
+
+    @operator
+    def stage(task):
+        task[output_name] = Skeleton.from_swc(file_name)
+        return task
+
+    return stage(_name="load-skeleton")
+
+
+@main.command("save-swc")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-name", "-i", type=str, default="skeleton")
+def save_swc_cmd(file_name, input_name):
+    @operator
+    def stage(task):
+        task[input_name].to_swc(file_name)
+        return task
+
+    return stage(_name="save-swc")
+
+
+@main.command("load-npy")
+@click.option("--file-name", "-f", type=str, required=True)
+@cartesian_option("--voxel-offset", default=(0, 0, 0))
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def load_npy_cmd(file_name, voxel_offset, output_chunk_name):
+    @operator
+    def stage(task):
+        task[output_chunk_name] = Chunk.from_npy(
+            file_name, voxel_offset=voxel_offset
+        )
+        return task
+
+    return stage(_name="load-npy")
+
+
+@main.command("save-npy")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_npy_cmd(file_name, input_chunk_name):
+    @operator
+    def stage(task):
+        task[input_chunk_name].to_npy(file_name)
+        return task
+
+    return stage(_name="save-npy")
+
+
+@main.command("load-json")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--output-name", "-o", type=str, default="json")
+def load_json_cmd(file_name, output_name):
+    import json as _json
+
+    @operator
+    def stage(task):
+        with open(file_name) as f:
+            task[output_name] = _json.load(f)
+        return task
+
+    return stage(_name="load-json")
+
+
+@main.command("load-zarr")
+@click.option("--store-path", "-p", type=str, required=True)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+@cartesian_option("--voxel-offset", default=(0, 0, 0))
+def load_zarr_cmd(store_path, output_chunk_name, voxel_offset):
+    """Load a zyx zarr array (tensorstore zarr driver)."""
+    import tensorstore as ts
+
+    @operator
+    def stage(task):
+        store = ts.open(
+            {"driver": "zarr", "kvstore": {"driver": "file", "path": store_path}}
+        ).result()
+        if task.get("bbox") is not None:
+            bbox = task["bbox"]
+            arr = store[bbox.slices].read().result()
+            task[output_chunk_name] = Chunk(arr, voxel_offset=bbox.start)
+        else:
+            task[output_chunk_name] = Chunk(
+                store.read().result(), voxel_offset=voxel_offset
+            )
+        return task
+
+    return stage(_name="load-zarr")
+
+
+@main.command("save-zarr")
+@click.option("--store-path", "-p", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@cartesian_option("--volume-size", default=None, help="create store of this size first")
+def save_zarr_cmd(store_path, input_chunk_name, volume_size):
+    """Write the chunk into a zyx zarr array at its voxel offset."""
+    import tensorstore as ts
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        arr = np.asarray(chunk.array)
+        spec = {
+            "driver": "zarr",
+            "kvstore": {"driver": "file", "path": store_path},
+        }
+        size = (
+            tuple(volume_size)
+            if volume_size and any(volume_size)
+            else arr.shape
+        )
+        store = ts.open(
+            spec,
+            create=True,
+            open=True,
+            dtype=arr.dtype.name,
+            shape=size,
+        ).result()
+        store[chunk.bbox.slices] = arr
+        return task
+
+    return stage(_name="save-zarr")
+
+
+@main.command("create-bbox")
+@cartesian_option("--start", "-s", required=True)
+@cartesian_option("--stop", "-e", default=None)
+@cartesian_option("--size", default=None)
+def create_bbox_cmd(start, stop, size):
+    """Set the task bbox explicitly (single-task pipelines)."""
+
+    @operator
+    def stage(task):
+        if stop and any(stop):
+            task["bbox"] = BoundingBox(start, stop)
+        elif size and any(size):
+            task["bbox"] = BoundingBox.from_delta(start, size)
+        else:
+            raise click.UsageError("need --stop or --size")
+        return task
+
+    return stage(_name="create-bbox")
+
+
+@main.command("cleanup")
+@click.option("--dir", "-d", "directory", type=str, required=True)
+@click.option("--suffix", type=str, default=".h5")
+def cleanup_cmd(directory, suffix):
+    """Remove per-task intermediate files for the task bbox."""
+    import os
+
+    @operator
+    def stage(task):
+        path = os.path.join(directory, f"{task['bbox'].string}{suffix}")
+        if os.path.exists(path) and not state.dry_run:
+            os.remove(path)
+        return task
+
+    return stage(_name="cleanup")
+
+
+# ---------------------------------------------------------------------------
 # flow control
 # ---------------------------------------------------------------------------
 @main.command("skip-all-zero")

@@ -21,9 +21,11 @@ def find_plugin(name: str) -> str:
     """Resolve a plugin name/path to a python file."""
     if not name.endswith(".py"):
         name = name + ".py"
+    bundled = os.path.join(os.path.dirname(__file__), "..", "plugins")
     candidates = [
         name,
-        os.path.join(os.path.dirname(__file__), "..", "plugins", name),
+        os.path.join(bundled, name),
+        os.path.join(bundled, "synapse", name),
     ]
     env_dir = os.environ.get("CHUNKFLOW_PLUGIN_DIR")
     if env_dir:

@@ -1,0 +1,209 @@
+import numpy as np
+import pytest
+
+from chunkflow_tpu.annotations.point_cloud import PointCloud
+from chunkflow_tpu.annotations.skeleton import Skeleton
+from chunkflow_tpu.annotations.synapses import Synapses
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.bbox import BoundingBox
+
+
+@pytest.fixture
+def synapses():
+    pre = np.array([[10, 10, 10], [50, 50, 50], [90, 90, 90]], dtype=np.int32)
+    post = np.array(
+        [
+            [0, 12, 10, 10],
+            [0, 10, 14, 10],
+            [1, 52, 50, 50],
+        ],
+        dtype=np.int32,
+    )
+    return Synapses(pre, post=post, resolution=(40, 4, 4))
+
+
+class TestSynapses:
+    def test_basic_counts(self, synapses):
+        assert synapses.pre_num == 3
+        assert synapses.post_num == 3
+        assert synapses.pre_with_post_num == 2
+        assert synapses.post_indices_of_pre(0).tolist() == [0, 1]
+
+    def test_distances(self, synapses):
+        d = synapses.distances_from_pre_to_post()
+        assert d.shape == (3,)
+        np.testing.assert_allclose(d[0], 2 * 40)  # z offset of 2
+        np.testing.assert_allclose(d[1], 4 * 4)   # y offset of 4
+
+    def test_json_h5_roundtrip(self, synapses, tmp_path):
+        for suffix in ("json", "h5"):
+            path = str(tmp_path / f"syn.{suffix}")
+            synapses.to_file(path)
+            loaded = Synapses.from_file(path)
+            assert loaded == synapses
+            assert loaded.resolution == synapses.resolution
+
+    def test_filter_by_bbox_remaps_indices(self, synapses):
+        cropped = synapses.filter_by_bbox(BoundingBox((40, 40, 40), (100, 100, 100)))
+        assert cropped.pre_num == 2
+        # only pre index 1 (now 0) kept its post
+        assert cropped.post_num == 1
+        assert cropped.post[0, 0] == 0
+        np.testing.assert_array_equal(cropped.post[0, 1:], [52, 50, 50])
+
+    def test_remove_pre_without_post(self, synapses):
+        trimmed = synapses.remove_pre_without_post()
+        assert trimmed.pre_num == 2
+        assert trimmed.post_num == 3
+        assert trimmed.post[:, 0].max() <= 1
+
+    def test_redundant_post(self):
+        pre = np.array([[0, 0, 0]], dtype=np.int32)
+        post = np.array(
+            [[0, 0, 0, 10], [0, 0, 0, 12], [0, 0, 0, 50]], dtype=np.int32
+        )
+        syn = Synapses(pre, post=post, resolution=(1, 1, 1))
+        redundant = syn.find_redundant_post(5.0)
+        assert redundant.tolist() == [1]
+
+    def test_duplicate_on_same_neuron(self):
+        seg_arr = np.zeros((4, 4, 4), dtype=np.uint32)
+        seg_arr[:, :, :2] = 7
+        seg = Chunk(seg_arr)
+        pre = np.array([[0, 0, 0]], dtype=np.int32)
+        post = np.array(
+            [[0, 1, 1, 0], [0, 2, 2, 1], [0, 3, 3, 3]], dtype=np.int32
+        )
+        syn = Synapses(pre, post=post)
+        dups = syn.find_duplicate_post_on_same_neuron(seg)
+        assert dups.tolist() == [1]  # second post on the same id-7 neuron
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Synapses(np.zeros((2, 2), dtype=np.int32))
+        with pytest.raises(ValueError):
+            Synapses(
+                np.zeros((1, 3), dtype=np.int32),
+                post=np.array([[5, 0, 0, 0]], dtype=np.int32),
+            )
+
+
+class TestPointCloud:
+    def test_basics_and_io(self, tmp_path):
+        points = np.array([[1, 2, 3], [7, 8, 9]], dtype=np.int64)
+        pc = PointCloud(points, voxel_size=(40, 4, 4))
+        assert len(pc) == 2
+        assert pc.bbox == BoundingBox((1, 2, 3), (8, 9, 10))
+        np.testing.assert_array_equal(pc.physical[0], [40, 8, 12])
+        path = str(tmp_path / "points.h5")
+        pc.to_h5(path)
+        loaded = PointCloud.from_h5(path)
+        np.testing.assert_array_equal(loaded.points, points)
+
+    def test_filter(self):
+        pc = PointCloud(np.array([[0, 0, 0], [5, 5, 5], [9, 9, 9]]))
+        kept = pc.filter_by_bbox(BoundingBox((1, 1, 1), (8, 8, 8)))
+        assert len(kept) == 1
+
+
+class TestSkeleton:
+    def _y_skeleton(self):
+        # a Y shape: 0-1-2 trunk, 3-4 branch from node 1
+        nodes = np.array(
+            [[0, 0, 0], [0, 10, 0], [0, 20, 0], [0, 15, 5], [0, 20, 10]],
+            dtype=np.float32,
+        )
+        parents = np.array([-1, 0, 1, 1, 3])
+        return Skeleton(nodes, parents)
+
+    def test_edges_and_length(self):
+        skel = self._y_skeleton()
+        assert skel.edges.shape == (4, 2)
+        assert skel.cable_length() > 0
+
+    def test_swc_roundtrip(self, tmp_path):
+        skel = self._y_skeleton()
+        path = str(tmp_path / "skel.swc")
+        skel.to_swc(path)
+        loaded = Skeleton.from_swc(path)
+        np.testing.assert_allclose(loaded.nodes, skel.nodes)
+        np.testing.assert_array_equal(loaded.parents, skel.parents)
+
+    def test_precomputed_roundtrip(self):
+        skel = self._y_skeleton()
+        blob = skel.to_precomputed_bytes()
+        loaded = Skeleton.from_precomputed_bytes(blob)
+        assert len(loaded) == len(skel)
+        np.testing.assert_allclose(loaded.nodes, skel.nodes)
+        # same edge set regardless of parent orientation
+        orig = {tuple(sorted(e)) for e in skel.edges.tolist()}
+        back = {tuple(sorted(e)) for e in loaded.edges.tolist()}
+        assert orig == back
+
+
+class TestSynapsePlugins:
+    def test_detect_pre_and_post(self):
+        from chunkflow_tpu.flow.plugin import load_plugin
+
+        prob = np.zeros((8, 32, 32), dtype=np.float32)
+        prob[4, 8, 8] = 1.0
+        prob[4, 24, 24] = 0.9
+        chunk = Chunk(prob)
+        detect_pre = load_plugin("detect_pre_synapses")
+        synapses = detect_pre(chunk, min_distance=3)
+        assert synapses.pre_num == 2
+
+        post_prob = np.zeros((8, 32, 32), dtype=np.float32)
+        post_prob[4, 10, 10] = 1.0
+        detect_post = load_plugin("detect_post_synapses")
+        with_post = detect_post(
+            synapses, Chunk(post_prob), search_radius=5, min_distance=2
+        )
+        assert with_post.post_num == 1
+        assert with_post.post[0, 0] in (0, 1)
+
+    def test_find_tbar_object(self):
+        from chunkflow_tpu.flow.plugin import load_plugin
+
+        seg_arr = np.zeros((8, 8, 8), dtype=np.uint32)
+        seg_arr[2, 2, 2] = 42
+        syn = Synapses(np.array([[2, 2, 2], [5, 5, 5]], dtype=np.int32))
+        find = load_plugin("find_tbar_object")
+        ids = find(syn, Chunk(seg_arr))
+        assert ids.tolist() == [42, 0]
+
+    def test_adjust_pre(self):
+        from chunkflow_tpu.flow.plugin import load_plugin
+
+        prob = np.zeros((8, 8, 8), dtype=np.float32)
+        prob[3, 3, 3] = 1.0
+        syn = Synapses(np.array([[2, 2, 2]], dtype=np.int32))
+        adjust = load_plugin("adjust_pre")
+        moved = adjust(syn, Chunk(prob), window=2)
+        np.testing.assert_array_equal(moved.pre[0], [3, 3, 3])
+
+
+def test_skeletonize_plugin(tmp_path):
+    from chunkflow_tpu.flow.plugin import load_plugin
+
+    # a thick horizontal bar: skeleton should run along its length
+    arr = np.zeros((8, 8, 32), dtype=np.uint32)
+    arr[2:6, 2:6, 2:30] = 1
+    seg = Chunk(arr, voxel_size=(1, 1, 1))
+    skeletonize = load_plugin("skeletonize")
+    out_dir = str(tmp_path / "skel")
+    skeletons = skeletonize(seg, voxel_num_threshold=10, output_path=out_dir)
+    assert 1 in skeletons
+    skel = skeletons[1]
+    assert len(skel) > 3
+    # spans most of the bar length
+    span = skel.nodes[:, 2].max() - skel.nodes[:, 2].min()
+    assert span > 15
+
+    import os
+
+    frags = os.listdir(out_dir)
+    assert len(frags) == 1
+
+    aggregate = load_plugin("aggregate_skeleton_fragments")
+    assert aggregate(out_dir, str(tmp_path / "agg")) == 1
