@@ -29,10 +29,14 @@ class Synapses:
         users: Optional[List[str]] = None,
     ):
         pre = np.asarray(pre, dtype=np.int32)
+        if pre.size == 0:
+            pre = pre.reshape(0, 3)  # json round-trips [] as shape (0,)
         if pre.ndim != 2 or pre.shape[1] != 3:
             raise ValueError(f"pre must be [N, 3] zyx, got {pre.shape}")
         if post is not None:
             post = np.asarray(post, dtype=np.int32)
+            if post.size == 0:
+                post = post.reshape(0, 4)
             if post.ndim != 2 or post.shape[1] != 4:
                 raise ValueError(f"post must be [M, 4] (pre_idx, z, y, x)")
             if post.size and (
@@ -131,6 +135,8 @@ class Synapses:
         if self.post is None or self.post_num == 0:
             return np.zeros((0,), dtype=np.int64)
         arr = np.asarray(seg.array)
+        if arr.ndim == 4:
+            arr = arr[0]  # czyx single-channel segmentation
         offset = seg.voxel_offset.vec
         duplicates = []
         for pre_index in np.unique(self.post[:, 0]):

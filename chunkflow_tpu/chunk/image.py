@@ -17,9 +17,34 @@ class Image(Chunk):
         kwargs.setdefault("layer_type", LayerType.IMAGE)
         super().__init__(array, **kwargs)
 
+    @classmethod
+    def from_chunk(cls, chunk: Chunk) -> "Image":
+        return cls(
+            chunk.array,
+            voxel_offset=chunk.voxel_offset,
+            voxel_size=chunk.voxel_size,
+        )
+
     def inference(self, inferencer) -> Chunk:
         """Run patch-wise convnet inference over this image."""
         return inferencer(self)
+
+    def normalize_shang(
+        self,
+        nominalmin=None,
+        nominalmax=None,
+        clipvalues: bool = False,
+    ) -> "Image":
+        """Slice-wise min/max normalization to a nominal range, Shang's
+        method (reference chunk/image/adjust_grey.py:209-255)."""
+        from chunkflow_tpu.chunk.adjust_grey import normalize_shang
+
+        out = normalize_shang(
+            np.asarray(self.array), nominalmin, nominalmax, clipvalues
+        )
+        return Image(
+            out, voxel_offset=self.voxel_offset, voxel_size=self.voxel_size
+        )
 
     def normalize_contrast(
         self,

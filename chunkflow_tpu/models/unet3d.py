@@ -35,10 +35,12 @@ class ConvBlock(nn.Module):
     def __call__(self, x):
         residual = x
         x = nn.Conv(self.features, (3, 3, 3), padding="SAME", dtype=self.dtype)(x)
-        x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
+                         dtype=self.dtype, use_fast_variance=False)(x)
         x = nn.elu(x)
         x = nn.Conv(self.features, (3, 3, 3), padding="SAME", dtype=self.dtype)(x)
-        x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
+                         dtype=self.dtype, use_fast_variance=False)(x)
         if residual.shape[-1] == self.features:
             x = x + residual
         x = nn.elu(x)

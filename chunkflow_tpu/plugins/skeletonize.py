@@ -74,7 +74,7 @@ def _object_graph(mask, dbf, voxel_size):
 
 
 def _skeletonize_object(mask, voxel_size, invalidation_scale=4.0,
-                        max_paths=50):
+                        max_paths=10000):
     dbf = ndimage.distance_transform_edt(mask, sampling=voxel_size)
     coords, index, graph = _object_graph(mask, dbf, voxel_size)
     n = coords.shape[0]
@@ -104,32 +104,44 @@ def _skeletonize_object(mask, voxel_size, invalidation_scale=4.0,
     add_node(root, -1)
     visited[root] = True
 
+    from scipy.spatial import cKDTree
+
+    all_phys = coords * vs
+    phys_tree = cKDTree(all_phys)
+
     for _ in range(max_paths):
         finite = np.isfinite(dist) & ~visited
         if not finite.any():
             break
         target = int(np.argmax(np.where(finite, dist, -np.inf)))
-        # walk predecessors back to a visited voxel
+        # walk predecessors back to a voxel already on the skeleton tree
+        # (NOT merely invalidated: invalidation marks a tube of off-axis
+        # voxels that are not nodes, and joining there would misattach the
+        # branch); the root is a tree node, so the walk always terminates
         path = []
         v = target
-        while v != -9999 and not visited[v]:
+        while v != -9999 and v not in node_of_voxel:
             path.append(v)
             v = int(predecessors[v])
             if v < 0:
                 break
-        join = v if v >= 0 and visited[v] else root
-        parent_node = node_of_voxel.get(join, 0)
+        join = v if v >= 0 and v in node_of_voxel else root
+        parent_node = node_of_voxel[join]
         for voxel in reversed(path):
             parent_node = add_node(voxel, parent_node)
-        # invalidate voxels near the new path
+        # invalidate voxels near the new path (KD-tree ball queries: the
+        # naive full-array distance per path voxel is O(len(path) * n))
         path_coords = coords[path] * vs
         radius = invalidation_scale * dbf_per_voxel[path] + 1e-3
-        all_phys = coords * vs
         for pc, r in zip(path_coords, radius):
-            close = np.linalg.norm(all_phys - pc, axis=1) <= r
-            visited |= close
+            visited[phys_tree.query_ball_point(pc, r)] = True
         visited[path] = True
 
+    if (np.isfinite(dist) & ~visited).any():
+        print(
+            f"warning: skeleton truncated at max_paths={max_paths} with "
+            "unvisited voxels remaining; pass a larger max_paths"
+        )
     skeleton_nodes = coords[nodes] * vs
     return Skeleton(
         skeleton_nodes,
@@ -142,6 +154,7 @@ def execute(
     seg,
     voxel_num_threshold: int = 100,
     invalidation_scale: float = 4.0,
+    max_paths: int = 10000,
     output_path: str = None,
 ):
     arr = np.asarray(seg.array)
@@ -156,6 +169,7 @@ def execute(
         skel = _skeletonize_object(
             arr == obj_id, voxel_size,
             invalidation_scale=invalidation_scale,
+            max_paths=max_paths,
         )
         if skel is not None and len(skel) > 1:
             # shift into global physical coordinates

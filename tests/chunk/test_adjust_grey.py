@@ -1,0 +1,107 @@
+"""Grey adjustment library (parity: reference tests/chunk/image/test_adjust_grey.py
+semantics + the adjust_grey.py function contracts)."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk import adjust_grey
+from chunkflow_tpu.chunk.image import Image
+
+
+def test_clip_percentile_stretches_to_full_range():
+    rng = np.random.default_rng(0)
+    img = rng.integers(50, 200, size=(4, 32, 32), dtype=np.uint8)
+    out = adjust_grey.clip_percentile(img, 0.01, 0.01)
+    assert out.dtype == np.uint8
+    assert out.min() < 10
+    assert out.max() > 245
+
+
+def test_clip_percentile_noop_range_preserved_shape():
+    img = np.zeros((2, 8, 8), dtype=np.uint8)
+    out = adjust_grey.clip_percentile(img)
+    assert out.shape == img.shape
+
+
+def test_window_level_maps_edges_to_unit():
+    img = np.array([0.0, 0.5, 1.0], dtype=np.float32)
+    out = adjust_grey.window_level(img.copy(), half_window=0.5, level=0.5)
+    np.testing.assert_allclose(out, [-1.0, 0.0, 1.0], atol=1e-6)
+    with pytest.raises(ValueError):
+        adjust_grey.window_level(img, half_window=0.0, level=0.5)
+
+
+def test_rescale_linear_map():
+    img = np.array([0.0, 0.5, 1.0], dtype=np.float32)
+    out = adjust_grey.rescale(img.copy(), (0, 1), (-1, 1))
+    np.testing.assert_allclose(out, [-1.0, 0.0, 1.0], atol=1e-6)
+    same = adjust_grey.rescale(img.copy(), (0, 1), (0, 1))
+    np.testing.assert_allclose(same, img)
+
+
+def test_normalize_meanstd_excludes_extremes():
+    rng = np.random.default_rng(1)
+    img = rng.random((16, 16)).astype(np.float32)
+    img[0, 0] = 0.0   # invalid min
+    img[0, 1] = 1.0   # invalid max
+    out = adjust_grey.normalize(img, "meanstd")
+    # the valid voxels are z-scored
+    got = out[(img != 0.0) & (img != 1.0)]
+    np.testing.assert_allclose(got.mean(), 0.0, atol=1e-5)
+    np.testing.assert_allclose(got.std(), 1.0, atol=1e-4)
+
+
+def test_normalize_fill_hits_target_range():
+    rng = np.random.default_rng(2)
+    img = rng.random((8, 8)).astype(np.float32) * 100
+    out = adjust_grey.normalize(img, "fill", target_scale=(-1, 1),
+                                min_max_invalid=(False, False))
+    np.testing.assert_allclose(out.min(), -1.0, atol=1e-5)
+    np.testing.assert_allclose(out.max(), 1.0, atol=1e-5)
+
+
+def test_adjust_gamma_identity_and_clip():
+    img = np.linspace(0, 1, 11, dtype=np.float32)
+    out = adjust_grey.adjust_gamma(img.copy(), 1.0)
+    np.testing.assert_allclose(out, img, atol=1e-6)
+    out2 = adjust_grey.adjust_gamma(np.array([-0.5, 2.0], np.float32), 2.0)
+    np.testing.assert_allclose(out2, [0.0, 1.0])
+
+
+def test_grey_augment_stays_in_range():
+    rng = np.random.default_rng(3)
+    img = (rng.random((4, 16, 16), dtype=np.float32) * 2 - 1)
+    out = adjust_grey.grey_augment(img, rng=np.random.default_rng(4))
+    assert out.shape == img.shape
+    assert out.min() >= -1.0 - 1e-5
+    assert out.max() <= 1.0 + 1e-5
+
+
+def test_normalize_shang_per_slice_fill():
+    rng = np.random.default_rng(5)
+    img = (rng.random((3, 16, 16)) * 100).astype(np.float32)
+    out = adjust_grey.normalize_shang(img, 0.0, 1.0, clipvalues=True)
+    assert out.dtype == np.float32
+    assert out.min() >= 0.0 and out.max() <= 1.0
+    # slice-wise: each slice's valid voxels span the target range
+    for zz in range(3):
+        assert out[zz].max() > 0.9
+
+
+def test_image_normalize_shang_method():
+    rng = np.random.default_rng(6)
+    img = Image(
+        (rng.random((3, 8, 8)) * 255).astype(np.uint8),
+        voxel_offset=(1, 2, 3),
+    )
+    out = img.normalize_shang(0.0, 1.0, clipvalues=True)
+    assert out.dtype == np.float32
+    assert tuple(out.voxel_offset) == (1, 2, 3)
+
+
+def test_normalize_shang_blank_slice_still_clipped():
+    img = (np.ones((2, 8, 8)) * 255).astype(np.float32)
+    img[1] = np.random.default_rng(7).random((8, 8)) * 255
+    out = adjust_grey.normalize_shang(img, 0.0, 1.0, clipvalues=True)
+    # the constant slice cannot be rescaled, but the [0, 1] output
+    # contract must still hold
+    assert out.max() <= 1.0

@@ -6,9 +6,20 @@ multi-chip path via __graft_entry__.dryrun_multichip.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override: the driver env pins JAX_PLATFORMS=axon (the real TPU) and a
+# sitecustomize hook registers that PJRT plugin in every interpreter, so env
+# vars alone cannot switch platforms. Unit tests must run on the virtual CPU
+# mesh — full-precision convs for the torch-parity oracle and no per-test TPU
+# compile latency — so force it through jax.config before any test imports
+# jax. bench.py and __graft_entry__ do not import this file, so they still
+# see the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

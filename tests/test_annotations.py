@@ -207,3 +207,66 @@ def test_skeletonize_plugin(tmp_path):
 
     aggregate = load_plugin("aggregate_skeleton_fragments")
     assert aggregate(out_dir, str(tmp_path / "agg")) == 1
+
+
+def test_skeleton_precomputed_undirected_edges():
+    """Precomputed edge pairs carry no orientation; any orientation must
+    round-trip into a valid single tree (child->parent rebuild by BFS)."""
+    import numpy as np
+    from chunkflow_tpu.annotations.skeleton import Skeleton
+
+    nodes = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # path 1-0-2-3 stored as unordered pairs (0,1), (2,3), (0,2)
+    import struct
+    blob = struct.pack("<II", 4, 3)
+    blob += nodes[:, ::-1].astype("<f4").tobytes()
+    blob += np.asarray([[0, 1], [2, 3], [0, 2]], dtype="<u4").tobytes()
+    skel = Skeleton.from_precomputed_bytes(blob)
+    assert len(skel) == 4
+    # exactly one root, all 3 edges present, every node reaches the root
+    assert int((skel.parents == -1).sum()) == 1
+    assert skel.edges.shape[0] == 3
+    root = int(np.nonzero(skel.parents == -1)[0][0])
+    for i in range(4):
+        seen = set()
+        j = i
+        while skel.parents[j] != -1:
+            assert j not in seen
+            seen.add(j)
+            j = int(skel.parents[j])
+        assert j == root
+
+
+def test_empty_synapses_json_roundtrip(tmp_path):
+    import numpy as np
+    from chunkflow_tpu.annotations.synapses import Synapses
+
+    empty = Synapses(np.zeros((0, 3), np.int32), np.zeros((0, 4), np.int32))
+    path = str(tmp_path / "empty.json")
+    empty.to_json(path)
+    back = Synapses.from_json(path)
+    assert back.pre_num == 0 and back.post_num == 0
+
+
+def test_duplicate_post_4d_segmentation():
+    import numpy as np
+    from chunkflow_tpu.annotations.synapses import Synapses
+    from chunkflow_tpu.chunk.segmentation import Segmentation
+
+    seg = Segmentation(np.ones((1, 4, 4, 4), np.uint32))
+    syn = Synapses(
+        np.asarray([[1, 1, 1]], np.int32),
+        np.asarray([[0, 1, 1, 2], [0, 2, 2, 2]], np.int32),
+    )
+    dup = syn.find_duplicate_post_on_same_neuron(seg)
+    assert dup.tolist() == [1]
+
+
+def test_skeleton_precomputed_radii_roundtrip():
+    import numpy as np
+    from chunkflow_tpu.annotations.skeleton import Skeleton
+
+    nodes = np.arange(9, dtype=np.float32).reshape(3, 3)
+    skel = Skeleton(nodes, [-1, 0, 1], radii=[3.0, 2.0, 1.0])
+    back = Skeleton.from_precomputed_bytes(skel.to_precomputed_bytes())
+    np.testing.assert_allclose(back.radii, [3.0, 2.0, 1.0])

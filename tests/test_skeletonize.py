@@ -1,0 +1,43 @@
+"""TEASAR-lite skeletonize plugin: topology correctness on synthetic shapes."""
+import numpy as np
+
+from chunkflow_tpu.chunk.segmentation import Segmentation
+from chunkflow_tpu.plugins import skeletonize
+
+
+def _tree_is_valid(skel):
+    n = len(skel)
+    roots = np.nonzero(skel.parents == -1)[0]
+    assert len(roots) == 1
+    for i in range(n):
+        j, hops = i, 0
+        while skel.parents[j] != -1:
+            j = int(skel.parents[j])
+            hops += 1
+            assert hops <= n
+    return True
+
+
+def test_skeletonize_branching_object_topology():
+    # T-shaped tube: horizontal bar + vertical stem in one z-plane slab
+    seg = np.zeros((3, 40, 40), dtype=np.uint32)
+    seg[:, 18:22, 4:36] = 1          # bar along x
+    seg[:, 4:30, 18:22] = 1          # stem along y, crossing the bar
+    chunk = Segmentation(seg, voxel_size=(1, 1, 1))
+    skels = skeletonize.execute(chunk, voxel_num_threshold=10)
+    assert 1 in skels
+    skel = skels[1]
+    assert _tree_is_valid(skel)
+    # no spurious giant edge: every edge should be short (neighbors in a
+    # 26-connected voxel grid are <= sqrt(3) apart; allow path joins a bit
+    # more slack)
+    edges = skel.edges
+    lengths = np.linalg.norm(
+        skel.nodes[edges[:, 0]] - skel.nodes[edges[:, 1]], axis=1
+    )
+    assert lengths.max() <= 2.0, (
+        f"misattached branch: edge of length {lengths.max()}"
+    )
+    # the skeleton should span all three arms of the T: total cable length
+    # must be a reasonable fraction of bar+stem extents (32 + 26)
+    assert skel.cable_length() > 35.0
