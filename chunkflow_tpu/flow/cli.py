@@ -104,6 +104,125 @@ def generate_tasks_cmd(chunk_size, overlap, roi_start, roi_stop, grid_size,
     return stage()
 
 
+@main.command("setup-env")
+@cartesian_option("--volume-start", required=True)
+@cartesian_option("--volume-stop", default=None)
+@cartesian_option("--volume-size", "-s", default=None)
+@click.option("--volume-path", "-l", type=str, required=True)
+@click.option("--max-ram-size", "-r", type=float, default=15.0,
+              help="RAM budget in GB; half goes to the output buffer")
+@cartesian_option("--output-patch-size", "-z", required=True)
+@cartesian_option("--input-patch-size", default=None)
+@cartesian_option("--output-patch-overlap", default=None)
+@cartesian_option("--crop-chunk-margin", default=None)
+@click.option("--channel-num", "-c", type=int, default=3)
+@click.option("--dtype", type=click.Choice(["uint8", "float16", "float32"]),
+              default="float32")
+@click.option("--mip", "env_mip", type=int, default=0)
+@click.option("--thumbnail-mip", type=int, default=6)
+@click.option("--max-mip", type=int, default=5)
+@click.option("--thumbnail/--no-thumbnail", default=True)
+@click.option("--encoding", type=str, default="raw")
+@cartesian_option("--voxel-size", default=(40, 4, 4))
+@click.option("--overwrite-info/--no-overwrite-info", default=False)
+@click.option("--queue-name", "-q", type=str, default=None,
+              help="also push the task grid to this queue")
+def setup_env_cmd(
+    volume_start, volume_stop, volume_size, volume_path, max_ram_size,
+    output_patch_size, input_patch_size, output_patch_overlap,
+    crop_chunk_margin, channel_num, dtype, env_mip, thumbnail_mip, max_mip,
+    thumbnail, encoding, voxel_size, overwrite_info, queue_name,
+):
+    """Plan chunk/block geometry, create volume infos, emit the task grid
+    (reference flow/setup_env.py:99-209)."""
+    from chunkflow_tpu.flow.setup_env import setup_environment
+
+    def none_if_unset(tp):
+        # click returns None for unset nargs=3 options; an explicit all-zero
+        # tuple (e.g. --output-patch-overlap 0 0 0) is a real value
+        return tuple(tp) if tp is not None else None
+
+    @generator
+    def stage(task):
+        plan = setup_environment(
+            dry_run=state.dry_run,
+            volume_start=tuple(volume_start),
+            volume_stop=none_if_unset(volume_stop),
+            volume_size=none_if_unset(volume_size),
+            volume_path=volume_path,
+            max_ram_size=max_ram_size,
+            output_patch_size=tuple(output_patch_size),
+            input_patch_size=none_if_unset(input_patch_size),
+            channel_num=channel_num,
+            dtype=dtype,
+            output_patch_overlap=none_if_unset(output_patch_overlap),
+            crop_chunk_margin=none_if_unset(crop_chunk_margin),
+            mip=env_mip,
+            thumbnail_mip=thumbnail_mip,
+            max_mip=max_mip,
+            thumbnail=thumbnail,
+            encoding=encoding,
+            voxel_size=tuple(voxel_size),
+            overwrite_info=overwrite_info,
+        )
+        if queue_name is not None and not state.dry_run:
+            from chunkflow_tpu.parallel.queues import open_queue
+
+            queue = open_queue(queue_name)
+            queue.send_messages([b.string for b in plan.bboxes])
+            print(f"pushed {len(plan.bboxes)} tasks to {queue_name}")
+            return
+        from chunkflow_tpu.flow.runtime import new_task
+
+        for bbox in plan.bboxes:
+            t = new_task()
+            t["bbox"] = bbox
+            yield t
+
+    return stage()
+
+
+@main.command("fetch-task-from-file")
+@click.option("--task-file", "-f", type=str, required=True,
+              help=".txt/.npy task list from generate-tasks")
+@click.option("--job-index", type=int, default=None,
+              help="index into the task list; defaults to $SLURM_ARRAY_TASK_ID")
+@click.option("--granularity", "-g", type=int, default=1,
+              help="number of consecutive tasks per job")
+def fetch_task_from_file_cmd(task_file, job_index, granularity):
+    """Static sharding: take this job's slice of a task-list file
+    (reference flow/flow.py:554-581, SLURM array protocol)."""
+    import os
+
+    @generator
+    def stage(task):
+        from chunkflow_tpu.flow.runtime import new_task
+
+        index = job_index
+        if index is None:
+            index = int(os.environ.get("SLURM_ARRAY_TASK_ID", 0))
+        boxes = list(BoundingBoxes.from_file(task_file))
+        start = index * granularity
+        for bbox in boxes[start:start + granularity]:
+            t = new_task()
+            t["bbox"] = bbox
+            yield t
+
+    return stage()
+
+
+@main.command("debug")
+def debug_cmd():
+    """Drop into a debugger with the flowing task bound to ``task``."""
+
+    @operator
+    def stage(task):
+        breakpoint()  # noqa: T100
+        return task
+
+    return stage(_name="debug")
+
+
 @main.command("fetch-task-from-queue")
 @click.option("--queue-name", "-q", type=str, required=True)
 @click.option("--visibility-timeout", type=int, default=1800)
@@ -537,18 +656,25 @@ def save_zarr_cmd(store_path, input_chunk_name, volume_size):
             "driver": "zarr",
             "kvstore": {"driver": "file", "path": store_path},
         }
-        size = (
-            tuple(volume_size)
-            if volume_size and any(volume_size)
-            else arr.shape
-        )
-        store = ts.open(
-            spec,
-            create=True,
-            open=True,
-            dtype=arr.dtype.name,
-            shape=size,
-        ).result()
+        try:
+            # existing store: open as-is (its domain must cover the bbox)
+            store = ts.open(spec).result()
+        except Exception:
+            # create; without an explicit volume size the store must still
+            # cover this chunk's GLOBAL bbox — a chunk at a nonzero
+            # voxel_offset writes at bbox slices, so shape=arr.shape alone
+            # would be out of bounds
+            size = (
+                tuple(volume_size)
+                if volume_size and any(volume_size)
+                else tuple(int(s) for s in chunk.bbox.stop)
+            )
+            store = ts.open(
+                spec,
+                create=True,
+                dtype=arr.dtype.name,
+                shape=size,
+            ).result()
         store[chunk.bbox.slices] = arr
         return task
 
@@ -850,6 +976,55 @@ def normalize_contrast_cmd(lower_clip_fraction, upper_clip_fraction, input_chunk
         return task
 
     return stage(_name="normalize-contrast")
+
+
+@main.command("normalize-intensity")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def normalize_intensity_cmd(input_chunk_name, output_chunk_name):
+    """uint8 grey image -> float32 in (-1, 1): x/127.5 - 1
+    (reference flow/flow.py:1650-1668)."""
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        assert np.issubdtype(np.dtype(chunk.dtype), np.uint8), (
+            "normalize-intensity expects a uint8 image chunk"
+        )
+        out = chunk.astype(np.float32)
+        out = out / 127.5 - 1.0
+        task[output_chunk_name] = out
+        return task
+
+    return stage(_name="normalize-intensity")
+
+
+@main.command("normalize-section-shang")
+@click.option("--nominalmin", type=float, default=None,
+              help="targeted minimum of the transformed chunk")
+@click.option("--nominalmax", type=float, default=None,
+              help="targeted maximum of the transformed chunk")
+@click.option("--clipvalues", type=bool, default=False,
+              help="clip transformed values to the target range")
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def normalize_section_shang_cmd(
+    nominalmin, nominalmax, clipvalues, input_chunk_name, output_chunk_name
+):
+    """Slice-wise min/max normalization, Shang's method
+    (reference flow/flow.py:1713-1748)."""
+
+    @operator
+    def stage(task):
+        img = task[input_chunk_name]
+        if not isinstance(img, Image):
+            img = Image.from_chunk(img)
+        task[output_chunk_name] = img.normalize_shang(
+            nominalmin=nominalmin, nominalmax=nominalmax, clipvalues=clipvalues
+        )
+        return task
+
+    return stage(_name="normalize-section-shang")
 
 
 @main.command("mask")

@@ -223,6 +223,7 @@ class PrecomputedVolume:
         block_size=(64, 64, 64),   # zyx
         num_mips: int = 1,
         downsample_factor=(1, 2, 2),  # zyx per mip
+        encoding: str = "raw",
     ) -> "PrecomputedVolume":
         """Create the info file with a mip pyramid (create_new_info parity)."""
         volume_size = to_cartesian(volume_size)
@@ -244,7 +245,7 @@ class PrecomputedVolume:
                     "resolution": [res.x, res.y, res.z],
                     "voxel_offset": [offset.x, offset.y, offset.z],
                     "chunk_sizes": [[block.x, block.y, block.z]],
-                    "encoding": "raw",
+                    "encoding": encoding,
                 }
             )
             size = size.ceildiv(factor)

@@ -129,3 +129,88 @@ def test_delete_and_copy_var(runner, tmp_path):
         ],
     )
     assert Chunk.from_h5(out).shape == (4, 4, 4)
+
+
+def test_normalize_intensity(runner, tmp_path):
+    src = str(tmp_path / "u8.h5")
+    out = str(tmp_path / "norm.h5")
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "8", "8", "--dtype", "uint8",
+            "--pattern", "random",
+            "save-h5", "-f", src,
+        ],
+    )
+    run_ok(
+        runner,
+        ["load-h5", "-f", src, "normalize-intensity", "save-h5", "-f", out],
+    )
+    norm = Chunk.from_h5(out)
+    arr = np.asarray(norm.array)
+    assert arr.dtype == np.float32
+    assert arr.min() >= -1.0 and arr.max() <= 1.0
+
+
+def test_normalize_section_shang(runner, tmp_path):
+    out = str(tmp_path / "shang.h5")
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "8", "8", "--dtype", "uint8",
+            "--pattern", "random",
+            "normalize-section-shang", "--nominalmax", "1.0",
+            "--clipvalues", "true",
+            "save-h5", "-f", out,
+        ],
+    )
+    arr = np.asarray(Chunk.from_h5(out).array)
+    assert arr.dtype == np.float32
+    assert arr.max() <= 1.0
+
+
+def test_save_zarr_nonzero_offset(runner, tmp_path):
+    pytest.importorskip("tensorstore")
+    store = str(tmp_path / "store.zarr")
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "8", "8",
+            "--voxel-offset", "2", "4", "4",
+            "save-zarr", "-p", store,
+        ],
+    )
+    import tensorstore as ts
+
+    arr = ts.open(
+        {"driver": "zarr", "kvstore": {"driver": "file", "path": store}}
+    ).result()
+    assert tuple(arr.shape) == (6, 12, 12)
+
+
+def test_save_zarr_into_existing_larger_store(runner, tmp_path):
+    pytest.importorskip("tensorstore")
+    store = str(tmp_path / "big.zarr")
+    # create the store with an explicit volume size via the corner chunk
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "8", "8",
+            "save-zarr", "-p", store, "--volume-size", "8", "16", "16",
+        ],
+    )
+    # then write an interior chunk without repeating --volume-size
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "8", "8",
+            "--voxel-offset", "4", "8", "8",
+            "save-zarr", "-p", store,
+        ],
+    )
+    import tensorstore as ts
+
+    arr = ts.open(
+        {"driver": "zarr", "kvstore": {"driver": "file", "path": store}}
+    ).result()
+    assert tuple(arr.shape) == (8, 16, 16)

@@ -1,0 +1,160 @@
+"""Capacity planner (parity: reference flow/setup_env.py semantics)."""
+import json
+import os
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from chunkflow_tpu.flow.setup_env import get_optimized_block_size, setup_environment
+
+
+def test_optimized_block_size_divisibility():
+    patch_num, out_chunk, in_chunk, block, factor = get_optimized_block_size(
+        output_patch_size=(16, 192, 192),
+        output_patch_overlap=(2, 32, 32),
+        max_ram_size=15.0,
+        channel_num=3,
+        max_mip=5,
+        crop_chunk_margin=(2, 32, 32),
+        input_patch_size=(20, 256, 256),
+        mip=0,
+        thumbnail_mip=6,
+    )
+    # xy divisible by 2**max_mip after margin removal
+    assert out_chunk[1] % 2 ** 5 == 0
+    assert out_chunk[2] % 2 ** 5 == 0
+    assert factor == 1
+    # output buffer fits in half of 15 GB at float32 x 3 channels
+    ram = np.prod(out_chunk) * 4 * 3 / 1e9
+    assert ram <= 15.0 * 0.75, f"output buffer {ram} GB blows the budget"
+    # input chunk = output chunk + 2*margin + (in_patch - out_patch)
+    assert in_chunk[0] == out_chunk[0] + 4 + 4
+    assert in_chunk[1] == out_chunk[1] + 64 + 64
+
+
+def test_optimized_block_size_infeasible_raises():
+    with pytest.raises(ValueError):
+        get_optimized_block_size(
+            output_patch_size=(16, 13, 13),   # xy stride 13, odd prime
+            output_patch_overlap=(2, 0, 0),
+            max_ram_size=0.001,
+            channel_num=1,
+            max_mip=10,                        # 1024-divisibility: impossible
+            crop_chunk_margin=(0, 0, 0),
+            input_patch_size=(16, 13, 13),
+            mip=0,
+            thumbnail_mip=6,
+        )
+
+
+def test_setup_environment_creates_infos_and_tasks(tmp_path):
+    volume_path = str(tmp_path / "vol")
+    plan = setup_environment(
+        dry_run=False,
+        volume_start=(0, 0, 0),
+        volume_stop=None,
+        volume_size=(128, 2048, 2048),
+        volume_path=volume_path,
+        max_ram_size=2.0,
+        output_patch_size=(16, 192, 192),
+        input_patch_size=(20, 256, 256),
+        channel_num=3,
+        dtype="float32",
+        output_patch_overlap=(2, 32, 32),
+        crop_chunk_margin=(2, 32, 32),
+        mip=0,
+        thumbnail_mip=6,
+        max_mip=5,
+        thumbnail=True,
+        encoding="raw",
+        voxel_size=(40, 4, 4),
+        overwrite_info=True,
+    )
+    assert os.path.exists(os.path.join(volume_path, "info"))
+    assert os.path.exists(os.path.join(volume_path, "thumbnail", "info"))
+    with open(os.path.join(volume_path, "info")) as f:
+        info = json.load(f)
+    assert info["num_channels"] == 3
+    assert len(plan.bboxes) > 0
+    # every task chunk is the planned output chunk size
+    first = plan.bboxes[0]
+    assert tuple(first.shape) == tuple(plan.output_chunk_size)
+
+
+def test_setup_env_cli_dry_run(tmp_path):
+    from chunkflow_tpu.flow.cli import main
+
+    runner = CliRunner()
+    result = runner.invoke(
+        main,
+        [
+            "--dry-run",
+            "setup-env",
+            "--volume-start", "0", "0", "0",
+            "--volume-size", "64", "1024", "1024",
+            "-l", str(tmp_path / "v"),
+            "-r", "1",
+            "--output-patch-size", "16", "192", "192",
+            "--input-patch-size", "20", "256", "256",
+            "--output-patch-overlap", "2", "32", "32",
+            "--crop-chunk-margin", "2", "32", "32",
+            "skip-none",
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "--patch-num" in result.output
+    assert not os.path.exists(str(tmp_path / "v"))
+
+
+def test_fetch_task_from_file(tmp_path, monkeypatch):
+    from chunkflow_tpu.core.bbox import BoundingBoxes
+    from chunkflow_tpu.flow.cli import main
+
+    boxes = BoundingBoxes.from_manual_setup(
+        chunk_size=(8, 8, 8), roi_start=(0, 0, 0), roi_stop=(8, 16, 16)
+    )
+    task_file = str(tmp_path / "tasks.txt")
+    boxes.to_file(task_file)
+
+    monkeypatch.setenv("SLURM_ARRAY_TASK_ID", "1")
+    out = str(tmp_path / "got.h5")
+    runner = CliRunner()
+    result = runner.invoke(
+        main,
+        [
+            "fetch-task-from-file", "-f", task_file,
+            "create-chunk", "--size", "8", "8", "8",
+            "save-h5", "-f", out,
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert os.path.exists(out)
+
+
+def test_setup_env_explicit_zero_overlap_respected(tmp_path):
+    """--output-patch-overlap 0 0 0 must mean ZERO, not the half-patch
+    default (regression: all-zero tuples were treated as unset)."""
+    from chunkflow_tpu.flow.cli import main
+
+    runner = CliRunner()
+    result = runner.invoke(
+        main,
+        [
+            "--dry-run",
+            "setup-env",
+            "--volume-start", "0", "0", "0",
+            "--volume-size", "64", "1024", "1024",
+            "-l", str(tmp_path / "v"),
+            "-r", "1",
+            "--output-patch-size", "16", "192", "192",
+            "--output-patch-overlap", "0", "0", "0",
+            "--crop-chunk-margin", "0", "0", "0",
+            "skip-none",
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "--expand-margin-size 0 0 0" in result.output
