@@ -1,0 +1,23 @@
+# chunkflow-tpu worker image.
+# Parity target: reference Dockerfile + docker/ (ubuntu + python + CUDA
+# torch); here the accelerator stack is JAX/TPU, which needs no CUDA base —
+# TPU runtime libraries are injected by the TPU VM host.
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make cmake ninja-build \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/chunkflow-tpu
+COPY pyproject.toml ./
+COPY chunkflow_tpu ./chunkflow_tpu
+
+# TPU wheels: libtpu comes from the TPU VM; jax[tpu] resolves the rest
+RUN pip install --no-cache-dir "jax[tpu]" -f \
+        https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir .
+
+# build the native host-side kernels (cc3d / watershed / surface-nets)
+RUN python -c "from chunkflow_tpu import native; native.build()"
+
+ENTRYPOINT ["python", "-m", "chunkflow_tpu.flow.cli"]

@@ -382,6 +382,13 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
 
         return _mask.maskout(self, mask)
 
+    def validate(self) -> bool:
+        """Detect black-box corruption by template matching
+        (reference chunk/validate.py:6-74)."""
+        from chunkflow_tpu.chunk.validate import validate_by_template_matching
+
+        return validate_by_template_matching(np.asarray(self.array))
+
     def gaussian_filter_2d(self, sigma: float = 1.0) -> "Chunk":
         from chunkflow_tpu.ops import filters
 

@@ -669,9 +669,11 @@ def save_zarr_cmd(store_path, input_chunk_name, volume_size):
                 if volume_size and any(volume_size)
                 else tuple(int(s) for s in chunk.bbox.stop)
             )
+            # open=True tolerates a concurrent worker winning the create race
             store = ts.open(
                 spec,
                 create=True,
+                open=True,
                 dtype=arr.dtype.name,
                 shape=size,
             ).result()
@@ -1282,6 +1284,209 @@ def mesh_manifest_cmd(mesh_dir):
         yield  # pragma: no cover
 
     return stage()
+
+
+@main.command("download-mesh")
+@click.option("--mesh-dir", "-v", type=str, required=True,
+              help="directory holding mesh fragments + manifests")
+@click.option("--ids", "-i", type=str, default=None,
+              help="comma-separated object ids, or a text file of them")
+@click.option("--input-chunk-name", type=str, default=None,
+              help="rank objects by voxel count from this segmentation chunk")
+@click.option("--start-rank", "-s", type=int, default=0)
+@click.option("--stop-rank", "-p", type=int, default=None)
+@click.option("--out-pre", "-o", type=str, default="./")
+@click.option("--output-format", "-f",
+              type=click.Choice(["ply", "obj"]), default="ply")
+def download_mesh_cmd(mesh_dir, ids, input_chunk_name, start_rank, stop_rank,
+                      out_pre, output_format):
+    """Fuse an object's mesh fragments and write ply/obj files
+    (reference flow/flow.py:2160-2210)."""
+    import os
+
+    from chunkflow_tpu.flow.mesh import download_mesh, to_obj, to_ply
+
+    @operator
+    def stage(task):
+        if input_chunk_name is not None:
+            seg = np.asarray(task[input_chunk_name].array)
+            unique, count = np.unique(seg, return_counts=True)
+            fg = unique != 0
+            unique, count = unique[fg], count[fg]
+            order = np.argsort(count)[::-1]
+            obj_ids = unique[order][start_rank:stop_rank].tolist()
+        else:
+            import re
+
+            text = ids
+            if text is not None and os.path.isfile(text):
+                with open(text) as f:
+                    text = f.read()
+            if text is None:
+                raise click.UsageError("need --ids or --input-chunk-name")
+            obj_ids = [int(x) for x in re.split(r"[\s,]+", text) if x]
+        for obj_id in obj_ids:
+            fused = download_mesh(mesh_dir, int(obj_id))
+            if fused is None:
+                print(f"object {obj_id}: no mesh manifest found")
+                continue
+            vertices, faces = fused
+            out = f"{out_pre}{obj_id}.{output_format}"
+            text_mesh = (
+                to_ply(vertices, faces)
+                if output_format == "ply"
+                else to_obj(vertices, faces)
+            )
+            with open(out, "w") as f:
+                f.write(text_mesh)
+            print(f"wrote {out} ({vertices.shape[0]} vertices)")
+        return task
+
+    return stage(_name="download-mesh")
+
+
+@main.command("aggregate-skeleton-fragments")
+@click.option("--fragments-path", "-f", type=str, required=True)
+@click.option("--output-path", "-o", type=str, default=None)
+def aggregate_skeleton_fragments_cmd(fragments_path, output_path):
+    """Merge per-chunk skeleton fragments into whole skeletons
+    (reference flow/flow.py:623-649)."""
+    from chunkflow_tpu.plugins.aggregate_skeleton_fragments import execute
+
+    @generator
+    def stage(task):
+        execute(fragments_path, output_path)
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
+@main.command("save-nrrd")
+@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
+def save_nrrd_cmd(file_name, input_chunk_name):
+    """Save the chunk as an NRRD file (reference flow/flow.py:853)."""
+    from chunkflow_tpu.volume.io_nrrd import save_nrrd
+
+    @operator
+    def stage(task):
+        chunk = task[input_chunk_name]
+        save_nrrd(
+            file_name,
+            np.asarray(chunk.array),
+            voxel_size=tuple(chunk.voxel_size),
+            voxel_offset=tuple(chunk.voxel_offset),
+        )
+        return task
+
+    return stage(_name="save-nrrd")
+
+
+@main.command("view")
+@click.option("--image-chunk-name", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--segmentation-chunk-name", type=str, default=None)
+@click.option("--screenshot", type=str, default=None,
+              help="save a middle-section png instead of opening a window")
+def view_cmd(image_chunk_name, segmentation_chunk_name, screenshot):
+    """Quick-look viewer: middle z-section via matplotlib
+    (reference flow/view.py microviewer equivalent)."""
+
+    @operator
+    def stage(task):
+        import matplotlib
+
+        if screenshot:
+            matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        chunk = task[image_chunk_name]
+        arr = np.asarray(chunk.array)
+        if arr.ndim == 4:
+            arr = arr[0]
+        mid = arr[arr.shape[0] // 2]
+        ncols = 2 if segmentation_chunk_name else 1
+        fig, axes = plt.subplots(1, ncols, squeeze=False)
+        axes[0][0].imshow(mid, cmap="gray")
+        axes[0][0].set_title(image_chunk_name)
+        if segmentation_chunk_name:
+            seg = np.asarray(task[segmentation_chunk_name].array)
+            if seg.ndim == 4:
+                seg = seg[0]
+            axes[0][1].imshow(seg[seg.shape[0] // 2] % 251, cmap="tab20")
+            axes[0][1].set_title(segmentation_chunk_name)
+        if screenshot:
+            fig.savefig(screenshot, dpi=120)
+            print(f"wrote {screenshot}")
+        else:  # pragma: no cover - interactive
+            plt.show()
+        plt.close(fig)
+        return task
+
+    return stage(_name="view")
+
+
+@main.command("neuroglancer")
+@click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME,
+              help="comma-separated chunk names to serve as layers")
+@click.option("--port", "-p", type=int, default=0)
+@click.option("--voxel-size", type=int, nargs=3, default=None)
+def neuroglancer_cmd(chunk_names, port, voxel_size):
+    """Serve chunks in an in-process neuroglancer viewer
+    (reference flow/neuroglancer.py; requires the neuroglancer package)."""
+
+    @operator
+    def stage(task):
+        try:
+            import neuroglancer  # noqa: F401
+        except ImportError as e:
+            raise click.ClickException(
+                "the neuroglancer package is not installed in this "
+                "environment; install it to use this operator"
+            ) from e
+        from chunkflow_tpu.flow.viewers import serve_neuroglancer
+
+        serve_neuroglancer(
+            {
+                name: task[name]
+                for name in chunk_names.split(",")
+                if name in task
+            },
+            port=port,
+            voxel_size=voxel_size,
+        )
+        return task
+
+    return stage(_name="neuroglancer")
+
+
+@main.command("napari")
+@click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME)
+def napari_cmd(chunk_names):
+    """Open chunks in napari (requires the napari package)."""
+
+    @operator
+    def stage(task):
+        try:
+            import napari
+        except ImportError as e:
+            raise click.ClickException(
+                "the napari package is not installed in this environment"
+            ) from e
+        viewer = napari.Viewer()
+        for name in chunk_names.split(","):
+            if name not in task:
+                continue
+            chunk = task[name]
+            arr = np.asarray(chunk.array)
+            if chunk.is_segmentation():
+                viewer.add_labels(arr, name=name)
+            else:
+                viewer.add_image(arr, name=name)
+        napari.run()  # pragma: no cover - interactive
+        return task
+
+    return stage(_name="napari")
 
 
 @main.command("evaluate-segmentation")

@@ -62,6 +62,39 @@ def to_precomputed_bytes(vertices: np.ndarray, faces: np.ndarray) -> bytes:
     )
 
 
+def from_precomputed_bytes(blob: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """Inverse of to_precomputed_bytes (legacy single-resolution format)."""
+    (nv,) = struct.unpack("<I", blob[:4])
+    vertices = np.frombuffer(blob, dtype="<f4", count=nv * 3, offset=4)
+    vertices = vertices.reshape(nv, 3)
+    faces = np.frombuffer(blob, dtype="<u4", offset=4 + nv * 12)
+    return vertices.copy(), faces.reshape(-1, 3).copy()
+
+
+def download_mesh(
+    mesh_dir: str, obj_id: int
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fuse an object's mesh fragments listed in its ``{id}:0`` manifest
+    (parity: reference flow/flow.py:2160-2210 download-mesh via
+    CloudVolume.mesh.get)."""
+    manifest_path = os.path.join(mesh_dir, f"{obj_id}:0")
+    if not os.path.exists(manifest_path):
+        return None
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    all_vertices, all_faces = [], []
+    base = 0
+    for frag in manifest["fragments"]:
+        with open(os.path.join(mesh_dir, frag), "rb") as f:
+            vertices, faces = from_precomputed_bytes(f.read())
+        all_vertices.append(vertices)
+        all_faces.append(faces + base)
+        base += vertices.shape[0]
+    if not all_vertices:
+        return None
+    return np.concatenate(all_vertices), np.concatenate(all_faces)
+
+
 def to_obj(vertices: np.ndarray, faces: np.ndarray) -> str:
     lines = [f"v {v[0]} {v[1]} {v[2]}" for v in vertices]
     lines += [f"f {f[0]+1} {f[1]+1} {f[2]+1}" for f in faces]

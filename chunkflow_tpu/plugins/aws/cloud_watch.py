@@ -1,0 +1,23 @@
+"""Publish per-operator timings as CloudWatch metrics
+(reference plugins/aws/cloud_watch.py:26-67). Requires boto3 + credentials."""
+
+
+def execute(log: dict, name: str = "chunkflow-tpu"):
+    try:
+        import boto3
+    except ImportError as e:
+        raise ImportError(
+            "cloud_watch needs the 'boto3' package, which is not installed "
+            "in this environment"
+        ) from e
+    client = boto3.client("cloudwatch")
+    metric_data = [
+        {
+            "MetricName": f"{key}-time",
+            "Value": float(value),
+            "Unit": "Seconds",
+        }
+        for key, value in log.get("timer", {}).items()
+    ]
+    if metric_data:
+        client.put_metric_data(Namespace=name, MetricData=metric_data)

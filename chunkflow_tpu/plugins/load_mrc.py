@@ -1,0 +1,13 @@
+"""Load an MRC file as an image chunk (reference plugins/load_mrc.py,
+mrcfile-free: native MRC2014 reader)."""
+from chunkflow_tpu.chunk.image import Image
+from chunkflow_tpu.volume.io_mrc import load_mrc
+
+
+def execute(file_name: str, voxel_offset=None):
+    array, header = load_mrc(file_name)
+    return Image(
+        array,
+        voxel_offset=voxel_offset,
+        voxel_size=tuple(max(1, round(s)) for s in header["voxel_size_nm"]),
+    )

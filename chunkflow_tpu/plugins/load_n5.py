@@ -1,0 +1,16 @@
+"""Load a chunk from an N5 dataset via tensorstore's n5 driver
+(reference plugins/load_n5.py used zarr.N5FSStore; tensorstore subsumes it)."""
+from chunkflow_tpu.chunk.base import Chunk
+
+
+def execute(bbox, n5_dir: str = None, group_path: str = None,
+            voxel_size: tuple = None):
+    import tensorstore as ts
+
+    dataset = ts.open({
+        "driver": "n5",
+        "kvstore": {"driver": "file", "path": n5_dir},
+        "path": group_path or "",
+    }).result()
+    array = dataset[bbox.slices].read().result()
+    return Chunk(array, voxel_offset=bbox.start, voxel_size=voxel_size)

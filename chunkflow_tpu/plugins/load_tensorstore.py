@@ -1,0 +1,24 @@
+"""Load a chunk from any tensorstore-supported dataset
+(reference plugins/load_tensorstore.py).
+
+args example:
+    driver=zarr;kvstore=file:///tmp/store;voxel_size=(40,4,4)
+"""
+from chunkflow_tpu.chunk.base import Chunk
+
+
+def execute(bbox, driver: str = "zarr", kvstore: str = None,
+            cache: int = None, voxel_size: tuple = None):
+    import tensorstore as ts
+
+    if isinstance(kvstore, str) and "://" in kvstore:
+        kv_driver, path = kvstore.split("://", 1)
+        kv_driver = "file" if kv_driver == "" else kv_driver
+        kvstore = {"driver": kv_driver, "path": path}
+    spec = {"driver": driver, "kvstore": kvstore}
+    if cache:
+        spec["context"] = {"cache_pool": {"total_bytes_limit": cache}}
+        spec["recheck_cached_data"] = "open"
+    dataset = ts.open(spec).result()
+    array = dataset[bbox.slices].read().result()
+    return Chunk(array, voxel_offset=bbox.start, voxel_size=voxel_size)

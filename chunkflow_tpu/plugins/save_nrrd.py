@@ -1,0 +1,14 @@
+"""Save a chunk as NRRD (reference plugins/save_nrrd.py, pynrrd-free)."""
+import numpy as np
+
+from chunkflow_tpu.volume.io_nrrd import save_nrrd
+
+
+def execute(chunk, file_name: str = "chunk.nrrd"):
+    save_nrrd(
+        file_name,
+        np.asarray(chunk.array),
+        voxel_size=tuple(chunk.voxel_size),
+        voxel_offset=tuple(chunk.voxel_offset),
+    )
+    print(f"saved chunk to {file_name}")

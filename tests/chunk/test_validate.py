@@ -1,0 +1,34 @@
+"""Black-box detector (reference chunk/validate.py + native NCC)."""
+import numpy as np
+
+from chunkflow_tpu.chunk.validate import (
+    match_template_ncc,
+    validate_by_template_matching,
+)
+
+
+def test_ncc_perfect_match_scores_one():
+    rng = np.random.default_rng(0)
+    img = rng.random((10, 12, 14))
+    template = img[2:4, 3:10, 4:11].copy()
+    score = match_template_ncc(img, template)
+    assert abs(score[2, 3, 4] - 1.0) < 1e-6
+    assert score.max() <= 1.0 + 1e-6
+
+
+def test_validate_clean_image_passes():
+    rng = np.random.default_rng(1)
+    img = rng.integers(1, 255, size=(16, 64, 64), dtype=np.uint8)
+    assert validate_by_template_matching(img)
+
+
+def test_validate_black_box_fails():
+    rng = np.random.default_rng(2)
+    img = rng.integers(1, 255, size=(32, 128, 128), dtype=np.uint8)
+    img[8:24, 32:96, 32:96] = 0  # the black box
+    assert not validate_by_template_matching(img)
+
+
+def test_validate_float_skipped():
+    img = np.zeros((16, 32, 32), dtype=np.float32)
+    assert validate_by_template_matching(img)

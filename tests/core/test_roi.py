@@ -1,0 +1,43 @@
+"""RegionOfInterest + ROITree (reference lib/region_of_interest.py; the
+reference's from_roi is an empty prototype — ours must actually decompose)."""
+import numpy as np
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.core.roi import RegionOfInterest, ROITree
+
+
+def test_roi_physical_size_and_scale_slices():
+    roi = RegionOfInterest((0, 0, 0), (4, 8, 8), voxel_size=(40, 4, 4))
+    assert tuple(roi.physical_size) == (160, 32, 32)
+    slices = roi.slices_in_scale((40, 8, 8))
+    assert slices == (slice(0, 4), slice(0, 4), slice(0, 4))
+
+
+def test_roi_from_bbox_clone():
+    roi = RegionOfInterest.from_bbox(
+        BoundingBox((1, 2, 3), (4, 5, 6)), (40, 4, 4)
+    )
+    other = roi.clone()
+    assert other == roi or (
+        tuple(other.start) == (1, 2, 3) and tuple(other.voxel_size) == (40, 4, 4)
+    )
+
+
+def test_roitree_decomposes_to_atomic_blocks():
+    roi = RegionOfInterest((0, 0, 0), (4, 64, 96), voxel_size=(40, 4, 4))
+    tree = ROITree.from_roi(roi, (4, 32, 32))
+    leaves = list(tree.leaves())
+    assert len(tree) == len(leaves) == 2 * 3
+    # leaves tile the roi exactly
+    total = sum(int(np.prod(tuple(l.shape))) for l in leaves)
+    assert total == 4 * 64 * 96
+    for leaf in leaves:
+        assert all(s <= b for s, b in zip(leaf.shape, (4, 32, 32)))
+
+
+def test_roitree_unaligned_roi():
+    roi = RegionOfInterest((0, 0, 0), (4, 40, 40), voxel_size=(1, 1, 1))
+    tree = ROITree.from_roi(roi, (4, 32, 32))
+    leaves = list(tree.leaves())
+    total = sum(int(np.prod(tuple(l.shape))) for l in leaves)
+    assert total == 4 * 40 * 40

@@ -214,3 +214,64 @@ def test_save_zarr_into_existing_larger_store(runner, tmp_path):
         {"driver": "zarr", "kvstore": {"driver": "file", "path": store}}
     ).result()
     assert tuple(arr.shape) == (8, 16, 16)
+
+
+def test_save_nrrd_cli(runner, tmp_path):
+    path = str(tmp_path / "c.nrrd")
+    run_ok(runner, ["create-chunk", "--size", "4", "8", "8", "save-nrrd", "-f", path])
+    from chunkflow_tpu.volume.io_nrrd import load_nrrd
+
+    arr, header = load_nrrd(path)
+    assert arr.shape == (4, 8, 8)
+
+
+def test_mesh_download_mesh_cli(runner, tmp_path):
+    mesh_dir = str(tmp_path / "mesh")
+    out_pre = str(tmp_path / "m_")
+    # two touching cubes of one object meshed from a random-ish seg
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "8", "16", "16", "--pattern", "zero",
+            "--dtype", "uint32",
+            "plugin", "-f", "print_max_id",
+            "mesh", "-o", mesh_dir, "--output-format", "precomputed",
+        ],
+    )
+    # meshing a zero chunk produces no fragments; now a real object
+    import numpy as np
+
+    from chunkflow_tpu.chunk.segmentation import Segmentation
+    from chunkflow_tpu.flow.mesh import MeshOperator, write_manifests
+
+    seg = np.zeros((8, 16, 16), np.uint32)
+    seg[2:6, 2:14, 2:8] = 7
+    seg[2:6, 2:14, 8:14] = 7
+    op = MeshOperator(mesh_dir, output_format="precomputed")
+    op(Segmentation(seg, voxel_size=(1, 1, 1)))
+    write_manifests(mesh_dir)
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "2", "2", "2",
+            "download-mesh", "-v", mesh_dir, "-i", "7",
+            "-o", out_pre, "-f", "obj",
+        ],
+    )
+    import os
+
+    assert os.path.exists(out_pre + "7.obj")
+
+
+def test_view_screenshot(runner, tmp_path):
+    shot = str(tmp_path / "view.png")
+    run_ok(
+        runner,
+        [
+            "create-chunk", "--size", "4", "16", "16", "--pattern", "sin",
+            "view", "--screenshot", shot,
+        ],
+    )
+    import os
+
+    assert os.path.exists(shot)

@@ -1,0 +1,117 @@
+"""SpatialTaskTree + GlobalIdAllocator + coordination HTTP service
+(reference distributed/restapi/ prototypes, completed and testable)."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.parallel.restapi import CoordinationService, serve
+from chunkflow_tpu.parallel.task_tree import (
+    DONE, READY, GlobalIdAllocator, SpatialTaskTree,
+)
+
+
+def test_tree_decomposition_covers_volume():
+    tree = SpatialTaskTree(BoundingBox((0, 0, 0), (4, 64, 64)), (4, 32, 32))
+    leaves = tree.leaf_list
+    assert len(leaves) == 4
+    total = sum(
+        int((l.bbox.stop[0] - l.bbox.start[0])
+            * (l.bbox.stop[1] - l.bbox.start[1])
+            * (l.bbox.stop[2] - l.bbox.start[2]))
+        for l in leaves
+    )
+    assert total == 4 * 64 * 64
+
+
+def test_merge_scheduling_order():
+    tree = SpatialTaskTree(BoundingBox((0, 0, 0), (4, 64, 32)), (4, 32, 32))
+    # two leaves + one root merge
+    first = tree.next_ready_task()
+    second = tree.next_ready_task()
+    assert first.is_leaf and second.is_leaf
+    # root not runnable until children done
+    assert tree.next_ready_task() is None
+    first.set_state_done()
+    second.set_state_done()
+    merge = tree.next_ready_task()
+    assert merge is tree and not merge.is_leaf
+    merge.set_state_done()
+    assert tree.all_done
+
+
+def test_auto_propagate_matches_reference_semantics():
+    tree = SpatialTaskTree(BoundingBox((0, 0, 0), (4, 64, 32)), (4, 32, 32))
+    for leaf in tree.leaf_list:
+        leaf.set_state_done(auto_propagate=True)
+    assert tree.is_done
+
+
+def test_json_roundtrip_preserves_states():
+    tree = SpatialTaskTree(BoundingBox((0, 0, 0), (4, 64, 64)), (4, 32, 32))
+    node = tree.next_ready_task()
+    node.set_state_done()
+    back = SpatialTaskTree.from_json(tree.json)
+    assert back.bbox == tree.bbox
+    states = [n.state for n in back.walk()]
+    assert DONE in states and READY in states
+
+
+def test_global_id_allocator_disjoint_ranges():
+    alloc = GlobalIdAllocator(100)
+    results = []
+
+    def worker():
+        for _ in range(50):
+            results.append((alloc.allocate(7), 7))
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = sorted(results)
+    for (a, n), (b, _) in zip(spans, spans[1:]):
+        assert a + n <= b, "overlapping id ranges"
+    assert alloc.watermark == 100 + 4 * 50 * 7
+
+
+def test_http_service_end_to_end():
+    tree = SpatialTaskTree(BoundingBox((0, 0, 0), (4, 64, 32)), (4, 32, 32))
+    service = CoordinationService(id_start=1000, task_tree=tree)
+    server, thread = serve(service, host="127.0.0.1", port=0, background=True)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/objids/5") as r:
+            assert json.load(r)["base_id"] == 1000
+        with urllib.request.urlopen(f"{base}/objids/5") as r:
+            assert json.load(r)["base_id"] == 1005
+
+        claimed = []
+        while True:
+            with urllib.request.urlopen(f"{base}/task") as r:
+                if r.status == 204:
+                    break
+                claimed.append(json.load(r)["bbox"])
+        assert len(claimed) == 2  # the two leaves
+        for bbox_str in claimed:
+            req = urllib.request.Request(
+                f"{base}/task/{bbox_str}/done", method="POST"
+            )
+            with urllib.request.urlopen(req) as r:
+                json.load(r)
+        # now the root merge is claimable
+        with urllib.request.urlopen(f"{base}/task") as r:
+            assert r.status == 200
+            root_bbox = json.load(r)["bbox"]
+        req = urllib.request.Request(
+            f"{base}/task/{root_bbox}/done", method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            assert json.load(r)["all_done"]
+    finally:
+        server.shutdown()
+        thread.join(timeout=5)

@@ -1,0 +1,85 @@
+"""Native NRRD/MRC codecs + their plugins (parity: reference save-nrrd
+command and load_nrrd/load_mrc plugins, without pynrrd/mrcfile)."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.volume.io_mrc import load_mrc, save_mrc
+from chunkflow_tpu.volume.io_nrrd import load_nrrd, save_nrrd
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "uint16", "float32", "uint32"])
+def test_nrrd_roundtrip(tmp_path, dtype):
+    rng = np.random.default_rng(0)
+    arr = (rng.random((4, 8, 6)) * 100).astype(dtype)
+    path = str(tmp_path / "c.nrrd")
+    save_nrrd(path, arr, voxel_size=(40, 4, 4), voxel_offset=(1, 2, 3))
+    back, header = load_nrrd(path)
+    np.testing.assert_array_equal(back, arr)
+    assert header["type"] == np.dtype(dtype).name
+    assert header["chunkflow voxel offset"] == "1 2 3"
+
+
+def test_nrrd_gzip_roundtrip(tmp_path):
+    arr = np.arange(64, dtype=np.uint8).reshape(4, 4, 4)
+    path = str(tmp_path / "c.nrrd")
+    save_nrrd(path, arr, encoding="gzip")
+    back, header = load_nrrd(path)
+    np.testing.assert_array_equal(back, arr)
+    assert header["encoding"] == "gzip"
+
+
+def test_nrrd_plugin_roundtrip(tmp_path):
+    from chunkflow_tpu.plugins import load_nrrd as load_plugin
+    from chunkflow_tpu.plugins import save_nrrd as save_plugin
+
+    chunk = Chunk.create(size=(4, 8, 8), dtype="uint8", voxel_offset=(5, 6, 7))
+    path = str(tmp_path / "p.nrrd")
+    save_plugin.execute(chunk, file_name=path)
+    back = load_plugin.execute(path)
+    np.testing.assert_array_equal(np.asarray(back.array), np.asarray(chunk.array))
+    assert tuple(back.voxel_offset) == (5, 6, 7)
+
+
+@pytest.mark.parametrize("dtype", ["int8", "int16", "float32", "uint16"])
+def test_mrc_roundtrip(tmp_path, dtype):
+    rng = np.random.default_rng(1)
+    arr = (rng.random((3, 5, 7)) * 50).astype(dtype)
+    path = str(tmp_path / "c.mrc")
+    save_mrc(path, arr, voxel_size_nm=(40.0, 4.0, 4.0))
+    back, header = load_mrc(path)
+    np.testing.assert_array_equal(back, arr)
+    np.testing.assert_allclose(header["voxel_size_nm"], (40.0, 4.0, 4.0), rtol=1e-5)
+
+
+def test_mrc_plugin(tmp_path):
+    from chunkflow_tpu.plugins import load_mrc as plugin
+
+    arr = np.zeros((2, 4, 4), dtype=np.float32)
+    path = str(tmp_path / "p.mrc")
+    save_mrc(path, arr, voxel_size_nm=(40.0, 4.0, 4.0))
+    img = plugin.execute(path)
+    assert img.shape == (2, 4, 4)
+    assert tuple(img.voxel_size) == (40, 4, 4)
+
+
+def test_load_tensorstore_plugin(tmp_path):
+    pytest.importorskip("tensorstore")
+    import tensorstore as ts
+
+    from chunkflow_tpu.core.bbox import BoundingBox
+    from chunkflow_tpu.plugins import load_tensorstore as plugin
+
+    store_path = str(tmp_path / "store.zarr")
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 255, size=(8, 8, 8), dtype=np.uint8)
+    store = ts.open(
+        {"driver": "zarr", "kvstore": {"driver": "file", "path": store_path}},
+        create=True, dtype="uint8", shape=(8, 8, 8),
+    ).result()
+    store[...] = data
+
+    bbox = BoundingBox((2, 2, 2), (6, 6, 6))
+    chunk = plugin.execute(bbox, driver="zarr", kvstore=f"file://{store_path}")
+    np.testing.assert_array_equal(np.asarray(chunk.array), data[2:6, 2:6, 2:6])
+    assert tuple(chunk.voxel_offset) == (2, 2, 2)
