@@ -126,6 +126,17 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
             voxel_size=voxel_size,
         )
 
+    @classmethod
+    def from_array(cls, array, bbox: BoundingBox, voxel_size=None) -> "Chunk":
+        """Wrap an array whose spatial extent is ``bbox`` (reference
+        chunk/base.py:98-106)."""
+        if tuple(array.shape[-3:]) != tuple(bbox.shape):
+            raise ValueError(
+                f"array spatial shape {tuple(array.shape[-3:])} does not "
+                f"match bbox shape {tuple(bbox.shape)}"
+            )
+        return cls(array, voxel_offset=bbox.start, voxel_size=voxel_size)
+
     # ---- array protocol -------------------------------------------------
     @property
     def shape(self):
@@ -301,6 +312,35 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
             self.array = self.array.at[sl].add(value)
         else:
             self.array[sl] += value
+
+    def add_overlap(self, other: "Chunk") -> None:
+        """Sum the overlapping region of ``other`` into this chunk
+        (reference chunk/base.py:750)."""
+        self.blend(other)
+
+    def shrink(self, size) -> "Chunk":
+        """Trim voxels from the faces; ``size`` is 3 symmetric or 6
+        (-z,-y,-x,+z,+y,+x) amounts (reference chunk/base.py:630-646)."""
+        size = tuple(int(s) for s in size)
+        if len(size) == 3:
+            size = size + size
+        if len(size) != 6:
+            raise ValueError(f"need 3 or 6 elements, got {len(size)}")
+        if any(s < 0 for s in size):
+            raise ValueError(f"shrink amounts must be non-negative: {size}")
+        z, y, x = self.shape[-3:]
+        arr = self.array[
+            ...,
+            size[0]:z - size[3],
+            size[1]:y - size[4],
+            size[2]:x - size[5],
+        ]
+        return type(self)(
+            arr,
+            voxel_offset=self.voxel_offset + Cartesian.from_collection(size[:3]),
+            voxel_size=self.voxel_size,
+            layer_type=self.layer_type,
+        )
 
     def crop_margin(self, margin) -> "Chunk":
         """Shrink symmetrically by ``margin`` voxels per face."""

@@ -8,7 +8,7 @@ Remap/renumber replace the fastremap C++ wheel with vectorized numpy
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -106,6 +106,20 @@ class Segmentation(Chunk):
         if base_id:
             arr = np.where(arr > 0, arr + base_id, 0).astype(arr.dtype)
         return self._with_array(arr)
+
+    def remap(self, base_id: int = 0) -> Tuple["Segmentation", int]:
+        """Renumber ids consecutively, offset by ``base_id``; returns the
+        new chunk and its max id as the next base (reference
+        chunk/segmentation.py:69-84). Functional twist: the reference
+        mutates in place and returns only the new base id."""
+        seg = self.renumber(start_id=1).astype(np.uint64)
+        if base_id:
+            arr = np.asarray(seg.array)
+            seg = seg._with_array(
+                np.where(arr > 0, arr + np.uint64(base_id), np.uint64(0))
+            )
+        new_base_id = max(int(np.asarray(seg.array).max()), int(base_id))
+        return seg, new_base_id
 
     def mask_fragments(self, voxel_num_threshold: int) -> "Segmentation":
         """Dust removal: zero out objects smaller than the threshold."""

@@ -1,0 +1,20 @@
+"""shard_map compatibility: jax.shard_map (>=0.8) vs the experimental one.
+
+The new API dropped ``check_rep``; replication checking is off either way
+because the blend programs psum explicitly."""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_rep,
+        )
+
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map

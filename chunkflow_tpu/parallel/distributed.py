@@ -47,7 +47,7 @@ def build_sharded_program(
     """
     import jax
     from jax import lax
-    from jax.experimental.shard_map import shard_map
+    from chunkflow_tpu.parallel._shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from chunkflow_tpu.ops.blend import build_local_blend, normalize_blend

@@ -131,3 +131,72 @@ def test_pad_to():
     p = c.pad_to((4, 8, 8))
     assert p.shape == (4, 8, 8)
     np.testing.assert_array_equal(np.asarray(p.array)[:3, :5, :7], np.asarray(c.array))
+
+
+def test_shrink():
+    c = Chunk.create(size=(8, 8, 8), voxel_offset=(1, 2, 3))
+    s = c.shrink((1, 2, 3))
+    assert s.shape == (6, 4, 2)
+    assert tuple(s.voxel_offset) == (2, 4, 6)
+    s6 = c.shrink((1, 1, 1, 2, 2, 2))
+    assert s6.shape == (5, 5, 5)
+    assert tuple(s6.voxel_offset) == (2, 3, 4)
+
+
+def test_add_overlap():
+    a = Chunk(np.ones((4, 4, 4), np.float32), voxel_offset=(0, 0, 0))
+    b = Chunk(np.ones((4, 4, 4), np.float32), voxel_offset=(0, 0, 2))
+    a.add_overlap(b)
+    assert np.asarray(a.array)[:, :, :2].sum() == 32  # untouched
+    assert np.asarray(a.array)[:, :, 2:].sum() == 64  # overlap doubled
+
+
+def test_from_array():
+    from chunkflow_tpu.core.bbox import BoundingBox
+
+    bbox = BoundingBox.from_delta((2, 3, 4), (4, 4, 4))
+    c = Chunk.from_array(np.zeros((4, 4, 4), np.uint8), bbox)
+    assert tuple(c.voxel_offset) == (2, 3, 4)
+
+
+def test_segmentation_remap():
+    from chunkflow_tpu.chunk.segmentation import Segmentation
+
+    arr = np.array([[[0, 7, 7], [9, 9, 0], [0, 0, 42]]], dtype=np.uint32)
+    seg = Segmentation(arr)
+    out, new_base = seg.remap(base_id=100)
+    assert isinstance(out, Segmentation)
+    assert out.dtype == np.uint64
+    vals = np.unique(np.asarray(out.array))
+    assert set(vals.tolist()) == {0, 101, 102, 103}
+    assert new_base == 103
+
+
+def test_segmentation_remap_overflow_and_empty():
+    from chunkflow_tpu.chunk.segmentation import Segmentation
+
+    # base_id near uint32 max must not wrap (offset applies after uint64 cast)
+    seg = Segmentation(np.array([[[0, 1, 2, 3]]], dtype=np.uint32))
+    out, base = seg.remap(base_id=2**32 - 2)
+    vals = set(np.unique(np.asarray(out.array)).tolist())
+    assert vals == {0, 2**32 - 1, 2**32, 2**32 + 1}
+    assert base == 2**32 + 1
+
+    # empty chunk must preserve the accumulated base id
+    empty = Segmentation(np.zeros((1, 2, 2), dtype=np.uint32))
+    _, base = empty.remap(base_id=100)
+    assert base == 100
+
+
+def test_shrink_rejects_negative():
+    c = Chunk.create(size=(8, 8, 8))
+    with pytest.raises(ValueError):
+        c.shrink((-1, 0, 0))
+
+
+def test_from_array_shape_mismatch():
+    from chunkflow_tpu.core.bbox import BoundingBox
+
+    bbox = BoundingBox.from_delta((0, 0, 0), (8, 8, 8))
+    with pytest.raises(ValueError):
+        Chunk.from_array(np.zeros((4, 4, 4), np.uint8), bbox)
