@@ -329,6 +329,11 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         if any(s < 0 for s in size):
             raise ValueError(f"shrink amounts must be non-negative: {size}")
         z, y, x = self.shape[-3:]
+        if size[0] + size[3] >= z or size[1] + size[4] >= y or \
+                size[2] + size[5] >= x:
+            raise ValueError(
+                f"shrink {size} consumes the whole extent {(z, y, x)}"
+            )
         arr = self.array[
             ...,
             size[0]:z - size[3],

@@ -104,7 +104,9 @@ class Segmentation(Chunk):
 
         arr, _ = remap.renumber(np.asarray(self.array), start_id=start_id)
         if base_id:
-            arr = np.where(arr > 0, arr + base_id, 0).astype(arr.dtype)
+            # offset in uint64 so large bases never wrap the source dtype
+            arr = np.asarray(arr, dtype=np.uint64)
+            arr = np.where(arr > 0, arr + np.uint64(base_id), np.uint64(0))
         return self._with_array(arr)
 
     def remap(self, base_id: int = 0) -> Tuple["Segmentation", int]:
@@ -112,12 +114,7 @@ class Segmentation(Chunk):
         new chunk and its max id as the next base (reference
         chunk/segmentation.py:69-84). Functional twist: the reference
         mutates in place and returns only the new base id."""
-        seg = self.renumber(start_id=1).astype(np.uint64)
-        if base_id:
-            arr = np.asarray(seg.array)
-            seg = seg._with_array(
-                np.where(arr > 0, arr + np.uint64(base_id), np.uint64(0))
-            )
+        seg = self.renumber(start_id=1, base_id=base_id).astype(np.uint64)
         new_base_id = max(int(np.asarray(seg.array).max()), int(base_id))
         return seg, new_base_id
 

@@ -223,6 +223,22 @@ def debug_cmd():
     return stage(_name="debug")
 
 
+@main.command("prefetch")
+@click.option(
+    "--depth", "-d", type=int, default=2,
+    help="how many tasks to stage ahead of the consumer",
+)
+def prefetch_cmd(depth):
+    """Pipeline upstream stages in a background thread.
+
+    Place after the load operators so the next task's host IO overlaps the
+    current task's device compute (no reference analog — the reference's
+    sequential loop is its acknowledged hot spot, SURVEY §3.2)."""
+    from chunkflow_tpu.flow.runtime import prefetch_stage
+
+    return prefetch_stage(depth=depth)
+
+
 @main.command("fetch-task-from-queue")
 @click.option("--queue-name", "-q", type=str, required=True)
 @click.option("--visibility-timeout", type=int, default=1800)
@@ -862,13 +878,17 @@ def copy_var_cmd(from_name, to_name):
 @click.option("--crop-output-margin/--no-crop-output-margin", default=True)
 @click.option("--mask-myelin-threshold", type=float, default=None)
 @click.option("--dtype", type=click.Choice(["float32", "bfloat16"]), default="float32")
+@click.option(
+    "--model-variant", type=click.Choice(["parity", "tpu"]), default="parity",
+    help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
+)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
                   num_output_channels, num_input_channels, framework,
                   model_path, weight_path, batch_size, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
-                  input_chunk_name, output_chunk_name):
+                  model_variant, input_chunk_name, output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -887,6 +907,7 @@ def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
         crop_output_margin=crop_output_margin,
         mask_myelin_threshold=mask_myelin_threshold,
         dtype=dtype,
+        model_variant=model_variant,
         dry_run=state.dry_run,
     )
 

@@ -97,3 +97,64 @@ def generator(func: Callable) -> Callable:
         return stage
 
     return wrapper
+
+
+def prefetch_stage(depth: int = 2) -> Callable:
+    """Run the upstream stages in a background thread, ``depth`` tasks ahead.
+
+    The reference loads, computes and saves strictly sequentially and pays
+    for it (SURVEY §7 "Host<->HBM pipelining"). Inserting this stage after
+    the load operators overlaps the next task's host-side IO with the
+    current task's device compute: the worker thread keeps pulling tasks
+    (filling a bounded queue) while the main thread runs the devicebound
+    stages. Upstream exceptions re-raise in the consumer.
+    """
+    import queue
+    import threading
+
+    def stage(stream: Iterator[Optional[dict]]):
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
+        _END = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            """Bounded put that gives up when the consumer has stopped."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for task in stream:
+                    if not put(task):
+                        return  # consumer gone: stop pulling upstream
+            except BaseException as exc:  # propagate to consumer
+                put((_END, exc))
+                return
+            put((_END, None))
+
+        thread = threading.Thread(target=worker, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if (
+                    isinstance(item, tuple)
+                    and len(item) == 2
+                    and item[0] is _END
+                ):
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            # early exit (downstream error / generator close): unblock and
+            # retire the worker so it stops consuming upstream tasks
+            stop.set()
+            thread.join(timeout=5.0)
+
+    return stage

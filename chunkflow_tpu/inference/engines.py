@@ -65,24 +65,39 @@ def create_flax_engine(
     num_input_channels: int = 1,
     num_output_channels: int = 3,
     dtype: str = "float32",
+    model_variant: str = "parity",
 ) -> Engine:
     """The native convnet engine: a Flax 3D UNet (or user model file).
 
     ``model_path`` may be empty (use the built-in UNet) or a python file
     exposing ``create_model(num_input_channels, num_output_channels)`` that
     returns a Flax module. ``weight_path`` may be a ``.pt`` torch state dict
-    (converted) or an orbax/msgpack flax checkpoint.
+    (converted) or an orbax/msgpack flax checkpoint. ``model_variant``:
+    'parity' is the reference-class UNet (torch-convertible); 'tpu' is the
+    space-to-depth flagship (unet3d.create_tpu_optimized_model).
     """
     from chunkflow_tpu.models import unet3d
 
+    compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    if model_path and model_variant != "parity":
+        raise ValueError(
+            "--model-path and --model-variant are mutually exclusive: a "
+            "user model file defines its own architecture"
+        )
     if model_path:
         module = _load_user_module(model_path, "chunkflow_user_model")
         model = module.create_model(num_input_channels, num_output_channels)
+    elif model_variant == "tpu":
+        model = unet3d.create_tpu_optimized_model(
+            in_channels=num_input_channels,
+            out_channels=num_output_channels,
+            dtype=compute_dtype,
+        )
     else:
         model = unet3d.UNet3D(
             in_channels=num_input_channels,
             out_channels=num_output_channels,
-            dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32,
+            dtype=compute_dtype,
         )
 
     params = unet3d.init_or_load_params(
@@ -158,6 +173,7 @@ def create_engine(framework: str, **kwargs) -> Engine:
             num_input_channels=kwargs.get("num_input_channels", 1),
             num_output_channels=kwargs.get("num_output_channels", 3),
             dtype=kwargs.get("dtype", "float32"),
+            model_variant=kwargs.get("model_variant", "parity"),
         )
     if framework == "universal":
         return create_universal_engine(

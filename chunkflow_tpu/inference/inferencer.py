@@ -56,6 +56,7 @@ class Inferencer:
         crop_output_margin: bool = True,
         mask_myelin_threshold: Optional[float] = None,
         dtype: str = "float32",
+        model_variant: str = "parity",
         dry_run: bool = False,
     ):
         self.input_patch_size = Cartesian.from_collection(input_patch_size)
@@ -93,6 +94,7 @@ class Inferencer:
             model_path=model_path,
             weight_path=weight_path,
             dtype=dtype,
+            model_variant=model_variant,
         )
         self._program = None
         self._device_params = None

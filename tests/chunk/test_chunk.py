@@ -200,3 +200,20 @@ def test_from_array_shape_mismatch():
     bbox = BoundingBox.from_delta((0, 0, 0), (8, 8, 8))
     with pytest.raises(ValueError):
         Chunk.from_array(np.zeros((4, 4, 4), np.uint8), bbox)
+
+
+def test_shrink_rejects_overconsume():
+    c = Chunk.create(size=(8, 8, 8))
+    with pytest.raises(ValueError):
+        c.shrink((0, 0, 0, 9, 0, 0))
+    with pytest.raises(ValueError):
+        c.shrink((4, 0, 0, 4, 0, 0))
+
+
+def test_renumber_base_id_no_wrap():
+    from chunkflow_tpu.chunk.segmentation import Segmentation
+
+    seg = Segmentation(np.array([[[0, 1, 2]]], dtype=np.uint32))
+    out = seg.renumber(base_id=2**32 - 2)
+    vals = set(np.unique(np.asarray(out.array)).tolist())
+    assert vals == {0, 2**32 - 1, 2**32}

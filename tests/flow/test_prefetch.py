@@ -1,0 +1,67 @@
+"""Prefetch stage: ordering, completeness, exception propagation, overlap."""
+import time
+
+import pytest
+
+from chunkflow_tpu.flow.runtime import prefetch_stage
+
+
+def test_prefetch_preserves_order_and_count():
+    tasks = [{"log": {"timer": {}}, "i": i} for i in range(20)]
+    out = list(prefetch_stage(depth=3)(iter(tasks)))
+    assert [t["i"] for t in out] == list(range(20))
+
+
+def test_prefetch_propagates_exceptions():
+    def source():
+        yield {"i": 0}
+        raise RuntimeError("boom")
+
+    stage = prefetch_stage(depth=1)
+    it = stage(source())
+    assert next(it)["i"] == 0
+    with pytest.raises(RuntimeError, match="boom"):
+        list(it)
+
+
+def test_prefetch_overlaps_producer_and_consumer():
+    """With prefetch, slow-produce + slow-consume take ~max, not ~sum."""
+    n, delay = 6, 0.05
+
+    def source():
+        for i in range(n):
+            time.sleep(delay)  # pretend host IO
+            yield {"i": i}
+
+    start = time.perf_counter()
+    for _ in prefetch_stage(depth=2)(source()):
+        time.sleep(delay)  # pretend device compute
+    elapsed = time.perf_counter() - start
+    # sequential would be ~2*n*delay; pipelined ~(n+1)*delay
+    assert elapsed < 1.7 * n * delay, elapsed
+
+
+def test_prefetch_cli_registered():
+    from chunkflow_tpu.flow.cli import main
+
+    assert "prefetch" in main.commands
+
+
+def test_prefetch_stops_upstream_on_early_exit():
+    """Closing the consumer retires the worker; upstream stops being pulled."""
+    pulled = []
+
+    def source():
+        for i in range(100):
+            pulled.append(i)
+            yield {"i": i}
+
+    stage = prefetch_stage(depth=1)
+    it = stage(source())
+    assert next(it)["i"] == 0
+    it.close()  # simulates a downstream exception unwinding the pipeline
+    time.sleep(0.3)
+    n = len(pulled)
+    assert n <= 4, f"worker kept pulling after close: {n}"
+    time.sleep(0.2)
+    assert len(pulled) == n, "worker still running after close"
