@@ -404,12 +404,14 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         return _threshold.threshold(self, threshold)
 
     def connected_component(
-        self, threshold: float = 0.5, connectivity: int = 26
+        self, threshold: float = 0.5, connectivity: int = 26,
+        device: bool = False,
     ) -> "Chunk":
         from chunkflow_tpu.ops import connected_components as _cc
 
         return _cc.connected_components(
-            self, threshold=threshold, connectivity=connectivity
+            self, threshold=threshold, connectivity=connectivity,
+            device=device,
         )
 
     def channel_voting(self) -> "Chunk":

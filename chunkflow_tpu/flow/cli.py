@@ -956,13 +956,15 @@ def threshold_cmd(threshold, input_chunk_name, output_chunk_name):
 @main.command("connected-components")
 @click.option("--threshold", "-t", type=float, default=0.5)
 @click.option("--connectivity", "-c", type=click.Choice(["6", "18", "26"]), default="26")
+@click.option("--device/--host", default=False,
+              help="label on the accelerator (iterative propagation) instead of host union-find")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def connected_components_cmd(threshold, connectivity, input_chunk_name, output_chunk_name):
+def connected_components_cmd(threshold, connectivity, device, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = task[input_chunk_name].connected_component(
-            threshold=threshold, connectivity=int(connectivity)
+            threshold=threshold, connectivity=int(connectivity), device=device
         )
         return task
 

@@ -156,6 +156,15 @@ def _load_user_module(path: str, name: str):
 
 
 def create_engine(framework: str, **kwargs) -> Engine:
+    if framework == "prebuilt":
+        # pass an Engine object directly via the dedicated kwarg (reference
+        # inferencer.py:209-211); programmatic use only — not on the CLI
+        engine = kwargs.get("engine")
+        if not isinstance(engine, Engine):
+            raise TypeError(
+                "framework='prebuilt' needs an Engine instance as engine="
+            )
+        return engine
     if framework == "identity":
         return create_identity_engine(
             kwargs["input_patch_size"],

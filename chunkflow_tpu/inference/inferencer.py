@@ -57,6 +57,7 @@ class Inferencer:
         mask_myelin_threshold: Optional[float] = None,
         dtype: str = "float32",
         model_variant: str = "parity",
+        engine=None,
         dry_run: bool = False,
     ):
         self.input_patch_size = Cartesian.from_collection(input_patch_size)
@@ -87,6 +88,7 @@ class Inferencer:
 
         self.engine = engines.create_engine(
             framework,
+            engine=engine,
             input_patch_size=tuple(self.input_patch_size),
             output_patch_size=tuple(self.output_patch_size),
             num_output_channels=num_output_channels,

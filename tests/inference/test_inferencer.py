@@ -169,3 +169,32 @@ def test_tta_requires_square_patches():
         Inferencer(
             input_patch_size=(16, 32, 16), framework="identity", augment=True
         )
+
+
+def test_prebuilt_engine():
+    """framework='prebuilt' reuses a caller-constructed Engine (reference
+    inferencer.py:209-211)."""
+    from chunkflow_tpu.inference import engines
+    from chunkflow_tpu.inference.inferencer import Inferencer
+    from chunkflow_tpu.chunk.base import Chunk
+
+    patch = (4, 16, 16)
+    eng = engines.create_identity_engine(
+        input_patch_size=patch, output_patch_size=patch,
+        num_input_channels=1, num_output_channels=1,
+    )
+    inferencer = Inferencer(
+        input_patch_size=patch,
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="prebuilt",
+        engine=eng,
+        batch_size=1,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    out = inferencer(chunk)
+    np.testing.assert_allclose(
+        np.asarray(out.array)[0], np.asarray(chunk.array), atol=1e-5
+    )

@@ -1,0 +1,60 @@
+"""Device-side CC (label propagation) vs host labeling."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.ops import connected_components as cc
+
+
+def _equivalent_labelings(a: np.ndarray, b: np.ndarray) -> bool:
+    """Same partition of foreground, regardless of label values."""
+    fg = a > 0
+    if not np.array_equal(fg, b > 0):
+        return False
+    pairs = {}
+    for va, vb in zip(a[fg], b[fg]):
+        if pairs.setdefault(va, vb) != vb:
+            return False
+    return len(set(pairs.values())) == len(pairs)
+
+
+@pytest.mark.parametrize("connectivity", [6, 18, 26])
+def test_device_cc_matches_host(connectivity):
+    rng = np.random.default_rng(0)
+    mask = rng.random((12, 16, 16)) > 0.7
+    host = cc.label_binary(mask, connectivity=connectivity)
+    dev = np.asarray(cc.label_binary_device(mask, connectivity=connectivity))
+    assert _equivalent_labelings(host, dev)
+
+
+def test_device_cc_two_objects():
+    mask = np.zeros((4, 8, 8), bool)
+    mask[1, 1:3, 1:3] = True
+    mask[2, 5:7, 5:7] = True
+    dev = np.asarray(cc.label_binary_device(mask, connectivity=6))
+    labels = set(np.unique(dev).tolist()) - {0}
+    assert len(labels) == 2
+    assert (dev > 0).sum() == mask.sum()
+
+
+def test_device_cc_empty():
+    dev = np.asarray(cc.label_binary_device(np.zeros((4, 4, 4), bool)))
+    assert dev.sum() == 0
+
+
+def test_device_cc_default_connectivity_matches_cc3d_default():
+    """label_binary_device defaults to 26 like the host paths."""
+    rng = np.random.default_rng(3)
+    mask = rng.random((6, 10, 10)) > 0.6
+    host = cc.label_binary(mask, connectivity=26)
+    dev = np.asarray(cc.label_binary_device(mask))
+    assert _equivalent_labelings(host, dev)
+
+
+def test_device_cc_stays_on_device():
+    from chunkflow_tpu.chunk.base import Chunk
+
+    chunk = Chunk(np.asarray(
+        np.random.default_rng(0).random((4, 8, 8)), dtype=np.float32
+    ))
+    out = cc.connected_components(chunk, threshold=0.5, device=True)
+    assert out.is_on_device

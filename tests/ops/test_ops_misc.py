@@ -1,0 +1,89 @@
+"""Unit tests for the small jax/numpy ops modules."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.ops import filters, mask as mask_ops, remap, threshold, voting
+
+
+def test_maskout_upsampled_mask():
+    """Mask at a coarser mip multiplies through (reference mask.py:74-81)."""
+    chunk = Chunk(np.ones((4, 8, 8), np.float32), voxel_size=(40, 4, 4))
+    coarse = Chunk(
+        np.ones((4, 4, 4), np.uint8), voxel_size=(40, 8, 8)
+    )
+    coarse[:, 0, :] = 0  # zero a y-band at coarse resolution
+    out = mask_ops.maskout(chunk, coarse)
+    arr = np.asarray(out.array)
+    assert np.all(arr[:, 0:2, :] == 0)  # coarse band upsamples to fine 2 rows
+    assert np.all(arr[:, 2:, :] == 1)
+
+
+def test_maskout_inverse():
+    chunk = Chunk(np.ones((2, 2, 2), np.float32))
+    m = Chunk(np.zeros((2, 2, 2), np.uint8))
+    out = mask_ops.maskout(chunk, m, inverse=True)
+    assert np.all(np.asarray(out.array) == 1)
+
+
+def test_channel_voting_argmax_plus_one():
+    arr = np.zeros((3, 1, 2, 2), np.float32)
+    arr[0, 0, 0, 0] = 1.0
+    arr[1, 0, 0, 1] = 1.0
+    arr[2, 0, 1, 0] = 1.0
+    out = voting.channel_voting(Chunk(arr))
+    res = np.asarray(out.array)
+    assert res.shape == (1, 2, 2)
+    assert res[0, 0, 0] == 1 and res[0, 0, 1] == 2 and res[0, 1, 0] == 3
+
+
+def test_mask_using_last_channel():
+    arr = np.zeros((2, 1, 2, 2), np.float32)
+    arr[0] = 0.8
+    arr[1, 0, 0, 0] = 0.9  # myelin above threshold -> zero out
+    out = voting.mask_using_last_channel(Chunk(arr), threshold=0.5)
+    res = np.asarray(out.array)
+    assert res.shape == (1, 1, 2, 2)
+    assert res[0, 0, 0, 0] == 0.0
+    assert res[0, 0, 0, 1] == pytest.approx(0.8)
+
+
+def test_threshold_binary():
+    c = Chunk(np.asarray([[[0.2, 0.8]]], dtype=np.float32))
+    out = threshold.threshold(c, 0.5)
+    res = np.asarray(out.array)
+    assert res.dtype == np.uint8
+    assert res.tolist() == [[[0, 1]]]
+
+
+def test_gaussian_filter_2d_matches_scipy():
+    from scipy.ndimage import gaussian_filter
+
+    rng = np.random.default_rng(0)
+    arr = rng.random((3, 16, 16)).astype(np.float32)
+    out = filters.gaussian_filter_2d(Chunk(arr.copy()), sigma=1.0)
+    ref = np.stack([gaussian_filter(a, 1.0) for a in arr])
+    np.testing.assert_allclose(np.asarray(out.array), ref, atol=2e-2)
+
+
+def test_median_filter():
+    arr = np.zeros((1, 5, 5), np.float32)
+    arr[0, 2, 2] = 100.0  # salt noise removed by median
+    out = filters.median_filter(Chunk(arr), size=3)
+    assert np.asarray(out.array)[0, 2, 2] == 0.0
+
+
+def test_renumber_and_remap_roundtrip():
+    arr = np.array([[[0, 5, 5, 9]]], dtype=np.uint32)
+    renum, mapping = remap.renumber(arr)
+    assert set(np.unique(renum).tolist()) == {0, 1, 2}
+    back = remap.remap(renum, {v: k for k, v in mapping.items()})
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_unique_ids():
+    arr = np.array([0, 3, 3, 7], dtype=np.uint32)
+    ids = remap.unique_ids(arr)
+    assert set(np.asarray(ids).tolist()) == {3, 7}
+    ids, counts = remap.unique_ids(arr, return_counts=True)
+    assert dict(zip(ids.tolist(), counts.tolist())) == {3: 2, 7: 1}
