@@ -411,10 +411,18 @@ def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
 @click.option("--mip", type=int, default=None, help="defaults to global --mip")
 @cartesian_option("--expand-margin-size", "-e", default=(0, 0, 0))
 @click.option("--fill-missing/--no-fill-missing", default=True)
+@click.option("--blackout-sections/--no-blackout-sections", default=False,
+              help="zero z-sections listed in the volume's blackout_section_ids.json")
+@click.option("--validate-mip", type=int, default=None,
+              help="cross-check the cutout against a re-download at this coarser mip")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
-                         output_chunk_name):
-    """Cut out the task bbox (plus margins) from a precomputed volume."""
+                         blackout_sections, validate_mip, output_chunk_name):
+    """Cut out the task bbox (plus margins) from a precomputed volume.
+
+    Reference parity: LoadPrecomputedOperator incl. bad-section blackout
+    (load_precomputed.py:99-113) and cross-mip re-download validation
+    (load_precomputed.py:115-182)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
 
     vol = PrecomputedVolume(volume_path)
@@ -424,14 +432,65 @@ def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
         bbox = task["bbox"]
         if expand_margin_size and any(expand_margin_size):
             bbox = bbox.adjust(expand_margin_size)
-        task[output_chunk_name] = vol.cutout(
-            bbox,
-            mip=mip if mip is not None else state.mip,
-            fill_missing=fill_missing,
-        )
+        the_mip = mip if mip is not None else state.mip
+        chunk = vol.cutout(bbox, mip=the_mip, fill_missing=fill_missing)
+        # validate the RAW cutout; blackout intentionally zeroes data and
+        # must not trigger mismatch warnings
+        if validate_mip is not None and not state.dry_run:
+            _validate_cutout(vol, chunk, the_mip, validate_mip)
+        if blackout_sections:
+            sidecar = vol.read_json("blackout_section_ids.json") or {}
+            z0 = int(chunk.voxel_offset.z)
+            nz = chunk.shape[-3]
+            for z in sidecar.get("section_ids", ()):
+                if z0 <= z < z0 + nz:
+                    chunk[..., z - z0, :, :] = 0
+        task[output_chunk_name] = chunk
         return task
 
     return stage(_name="load-precomputed")
+
+
+def _validate_cutout(vol, chunk, mip, validate_mip):
+    """Mean-pool the cutout to ``validate_mip`` and compare with a direct
+    coarse-mip read of the same window; print a warning on mismatch."""
+    from chunkflow_tpu.core.bbox import BoundingBox
+    from chunkflow_tpu.ops.downsample import downsample_average
+
+    if not (mip < validate_mip < vol.num_mips):
+        raise ValueError(
+            f"--validate-mip {validate_mip} must be coarser than the load "
+            f"mip {mip} and exist in the volume ({vol.num_mips} mips)"
+        )
+    factor = tuple(
+        int(c // f)
+        for c, f in zip(vol.voxel_size(validate_mip), vol.voxel_size(mip))
+    )
+    # crop to a window whose offset AND extent are factor-aligned, so the
+    # pooled grid coincides exactly with the coarse mip's voxel grid
+    offset = tuple(int(o) for o in chunk.voxel_offset)
+    skip = tuple((-o) % f for o, f in zip(offset, factor))
+    spatial = chunk.shape[-3:]
+    aligned = tuple(
+        (s - k) - (s - k) % f for s, k, f in zip(spatial, skip, factor)
+    )
+    if any(a < f for a, f in zip(aligned, factor)):
+        return  # window too small to compare
+    sub = chunk.cutout(BoundingBox(
+        tuple(o + k for o, k in zip(offset, skip)),
+        tuple(o + k + a for o, k, a in zip(offset, skip, aligned)),
+    ))
+    pooled = downsample_average(sub, factor=factor)
+    ref = vol.cutout(pooled.bbox, mip=validate_mip, fill_missing=True)
+    a = np.asarray(pooled.array, dtype=np.float64)
+    b = np.asarray(ref.array, dtype=np.float64)
+    err = float(np.abs(a - b).mean())
+    scale = max(float(np.abs(b).mean()), 1e-6)
+    if err / scale > 0.5:
+        print(
+            f"WARNING: cross-mip validation mismatch (mip {mip} vs "
+            f"{validate_mip}): mean|diff|={err:.4f} vs mean|ref|={scale:.4f}"
+        )
 
 
 @main.command("save-precomputed")

@@ -76,6 +76,24 @@ class PrecomputedVolume:
                 self._info = json.loads(kv.read("info").result().value)
         return self._info
 
+    def read_json(self, name: str):
+        """Read a JSON sidecar file from the volume root (e.g.
+        blackout_section_ids.json); None if absent."""
+        local = _local_root(self.path)
+        if local is not None:
+            p = os.path.join(local, name)
+            if not os.path.exists(p):
+                return None
+            with open(p) as f:
+                return json.load(f)
+        import tensorstore as ts
+
+        kv = ts.KvStore.open(self.kvstore).result()
+        result = kv.read(name).result()
+        if not result.value:
+            return None
+        return json.loads(result.value)
+
     @property
     def num_mips(self) -> int:
         return len(self.info["scales"])

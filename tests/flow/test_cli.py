@@ -275,3 +275,60 @@ def test_view_screenshot(runner, tmp_path):
     import os
 
     assert os.path.exists(shot)
+
+
+def test_load_precomputed_blackout_and_validate(runner, tmp_path):
+    """blackout_section_ids.json zeroes sections; cross-mip validation runs."""
+    import json
+
+    from chunkflow_tpu.chunk import Chunk
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    chunk = Chunk.create((8, 16, 16), dtype=np.uint8, pattern="sin")
+    vol = PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4),
+    )
+    vol.save(chunk, mip=0)
+    (root / "blackout_section_ids.json").write_text(
+        json.dumps({"section_ids": [2, 5]})
+    )
+
+    out = tmp_path / "out.h5"
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16",
+        "load-precomputed", "-v", str(root), "--blackout-sections",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    loaded = np.asarray(Chunk.from_h5(str(out)).array)
+    assert loaded[2].sum() == 0 and loaded[5].sum() == 0
+    assert loaded[0].sum() > 0
+
+
+def test_load_precomputed_cross_mip_validation(runner, tmp_path, capsys):
+    """--validate-mip re-downloads at the coarse mip and compares."""
+    from chunkflow_tpu.chunk import Chunk
+    from chunkflow_tpu.ops.downsample import downsample_average
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol2"
+    chunk = Chunk.create((8, 16, 16), dtype=np.uint8, pattern="sin")
+    vol = PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4), num_mips=2, block_size=(8, 8, 8),
+    )
+    vol.save(chunk, mip=0)
+    vol.save(downsample_average(chunk, factor=(1, 2, 2)), mip=1)
+
+    out = tmp_path / "out2.h5"
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16",
+        "load-precomputed", "-v", str(root), "--validate-mip", "1",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    assert "WARNING: cross-mip validation mismatch" not in result.output

@@ -108,3 +108,31 @@ def test_converter_mismatch_raises():
     )["params"]
     with pytest.raises(ValueError, match="do not mirror|shape mismatch"):
         torch_to_flax({}, template)
+
+
+def test_tpu_variant_bf16_through_inferencer():
+    """The flagship (space-to-depth, bfloat16) runs through the fused
+    program — the exact path bench.py measures, at toy sizes."""
+    import numpy as np
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="flax",
+        batch_size=2,
+        dtype="bfloat16",
+        model_variant="tpu",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    out = inferencer(chunk)
+    arr = np.asarray(out.array)
+    assert arr.shape == (3, 8, 32, 32)
+    assert np.isfinite(arr).all()
+    assert arr.std() > 0
+    assert arr.dtype == np.float32
