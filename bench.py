@@ -36,14 +36,31 @@ OUTPUT_OVERLAP = (4, 64, 64)
 NUM_OUT = 3
 
 CONFIGS = [
-    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4},
-    {"model_variant": "parity", "dtype": "float32", "batch_size": 2},
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "1"},
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0"},
+    {"model_variant": "parity", "dtype": "float32", "batch_size": 2,
+     "pallas": "0"},
 ]
 
 
+def _wants_pallas(cfg: dict) -> bool:
+    return cfg.get("pallas", "0").lower() not in ("0", "off", "false", "")
+
+
 def run_config(cfg: dict) -> float:
+    os.environ["CHUNKFLOW_PALLAS"] = cfg.get("pallas", "0")
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference import Inferencer
+    from chunkflow_tpu.ops.pallas_blend import pallas_mode
+
+    if _wants_pallas(cfg):
+        if pallas_mode() == "off":
+            # non-TPU backend: this config would silently run the XLA path
+            # and misattribute its numbers to the pallas kernel
+            raise RuntimeError("pallas requested but unavailable on this backend")
+        _check_pallas_oracle()
 
     rng = np.random.default_rng(0)
     chunk = Chunk(rng.random(CHUNK_SIZE, dtype=np.float32))
@@ -74,6 +91,29 @@ def run_config(cfg: dict) -> float:
     return float(np.prod(CHUNK_SIZE)) / min(times) / 1e6
 
 
+def _check_pallas_oracle():
+    """Identity-engine oracle at toy size: catches a miscompiled pallas
+    scatter kernel (wrong results, not just crashes) before it can taint
+    the measured config."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(1)
+    chunk = rng.random((8, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    mse = float(((out - chunk[None]) ** 2).mean())
+    if mse > 1e-8:
+        raise RuntimeError(f"pallas identity oracle failed: MSE={mse}")
+
+
 def main():
     configs = CONFIGS
     if os.environ.get("CHUNKFLOW_BENCH_VARIANT"):
@@ -81,6 +121,7 @@ def main():
             "model_variant": os.environ["CHUNKFLOW_BENCH_VARIANT"],
             "dtype": os.environ.get("CHUNKFLOW_BENCH_DTYPE", "bfloat16"),
             "batch_size": int(os.environ.get("CHUNKFLOW_BENCH_BATCH", "4")),
+            "pallas": os.environ.get("CHUNKFLOW_PALLAS", "0"),
         }]
     last_error = None
     for cfg in configs:
@@ -99,7 +140,7 @@ def main():
                     "vs_baseline": round(mvox_s / BASELINE_MVOX_S, 2),
                     "config": (
                         f"{cfg['model_variant']}-{cfg['dtype']}-"
-                        f"bs{cfg['batch_size']}"
+                        f"bs{cfg['batch_size']}-pallas{cfg.get('pallas', '0')}"
                     ),
                 }
             )

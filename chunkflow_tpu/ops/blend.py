@@ -34,6 +34,10 @@ def build_local_blend(
     pout = tuple(output_patch_size)
     bump = jnp.asarray(bump)
 
+    from chunkflow_tpu.ops import pallas_blend
+
+    mode = pallas_blend.pallas_mode()
+
     def local_blend(chunk, in_starts, out_starts, valid, params):
         zyx = chunk.shape[1:]
         num_batches = in_starts.shape[0] // batch_size
@@ -55,6 +59,14 @@ def build_local_blend(
             preds = forward(params, patches)
             weighted = preds * bump[None, None] * v[:, None, None, None, None]
             wpatch = bump[None] * v[:, None, None, None]
+
+            if mode != "off":
+                # pallas scatter-accumulate: in-place HBM tiles via DMA
+                out, weight = pallas_blend.accumulate_patches(
+                    out, weight, weighted, wpatch, s_out,
+                    interpret=(mode == "interpret"),
+                )
+                return (out, weight), None
 
             def blend_one(j, ow):
                 out, weight = ow

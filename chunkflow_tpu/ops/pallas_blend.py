@@ -1,0 +1,121 @@
+"""Pallas TPU kernel: bump-weighted patch accumulation into the chunk buffer.
+
+The fused inference program's scatter-add (ops/blend.py) is, per patch, a
+read-modify-write of a [co, *pout] region of the HBM-resident output buffer
+plus the same for the weight buffer. The XLA path expresses it as
+``fori_loop`` + ``dynamic_update_slice``; this kernel does the same job as
+one ``pallas_call`` over a (B, co, pz) grid with explicit HBM<->VMEM DMAs:
+
+- the output/weight buffers stay in HBM (``pl.ANY``) and are aliased
+  in-place (``input_output_aliases``), so no full-buffer copies;
+- per grid step one (py, px) tile rides DMA into VMEM scratch, the
+  pre-weighted prediction tile is added (the multiply happened on the VPU
+  as part of the producing fusion), and the tile rides back;
+- the TPU grid is sequential, so overlapping patches accumulate without
+  races — exactly the property the reference gets from its Python loop
+  (chunk/base.py:792-807) and the XLA path gets from ``fori_loop``.
+
+Selection: ``blend.build_local_blend`` uses this kernel on TPU backends
+(opt out with CHUNKFLOW_PALLAS=0); tests run it in interpret mode on CPU
+(CHUNKFLOW_PALLAS=interpret).
+"""
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+Triple = Tuple[int, int, int]
+
+
+def pallas_mode() -> str:
+    """'on' | 'off' | 'interpret' — resolved from env + backend."""
+    env = os.environ.get("CHUNKFLOW_PALLAS", "").lower()
+    if env in ("0", "off", "false"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    import jax
+
+    return "on" if jax.default_backend() == "tpu" else "off"
+
+
+def accumulate_patches(out, weight, preds, wpatches, out_starts,
+                       interpret: bool = False):
+    """out[:, s:s+p] += preds[b]; weight[s:s+p] += wpatches[b] for every b.
+
+    out:      [co, Z, Y, X] f32   (donated, updated in place)
+    weight:   [Z, Y, X] f32       (donated, updated in place)
+    preds:    [B, co, pz, py, px] f32, already bump*validity weighted
+    wpatches: [B, pz, py, px] f32
+    out_starts: [B, 3] int32 zyx corners (within-bounds, batch-padded)
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, co, pz, py, px = preds.shape
+
+    def kernel(starts_ref, preds_ref, wpatch_ref, out_in, w_in, out_ref,
+               w_ref, scratch, sem_in, sem_out):
+        b = pl.program_id(0)
+        c = pl.program_id(1)
+        k = pl.program_id(2)
+        z0 = starts_ref[b, 0]
+        y0 = starts_ref[b, 1]
+        x0 = starts_ref[b, 2]
+
+        tile = out_ref.at[c, z0 + k, pl.ds(y0, py), pl.ds(x0, px)]
+        load = pltpu.make_async_copy(tile, scratch, sem_in)
+        load.start()
+        load.wait()
+        scratch[:] = scratch[:] + preds_ref[0, 0, 0]
+        store = pltpu.make_async_copy(scratch, tile, sem_out)
+        store.start()
+        store.wait()
+
+        @pl.when(c == 0)
+        def _():
+            wtile = w_ref.at[z0 + k, pl.ds(y0, py), pl.ds(x0, px)]
+            wload = pltpu.make_async_copy(wtile, scratch, sem_in)
+            wload.start()
+            wload.wait()
+            scratch[:] = scratch[:] + wpatch_ref[0, 0]
+            wstore = pltpu.make_async_copy(scratch, wtile, sem_out)
+            wstore.start()
+            wstore.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, co, pz),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 1, py, px), lambda b, c, k, starts: (b, c, k, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, py, px), lambda b, c, k, starts: (b, k, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((py, px), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(out.shape, out.dtype),
+            jax.ShapeDtypeStruct(weight.shape, weight.dtype),
+        ],
+        # tensor inputs (after the scalar-prefetch arg): preds, wpatches,
+        # out, weight -> indices 1..4; alias out->output0, weight->output1
+        input_output_aliases={3: 0, 4: 1},
+        interpret=interpret,
+    )(out_starts, preds, wpatches, out, weight)
