@@ -45,21 +45,20 @@ CONFIGS = [
 ]
 
 
-def _wants_pallas(cfg: dict) -> bool:
-    return cfg.get("pallas", "0").lower() not in ("0", "off", "false", "")
-
-
 def run_config(cfg: dict) -> float:
     os.environ["CHUNKFLOW_PALLAS"] = cfg.get("pallas", "0")
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference import Inferencer
     from chunkflow_tpu.ops.pallas_blend import pallas_mode
 
-    if _wants_pallas(cfg):
-        if pallas_mode() == "off":
-            # non-TPU backend: this config would silently run the XLA path
-            # and misattribute its numbers to the pallas kernel
-            raise RuntimeError("pallas requested but unavailable on this backend")
+    # single source of truth for whether the kernel will actually run
+    effective = pallas_mode()
+    wants = cfg.get("pallas", "0").lower() not in ("0", "off", "false")
+    if wants and effective == "off":
+        # non-TPU backend: this config would silently run the XLA path
+        # and misattribute its numbers to the pallas kernel
+        raise RuntimeError("pallas requested but unavailable on this backend")
+    if effective != "off":
         _check_pallas_oracle()
 
     rng = np.random.default_rng(0)

@@ -40,7 +40,10 @@ def cartesian_option(*names, default=None, required=False, help=""):
 @click.option("--mip", type=int, default=0, help="storage hierarchy level")
 @click.option("--dry-run/--real-run", default=False)
 @click.option("--verbose", "-v", count=True)
-def main(mip, dry_run, verbose):
+@click.option("--profile-dir", type=str, default=None,
+              help="write a jax profiler trace of the whole pipeline here "
+                   "(view with tensorboard or xprof)")
+def main(mip, dry_run, verbose, profile_dir):
     """chunkflow-tpu: compose chunk operators into a pipeline."""
     state.mip = mip
     state.dry_run = dry_run
@@ -48,8 +51,18 @@ def main(mip, dry_run, verbose):
 
 
 @main.result_callback()
-def run_pipeline(stages, mip, dry_run, verbose):
-    count = process_stream(stages, verbose=verbose)
+def run_pipeline(stages, mip, dry_run, verbose, profile_dir):
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+    try:
+        count = process_stream(stages, verbose=verbose)
+    finally:
+        if profile_dir:
+            import jax
+
+            jax.profiler.stop_trace()
     if verbose:
         print(f"pipeline drained {count} task(s)")
 
@@ -327,12 +340,25 @@ def load_h5_cmd(file_name, dataset_path, output_chunk_name, voxel_offset):
 
 
 @main.command("save-h5")
-@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--file-name", "-f", type=str, default=None)
+@click.option("--file-name-prefix", type=str, default=None,
+              help="write one file per task: <prefix><bbox-string>.h5")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_h5_cmd(file_name, input_chunk_name):
+def save_h5_cmd(file_name, file_name_prefix, input_chunk_name):
+    if (file_name is None) == (file_name_prefix is None):
+        raise click.UsageError(
+            "save-h5 needs exactly one of --file-name / --file-name-prefix"
+        )
+
     @operator
     def stage(task):
-        task[input_chunk_name].to_h5(file_name)
+        chunk = task[input_chunk_name]
+        if file_name_prefix is not None:
+            bbox = task.get("bbox") or chunk.bbox
+            path = f"{file_name_prefix}{bbox.string}.h5"
+        else:
+            path = file_name
+        chunk.to_h5(path)
         return task
 
     return stage(_name="save-h5")

@@ -1,0 +1,63 @@
+"""Multi-host bring-up: jax.distributed + the queue-of-bboxes design.
+
+Cross-host design (SURVEY §5.8): chunkflow's workers never talk to each
+other — they share only a task queue and object storage, which is the
+right architecture for inference and is preserved here. Within one host's
+TPU slice, the fused inference program scales over chips with shard_map
+(parallel/distributed.py, parallel/spatial.py); across hosts there is NO
+tensor traffic, only task leases. So the distributed "backend" is:
+
+- ICI collectives (psum/ppermute) inside a slice — compiled by XLA;
+- this module's ``initialize()`` to join a multi-host jax runtime when a
+  single program spans hosts (e.g. a v5e-16 pod slice where the mesh
+  covers all hosts' chips);
+- the queue (parallel/queues.py: memory/file/SQS) for host-level work
+  distribution, exactly like the reference's SQS deployment
+  (lib/aws/sqs_queue.py), including visibility-timeout recovery.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host jax runtime (idempotent).
+
+    With no arguments, jax auto-detects TPU pod metadata (the normal case
+    on Cloud TPU VMs). Explicit args support SLURM-style bring-up: reads
+    ``SLURM_PROCID`` / ``SLURM_NTASKS`` when present and args are omitted.
+    """
+    import jax
+
+    if jax._src.distributed.global_state.client is not None:  # already up
+        return
+    if coordinator_address is None and "SLURM_PROCID" in os.environ:
+        process_id = int(os.environ["SLURM_PROCID"])
+        num_processes = int(os.environ["SLURM_NTASKS"])
+        coordinator_address = os.environ.get("CHUNKFLOW_COORDINATOR")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis: str = "data"):
+    """A mesh over every chip of every host in the initialized runtime."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0

@@ -332,3 +332,14 @@ def test_load_precomputed_cross_mip_validation(runner, tmp_path, capsys):
     ])
     assert result.exit_code == 0, result.output
     assert "WARNING: cross-mip validation mismatch" not in result.output
+
+
+def test_profile_dir_writes_trace(runner, tmp_path):
+    trace_dir = tmp_path / "trace"
+    result = runner.invoke(main, [
+        "--profile-dir", str(trace_dir),
+        "create-chunk", "--size", "4", "8", "8",
+        "threshold", "--threshold", "0.5",
+    ])
+    assert result.exit_code == 0, result.output
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))
