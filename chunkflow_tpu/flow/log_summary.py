@@ -50,6 +50,9 @@ def summarize(records: List[dict], output_size=None) -> "object":
             row["_voxels"] = record["_bbox"].voxel_count
         elif output_size is not None:
             row["_voxels"] = int(np.prod(output_size))
+        if row.get("_voxels") and row["_total"] > 0:
+            # the canonical metric (reference log_summary.py:69-71)
+            row["_mvoxel_per_s"] = row["_voxels"] / row["_total"] / 1e6
         rows.append(row)
     frame = pd.DataFrame(rows)
     grouped = frame.groupby("compute_device")

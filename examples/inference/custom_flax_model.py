@@ -1,0 +1,29 @@
+"""Example --model-path file: a custom Flax model for the flax engine.
+
+Usage:
+    chunkflow ... inference --framework flax \
+        --model-path examples/inference/custom_flax_model.py \
+        --input-patch-size 16 128 128 ...
+
+The file must expose ``create_model(num_input_channels,
+num_output_channels)`` returning a Flax module mapping NDHWC -> NDHWC.
+(Engine contract: chunkflow_tpu/inference/engines.py:create_flax_engine.)
+"""
+import flax.linen as nn
+import jax
+
+
+class TinyNet(nn.Module):
+    out_channels: int
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(16, (3, 3, 3), padding="SAME")(x)
+        x = nn.elu(x)
+        x = nn.Conv(self.out_channels, (3, 3, 3), padding="SAME")(x)
+        return jax.nn.sigmoid(x)
+
+
+def create_model(num_input_channels, num_output_channels):
+    del num_input_channels  # inferred from the input by flax
+    return TinyNet(out_channels=num_output_channels)

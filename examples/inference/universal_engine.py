@@ -1,0 +1,34 @@
+"""Example --model-path file for the universal engine.
+
+Usage:
+    chunkflow ... inference --framework universal \
+        --model-path examples/inference/universal_engine.py ...
+
+Contract (chunkflow_tpu/inference/engines.py:create_universal_engine,
+reference patch/universal.py): expose
+``create_engine(weight_path, input_patch_size, output_patch_size,
+num_input_channels, num_output_channels) -> (params, apply)`` where
+``apply(params, batch)`` maps [B, Ci, *pin] -> [B, Co, *pout] in jax.
+This one inverts intensities and center-crops — any jax-traceable code
+works, including wrapping models from other ecosystems.
+"""
+import jax.numpy as jnp
+
+
+def create_engine(weight_path, input_patch_size, output_patch_size,
+                  num_input_channels, num_output_channels):
+    del weight_path
+    margin = tuple(
+        (i - o) // 2 for i, o in zip(input_patch_size, output_patch_size)
+    )
+
+    def apply(params, batch):
+        sl = (slice(None), slice(0, 1)) + tuple(
+            slice(m, m + o) for m, o in zip(margin, output_patch_size)
+        )
+        center = 1.0 - batch[sl]
+        return jnp.broadcast_to(
+            center, (batch.shape[0], num_output_channels) + tuple(output_patch_size)
+        )
+
+    return (), apply

@@ -1,0 +1,54 @@
+"""The shipped examples/ files run through the real CLI."""
+import os
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from chunkflow_tpu.chunk import Chunk
+from chunkflow_tpu.flow.cli import main
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "examples", "inference",
+)
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_custom_flax_model_example(runner, tmp_path):
+    out = tmp_path / "out.h5"
+    result = runner.invoke(main, [
+        "create-chunk", "--size", "8", "32", "32", "--pattern", "random",
+        "inference", "--framework", "flax",
+        "--model-path", os.path.join(EXAMPLES, "custom_flax_model.py"),
+        "--input-patch-size", "4", "16", "16",
+        "--output-patch-overlap", "2", "8", "8",
+        "--num-output-channels", "3", "--no-crop-output-margin",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    arr = np.asarray(Chunk.from_h5(str(out)).array)
+    assert arr.shape == (3, 8, 32, 32)
+    assert np.isfinite(arr).all() and arr.std() > 0
+
+
+def test_universal_engine_example(runner, tmp_path):
+    out = tmp_path / "out.h5"
+    result = runner.invoke(main, [
+        "create-chunk", "--size", "8", "32", "32", "--pattern", "random",
+        "--dtype", "float32",
+        "inference", "--framework", "universal",
+        "--model-path", os.path.join(EXAMPLES, "universal_engine.py"),
+        "--input-patch-size", "4", "16", "16",
+        "--output-patch-overlap", "2", "8", "8",
+        "--num-output-channels", "1", "--no-crop-output-margin",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    arr = np.asarray(Chunk.from_h5(str(out)).array)
+    assert arr.shape == (1, 8, 32, 32)
+    assert np.isfinite(arr).all()

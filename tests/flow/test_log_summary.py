@@ -1,0 +1,42 @@
+"""log-summary: per-device aggregation + Mvoxel/s (reference
+flow/log_summary.py:57-75 semantics)."""
+import json
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.flow import log_summary
+
+
+@pytest.fixture
+def log_dir(tmp_path):
+    d = tmp_path / "log"
+    d.mkdir()
+    # two tasks on one device, one on another; bbox-coded filenames
+    specs = [
+        ("0-8_0-16_0-16.json", "tpu:v5e", {"load": 1.0, "inference": 3.0}),
+        ("8-16_0-16_0-16.json", "tpu:v5e", {"load": 2.0, "inference": 5.0}),
+        ("16-24_0-16_0-16.json", "cpu:x86", {"load": 4.0, "inference": 16.0}),
+    ]
+    for name, device, timer in specs:
+        (d / name).write_text(json.dumps({
+            "timer": timer, "compute_device": device,
+        }))
+    return str(d)
+
+
+def test_load_and_summarize(log_dir):
+    records = log_summary.load_log_dir(log_dir)
+    assert len(records) == 3
+    assert all(r["_bbox"] is not None for r in records)
+
+    frame = log_summary.summarize(records)
+    # grouped by device: v5e mean total = (4 + 7) / 2 = 5.5; cpu total = 20
+    v5e = frame.loc["tpu:v5e"]
+    cpu = frame.loc["cpu:x86"]
+    assert v5e[("_total", "mean")] == pytest.approx(5.5)
+    assert cpu[("_total", "mean")] == pytest.approx(20.0)
+    # Mvoxel/s = voxels / mean_seconds / 1e6; bbox voxels = 8*16*16 = 2048
+    assert v5e[("_mvoxel_per_s", "mean")] == pytest.approx(
+        np.mean([2048 / 4 / 1e6, 2048 / 7 / 1e6])
+    )
