@@ -198,6 +198,16 @@ class SQSQueue(QueueBase):
         if not messages:
             return None
         msg = messages[0]
+        # transport integrity check (reference sqs_queue.py:95-100)
+        expected = msg.get("MD5OfBody")
+        if expected:
+            import hashlib
+
+            got = hashlib.md5(msg["Body"].encode()).hexdigest()
+            if got != expected:
+                raise IOError(
+                    f"SQS body md5 mismatch: got {got}, expected {expected}"
+                )
         return msg["ReceiptHandle"], msg["Body"]
 
     def delete(self, handle: str) -> None:

@@ -1,0 +1,63 @@
+"""Coordination service: ID ranges + hierarchical task scheduling over HTTP."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.parallel.restapi import CoordinationService, serve
+from chunkflow_tpu.parallel.task_tree import SpatialTaskTree
+
+
+def make_tree():
+    return SpatialTaskTree(BoundingBox((0, 0, 0), (4, 8, 8)), (4, 4, 4))
+
+
+def test_handle_objids_and_tasks():
+    svc = CoordinationService(id_start=100, task_tree=make_tree())
+    status, payload = svc.handle("GET", "/objids/10")
+    assert status == 200 and payload["base_id"] == 100
+    status, payload = svc.handle("GET", "/objids/5")
+    assert payload["base_id"] == 110
+
+    # drain leaves, completing each; parents become ready then complete
+    done = 0
+    while True:
+        status, payload = svc.handle("GET", "/task")
+        if status == 204:
+            break
+        assert status == 200
+        status, result = svc.handle("POST", f"/task/{payload['bbox']}/done")
+        assert status == 200
+        done += 1
+        if result["all_done"]:
+            break
+    assert done >= 4  # 4 leaves + internal nodes
+
+
+def test_handle_unknown_and_unclaimed():
+    svc = CoordinationService(task_tree=make_tree())
+    assert svc.handle("GET", "/nope")[0] == 404
+    assert svc.handle("POST", "/task/0-4_0-4_0-4/done")[0] == 404
+
+
+def test_http_server_roundtrip():
+    svc = CoordinationService(id_start=0, task_tree=make_tree())
+    server, _thread = serve(svc, host="127.0.0.1", port=0, background=True)
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/objids/7"
+        ) as resp:
+            assert json.loads(resp.read())["base_id"] == 0
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/task") as resp:
+            body = json.loads(resp.read())
+            assert "bbox" in body
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/task/{body['bbox']}/done", method="POST"
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+    finally:
+        server.shutdown()
