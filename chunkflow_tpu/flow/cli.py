@@ -1355,8 +1355,11 @@ def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
 @click.option("--ids", type=str, default=None, help="comma-separated object ids (default: all)")
 @click.option("--skip-ids", type=str, default=None)
 @click.option("--manifest/--no-manifest", default=False)
+@click.option("--simplification-error", type=float, default=0.0,
+              help="max geometric error in nm for vertex-clustering simplification (0 = off)")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def mesh_cmd(output_path, output_format, ids, skip_ids, manifest, input_chunk_name):
+def mesh_cmd(output_path, output_format, ids, skip_ids, manifest,
+             simplification_error, input_chunk_name):
     """Mesh every object of a segmentation chunk (surface nets)."""
     from chunkflow_tpu.flow.mesh import MeshOperator
 
@@ -1366,6 +1369,7 @@ def mesh_cmd(output_path, output_format, ids, skip_ids, manifest, input_chunk_na
         ids=[int(x) for x in ids.split(",")] if ids else None,
         skip_ids=tuple(int(x) for x in skip_ids.split(",")) if skip_ids else (),
         manifest=manifest,
+        simplification_error_nm=simplification_error,
     )
 
     @operator

@@ -343,3 +343,24 @@ def test_profile_dir_writes_trace(runner, tmp_path):
     ])
     assert result.exit_code == 0, result.output
     assert trace_dir.exists() and any(trace_dir.rglob("*"))
+
+
+def test_mesh_simplification():
+    """Vertex clustering cuts vertex count, preserves manifoldness basics."""
+    from chunkflow_tpu.flow.mesh import mesh_chunk, simplify_mesh
+    from chunkflow_tpu.chunk import Segmentation
+
+    seg = np.zeros((16, 16, 16), dtype=np.uint32)
+    seg[2:14, 2:14, 2:14] = 1
+    meshes = mesh_chunk(Segmentation(seg, voxel_size=(1, 1, 1)))
+    vertices, faces = meshes[1]
+    sv, sf = simplify_mesh(vertices, faces, cell_size=4.0)
+    assert sv.shape[0] < vertices.shape[0]
+    assert sf.shape[0] < faces.shape[0]
+    assert sf.max() < sv.shape[0]
+    # bounding box roughly preserved (within one cell)
+    assert np.allclose(sv.min(0), vertices.min(0), atol=4.0)
+    assert np.allclose(sv.max(0), vertices.max(0), atol=4.0)
+    # no-op when cell_size=0
+    v0, f0 = simplify_mesh(vertices, faces, cell_size=0.0)
+    assert v0.shape == vertices.shape and f0.shape == faces.shape
