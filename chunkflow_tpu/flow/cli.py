@@ -967,13 +967,20 @@ def copy_var_cmd(from_name, to_name):
     "--model-variant", type=click.Choice(["parity", "tpu"]), default="parity",
     help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
 )
+@click.option(
+    "--sharding", type=click.Choice(["none", "patch", "spatial"]),
+    default="none",
+    help="multi-chip execution over all local devices: patch-parallel "
+         "(psum merge) or spatially-sharded chunk (ring halo exchange)",
+)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
                   num_output_channels, num_input_channels, framework,
                   model_path, weight_path, batch_size, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
-                  model_variant, input_chunk_name, output_chunk_name):
+                  model_variant, sharding, input_chunk_name,
+                  output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -993,6 +1000,7 @@ def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
         mask_myelin_threshold=mask_myelin_threshold,
         dtype=dtype,
         model_variant=model_variant,
+        sharding=sharding,
         dry_run=state.dry_run,
     )
 

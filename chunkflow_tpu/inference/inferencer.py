@@ -58,6 +58,7 @@ class Inferencer:
         dtype: str = "float32",
         model_variant: str = "parity",
         engine=None,
+        sharding: str = "none",
         dry_run: bool = False,
     ):
         self.input_patch_size = Cartesian.from_collection(input_patch_size)
@@ -76,6 +77,12 @@ class Inferencer:
         self.mask_myelin_threshold = mask_myelin_threshold
         self.dry_run = dry_run
         self.framework = framework
+        if sharding not in ("none", "patch", "spatial"):
+            raise ValueError(f"unknown sharding mode {sharding!r}")
+        self.sharding = sharding
+        self._mesh = None
+        self._sharded_program = None
+        self._spatial_programs = {}
         if bump != "wu":
             raise ValueError(f"only the 'wu' bump is implemented, got {bump!r}")
         if augment and (
@@ -163,6 +170,93 @@ class Inferencer:
         return jax.jit(program)
 
     # ------------------------------------------------------------------
+    def _mesh_or_build(self):
+        if self._mesh is None:
+            from chunkflow_tpu.parallel.distributed import make_mesh
+
+            self._mesh = make_mesh()
+        return self._mesh
+
+    def _run_sharded(self, arr, grid):
+        """Multi-chip execution over all local devices.
+
+        'patch': chunk replicated, patch batches sharded, psum merge
+        (parallel/distributed.py). 'spatial': chunk sharded along y with
+        ring halo/spill exchange (parallel/spatial.py). Programs are built
+        once (jit re-specializes per input shape and caches).
+        """
+        import jax.numpy as jnp
+
+        from chunkflow_tpu.inference.patching import pad_to_batch
+
+        mesh = self._mesh_or_build()
+        n_dev = mesh.devices.size
+
+        if self.sharding == "patch":
+            from chunkflow_tpu.parallel.distributed import (
+                build_sharded_program,
+            )
+
+            if self._sharded_program is None:
+                self._sharded_program = build_sharded_program(
+                    self._forward,
+                    self.num_input_channels,
+                    self.num_output_channels,
+                    tuple(self.input_patch_size),
+                    tuple(self.output_patch_size),
+                    self.batch_size,
+                    mesh,
+                    bump_map(tuple(self.output_patch_size)),
+                )
+            in_starts, out_starts, valid = pad_to_batch(
+                grid, self.batch_size * n_dev
+            )
+            return self._sharded_program(
+                arr,
+                jnp.asarray(in_starts),
+                jnp.asarray(out_starts),
+                jnp.asarray(valid),
+                self._device_params,
+            )
+
+        # spatial sharding: static geometry depends on the slab height
+        from chunkflow_tpu.parallel.spatial import (
+            build_spatial_program,
+            partition_patches,
+            spatial_geometry,
+        )
+
+        pin, pout = tuple(self.input_patch_size), tuple(self.output_patch_size)
+        slab, halo_left, halo_right, spill = spatial_geometry(
+            arr.shape[-2], n_dev, pin, pout
+        )
+        if slab not in self._spatial_programs:
+            self._spatial_programs[slab] = build_spatial_program(
+                self._forward,
+                self.num_input_channels,
+                self.num_output_channels,
+                pin,
+                pout,
+                self.batch_size,
+                mesh,
+                bump_map(tuple(self.output_patch_size)),
+                slab,
+                halo_left,
+                halo_right,
+                spill,
+            )
+        dev_in, dev_out, dev_valid = partition_patches(
+            grid, n_dev, slab, self.batch_size, halo_left
+        )
+        return self._spatial_programs[slab](
+            arr,
+            jnp.asarray(dev_in),
+            jnp.asarray(dev_out),
+            jnp.asarray(dev_valid),
+            self._device_params,
+        )
+
+    # ------------------------------------------------------------------
     def __call__(self, chunk: Chunk) -> Chunk:
         import jax
         import jax.numpy as jnp
@@ -196,7 +290,6 @@ class Inferencer:
             self.output_patch_size,
             self.output_patch_overlap,
         )
-        in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
 
         arr = chunk.array
         if not chunk.is_on_device:
@@ -210,18 +303,22 @@ class Inferencer:
         if arr.ndim == 3:
             arr = arr[None]
 
-        if self._program is None:
-            self._program = self._build_program()
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)
 
-        result = self._program(
-            arr,
-            jnp.asarray(in_starts),
-            jnp.asarray(out_starts),
-            jnp.asarray(valid),
-            self._device_params,
-        )
+        if self.sharding == "none":
+            in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
+            if self._program is None:
+                self._program = self._build_program()
+            result = self._program(
+                arr,
+                jnp.asarray(in_starts),
+                jnp.asarray(out_starts),
+                jnp.asarray(valid),
+                self._device_params,
+            )
+        else:
+            result = self._run_sharded(arr, grid)
         result.block_until_ready()
 
         out = Chunk(

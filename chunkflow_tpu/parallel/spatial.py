@@ -32,6 +32,26 @@ import numpy as np
 Triple = Tuple[int, int, int]
 
 
+def spatial_geometry(y: int, n_devices: int, pin: Triple, pout: Triple):
+    """(slab, halo_left, halo_right, spill) for y-sharding, with guards.
+
+    Single source of the halo math for both Inferencer(--sharding spatial)
+    and spatial_sharded_inference."""
+    if y % n_devices:
+        raise ValueError(f"y={y} must divide over {n_devices} devices")
+    slab = y // n_devices
+    margin_y = (pin[1] - pout[1]) // 2
+    halo_left = margin_y
+    halo_right = pin[1] - margin_y
+    spill = pout[1]
+    if max(halo_left, halo_right, spill) > slab:
+        raise ValueError(
+            f"slab {slab} too thin for halo {(halo_left, halo_right)} / "
+            f"spill {spill}; use fewer devices or a bigger chunk"
+        )
+    return slab, halo_left, halo_right, spill
+
+
 def partition_patches(
     grid,
     n_devices: int,
@@ -196,21 +216,9 @@ def spatial_sharded_inference(
     if arr.ndim == 3:
         arr = arr[None]
     c, z, y, x = arr.shape
-    if y % n_dev:
-        raise ValueError(f"y={y} must divide over {n_dev} devices")
-    slab = y // n_dev
-
     pin = tuple(input_patch_size)
     pout = tuple(output_patch_size)
-    margin_y = (pin[1] - pout[1]) // 2
-    halo_left = margin_y
-    halo_right = pin[1] - margin_y
-    spill = pout[1]
-    if max(halo_left, halo_right, spill) > slab:
-        raise ValueError(
-            f"slab {slab} too thin for halo {(halo_left, halo_right)} / "
-            f"spill {spill}; use fewer devices or a bigger chunk"
-        )
+    slab, halo_left, halo_right, spill = spatial_geometry(y, n_dev, pin, pout)
 
     grid = enumerate_patches(
         arr.shape, input_patch_size, output_patch_size, output_patch_overlap

@@ -198,3 +198,34 @@ def test_prebuilt_engine():
     np.testing.assert_allclose(
         np.asarray(out.array)[0], np.asarray(chunk.array), atol=1e-5
     )
+
+
+@pytest.mark.parametrize("sharding", ["patch", "spatial"])
+def test_inferencer_sharded_modes_match_single_device(sharding):
+    """--sharding patch/spatial produce the single-device result on the
+    8-device virtual mesh (identity oracle)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 128, 32)).astype(np.float32))
+
+    def run(mode):
+        inferencer = Inferencer(
+            input_patch_size=(4, 16, 16),
+            output_patch_overlap=(2, 8, 8),
+            num_output_channels=1,
+            framework="identity",
+            batch_size=2,
+            sharding=mode,
+            crop_output_margin=False,
+        )
+        return np.asarray(inferencer(chunk.clone()).array)
+
+    result = run(sharding)
+    np.testing.assert_allclose(result, run("none"), atol=1e-5)
+    np.testing.assert_allclose(result[0], np.asarray(chunk.array), atol=1e-5)
