@@ -241,7 +241,11 @@ def debug_cmd():
     "--depth", "-d", type=int, default=2,
     help="how many tasks to stage ahead of the consumer",
 )
-def prefetch_cmd(depth):
+@click.option(
+    "--to-device/--no-to-device", default=False,
+    help="also start the async H2D transfer of staged chunks",
+)
+def prefetch_cmd(depth, to_device):
     """Pipeline upstream stages in a background thread.
 
     Place after the load operators so the next task's host IO overlaps the
@@ -249,7 +253,7 @@ def prefetch_cmd(depth):
     sequential loop is its acknowledged hot spot, SURVEY §3.2)."""
     from chunkflow_tpu.flow.runtime import prefetch_stage
 
-    return prefetch_stage(depth=depth)
+    return prefetch_stage(depth=depth, to_device=to_device)
 
 
 @main.command("fetch-task-from-queue")

@@ -99,7 +99,7 @@ def generator(func: Callable) -> Callable:
     return wrapper
 
 
-def prefetch_stage(depth: int = 2) -> Callable:
+def prefetch_stage(depth: int = 2, to_device: bool = False) -> Callable:
     """Run the upstream stages in a background thread, ``depth`` tasks ahead.
 
     The reference loads, computes and saves strictly sequentially and pays
@@ -107,10 +107,20 @@ def prefetch_stage(depth: int = 2) -> Callable:
     the load operators overlaps the next task's host-side IO with the
     current task's device compute: the worker thread keeps pulling tasks
     (filling a bounded queue) while the main thread runs the devicebound
-    stages. Upstream exceptions re-raise in the consumer.
+    stages. With ``to_device`` the worker also starts the H2D transfer of
+    each task's chunks (``jax.device_put`` is async), so the data is
+    HBM-resident by the time the compute stage runs. Upstream exceptions
+    re-raise in the consumer.
     """
     import queue
     import threading
+
+    def _stage_chunks(task):
+        for key, value in list(task.items()):
+            if hasattr(value, "device") and hasattr(value, "is_on_device"):
+                if not value.is_on_device:
+                    task[key] = value.device()
+        return task
 
     def stage(stream: Iterator[Optional[dict]]):
         q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
@@ -130,6 +140,8 @@ def prefetch_stage(depth: int = 2) -> Callable:
         def worker():
             try:
                 for task in stream:
+                    if to_device and task is not None:
+                        task = _stage_chunks(task)
                     if not put(task):
                         return  # consumer gone: stop pulling upstream
             except BaseException as exc:  # propagate to consumer

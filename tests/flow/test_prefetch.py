@@ -65,3 +65,16 @@ def test_prefetch_stops_upstream_on_early_exit():
     assert n <= 4, f"worker kept pulling after close: {n}"
     time.sleep(0.2)
     assert len(pulled) == n, "worker still running after close"
+
+
+def test_prefetch_to_device():
+    from chunkflow_tpu.chunk.base import Chunk
+    import numpy as np
+
+    tasks = [
+        {"log": {"timer": {}}, "chunk": Chunk(np.ones((2, 2, 2), np.float32))}
+        for _ in range(3)
+    ]
+    out = list(prefetch_stage(depth=2, to_device=True)(iter(tasks)))
+    assert len(out) == 3
+    assert all(t["chunk"].is_on_device for t in out)
