@@ -364,3 +364,29 @@ def test_mesh_simplification():
     # no-op when cell_size=0
     v0, f0 = simplify_mesh(vertices, faces, cell_size=0.0)
     assert v0.shape == vertices.shape and f0.shape == faces.shape
+
+
+def test_save_precomputed_with_thumbnail_and_log(runner, tmp_path):
+    """save-precomputed writes data + timing-log sidecar; thumbnail pyramid
+    lands in the sibling thumbnail volume (reference save_precomputed.py
+    :104-150)."""
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "outvol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(8, 8, 8),
+    )
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16",
+        "create-chunk", "--size", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root),
+    ])
+    assert result.exit_code == 0, result.output
+    log_dir = root / "log"
+    assert log_dir.exists() and any(log_dir.iterdir())
+    import json
+
+    record = json.loads(next(log_dir.iterdir()).read_text())
+    assert "timer" in record and "compute_device" in record
