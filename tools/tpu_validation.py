@@ -1,0 +1,179 @@
+"""One-process TPU validation + measurement battery.
+
+The TPU tunnel in this environment serves a single client at a time and
+wedges if probed concurrently or killed mid-compile, so every hardware
+question is answered in ONE process, in priority order, with results
+appended to ``tools/tpu_validation.json`` as they arrive (a crash keeps
+earlier answers).
+
+Run:  python tools/tpu_validation.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS_PATH = os.path.join(os.path.dirname(__file__), "tpu_validation.json")
+RESULTS: dict = {}
+
+
+def record(name, value):
+    RESULTS[name] = value
+    with open(RESULTS_PATH, "w") as f:
+        json.dump(RESULTS, f, indent=2)
+    print(f"[{name}] {value}", flush=True)
+
+
+def step(name):
+    def deco(fn):
+        def run():
+            t0 = time.perf_counter()
+            try:
+                value = fn()
+                record(name, {"ok": True, "value": value,
+                              "seconds": round(time.perf_counter() - t0, 1)})
+                return True
+            except Exception:
+                record(name, {"ok": False,
+                              "error": traceback.format_exc()[-2000:],
+                              "seconds": round(time.perf_counter() - t0, 1)})
+                return False
+        return run
+    return deco
+
+
+@step("tunnel")
+def check_tunnel():
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()
+    y = (jnp.ones((512, 512)) @ jnp.ones((512, 512))).block_until_ready()
+    return str(d)
+
+
+@step("pallas_oracle")
+def check_pallas_oracle():
+    import numpy as np
+
+    os.environ["CHUNKFLOW_PALLAS"] = "1"
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(1)
+    chunk = rng.random((8, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    mse = float(((out - chunk[None]) ** 2).mean())
+    assert mse < 1e-8, f"pallas oracle MSE={mse}"
+    return {"mse": mse}
+
+
+def _fwd_time(model, params, x, n=3):
+    import jax
+
+    f = jax.jit(lambda p, v: model.apply({"params": p}, v))
+    f(params, x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f(params, x).block_until_ready()
+    return (time.perf_counter() - t0) / n
+
+
+@step("fwd_parity_f32")
+def fwd_parity():
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.UNet3D(in_channels=1, out_channels=3)
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((2, 20, 256, 256, 1), jnp.float32)
+    dt = _fwd_time(model, params, x)
+    return {"ms": round(dt * 1e3, 1),
+            "mvox_s": round(2 * 20 * 256 * 256 / dt / 1e6, 2)}
+
+
+@step("fwd_tpu_bf16")
+def fwd_tpu_variant():
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.create_tpu_optimized_model()
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
+    dt = _fwd_time(model, params, x)
+    return {"ms": round(dt * 1e3, 1),
+            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
+
+
+def _bench(pallas: str, variant: str, dtype: str, batch: int):
+    import importlib
+
+    os.environ["CHUNKFLOW_PALLAS"] = pallas
+    os.environ["CHUNKFLOW_BENCH_VARIANT"] = variant
+    os.environ["CHUNKFLOW_BENCH_DTYPE"] = dtype
+    os.environ["CHUNKFLOW_BENCH_BATCH"] = str(batch)
+    import bench
+
+    importlib.reload(bench)
+    return {"mvox_s": round(bench.run_config({
+        "model_variant": variant, "dtype": dtype,
+        "batch_size": batch, "pallas": pallas,
+    }), 2)}
+
+
+@step("bench_tpu_bf16_xla")
+def bench_flagship_xla():
+    return _bench("0", "tpu", "bfloat16", 4)
+
+
+@step("bench_tpu_bf16_pallas")
+def bench_flagship_pallas():
+    return _bench("1", "tpu", "bfloat16", 4)
+
+
+@step("bench_parity_f32")
+def bench_parity():
+    return _bench("0", "parity", "float32", 2)
+
+
+@step("entry_compile")
+def entry_compile():
+    import jax
+
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    out.block_until_ready()
+    return {"shape": list(out.shape)}
+
+
+def main():
+    steps = [check_tunnel, check_pallas_oracle, fwd_parity, fwd_tpu_variant,
+             bench_flagship_xla, bench_flagship_pallas, bench_parity,
+             entry_compile]
+    if not steps[0]():
+        print("tunnel unavailable; aborting", file=sys.stderr)
+        return 1
+    for s in steps[1:]:
+        s()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
