@@ -60,16 +60,21 @@ class Image(Chunk):
         (image/base.py:93-133): clip the darkest/brightest fractions and
         stretch the remainder to [minval, maxval].
         """
-        arr = np.asarray(self.array).astype(np.float32)
+        # stays on device when the payload is already HBM-resident
+        if self.is_on_device:
+            import jax.numpy as xp
+        else:
+            xp = np
+        arr = xp.asarray(self.array).astype(xp.float32)
         lo_q = lower_clip_fraction * 100.0
         hi_q = 100.0 - upper_clip_fraction * 100.0
         # per z-section (and per channel for 4D): reduce over the trailing
         # (y, x) axes; otherwise over the whole array
         axes = (-2, -1) if per_section else tuple(range(-3, 0))
-        lows = np.percentile(arr, lo_q, axis=axes, keepdims=True)
-        highs = np.percentile(arr, hi_q, axis=axes, keepdims=True)
-        scale = (maxval - minval) / np.maximum(highs - lows, 1e-6)
-        out = np.clip((arr - lows) * scale + minval, minval, maxval)
+        lows = xp.percentile(arr, lo_q, axis=axes, keepdims=True)
+        highs = xp.percentile(arr, hi_q, axis=axes, keepdims=True)
+        scale = (maxval - minval) / xp.maximum(highs - lows, 1e-6)
+        out = xp.clip((arr - lows) * scale + minval, minval, maxval)
         dtype = self.dtype if np.dtype(self.dtype).kind in "iu" else np.uint8
         return Image(
             out.astype(dtype),

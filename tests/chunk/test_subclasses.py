@@ -120,3 +120,21 @@ def test_maskout_multiresolution():
     arr = np.asarray(out.array)
     assert arr[0, 0, 0] == 0 and arr[0, 1, 1] == 0
     assert arr[0, 2, 2] == 1
+
+
+def test_normalize_contrast_on_device_matches_host():
+    import numpy as np
+
+    from chunkflow_tpu.chunk.image import Image
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(10, 240, (4, 16, 16)).astype(np.uint8)
+    host_out = Image(arr).normalize_contrast()
+    dev_img = Image(arr).device()
+    dev_out = dev_img.normalize_contrast()
+    assert dev_out.is_on_device
+    np.testing.assert_allclose(
+        np.asarray(dev_out.array).astype(np.int32),
+        np.asarray(host_out.array).astype(np.int32),
+        atol=1,  # percentile interpolation may differ by 1 grey level
+    )
