@@ -8,7 +8,13 @@ from chunkflow_tpu.chunk.base import Chunk
 
 
 def gaussian_filter_2d(chunk: Chunk, sigma: float = 1.0) -> Chunk:
-    """Per-z-section 2D gaussian blur (does not mix z slices)."""
+    """Per-z-section 2D gaussian blur (does not mix z slices).
+
+    HBM-resident chunks filter on device with separable 1D convs (reflect
+    boundary, 4-sigma truncation — scipy.ndimage.gaussian_filter
+    semantics); host chunks go through scipy."""
+    if chunk.is_on_device:
+        return _gaussian_filter_2d_device(chunk, sigma)
     arr = np.asarray(chunk.array)
     spatial_sigma = (0.0, sigma, sigma)
     if arr.ndim == 4:
@@ -17,6 +23,37 @@ def gaussian_filter_2d(chunk: Chunk, sigma: float = 1.0) -> Chunk:
         sigma_nd = spatial_sigma
     out = ndimage.gaussian_filter(arr.astype(np.float32), sigma=sigma_nd)
     return chunk._with_array(out.astype(arr.dtype))
+
+
+def _gaussian_filter_2d_device(chunk: Chunk, sigma: float) -> Chunk:
+    import jax.numpy as jnp
+
+    radius = int(4.0 * sigma + 0.5)
+    x = np.arange(-radius, radius + 1, dtype=np.float32)
+    kernel = np.exp(-0.5 * (x / sigma) ** 2)
+    kernel /= kernel.sum()
+    k = jnp.asarray(kernel)
+
+    arr = jnp.asarray(chunk.array).astype(jnp.float32)
+    orig_ndim = arr.ndim
+    if orig_ndim == 3:
+        arr = arr[None]
+
+    def blur_axis(v, axis):
+        pad = [(0, 0)] * v.ndim
+        pad[axis] = (radius, radius)
+        padded = jnp.pad(v, pad, mode="symmetric")  # scipy "reflect"
+        moved = jnp.moveaxis(padded, axis, -1)
+        out = jnp.apply_along_axis(
+            lambda row: jnp.convolve(row, k, mode="valid"), -1, moved
+        )
+        return jnp.moveaxis(out, -1, axis)
+
+    arr = blur_axis(arr, -2)
+    arr = blur_axis(arr, -1)
+    if orig_ndim == 3:
+        arr = arr[0]
+    return chunk._with_array(arr.astype(chunk.dtype))
 
 
 def median_filter(chunk: Chunk, size: int = 3) -> Chunk:

@@ -87,3 +87,14 @@ def test_unique_ids():
     assert set(np.asarray(ids).tolist()) == {3, 7}
     ids, counts = remap.unique_ids(arr, return_counts=True)
     assert dict(zip(ids.tolist(), counts.tolist())) == {3: 2, 7: 1}
+
+
+def test_gaussian_filter_2d_device_matches_scipy():
+    from scipy.ndimage import gaussian_filter
+
+    rng = np.random.default_rng(1)
+    arr = rng.random((2, 12, 12)).astype(np.float32)
+    dev = filters.gaussian_filter_2d(Chunk(arr).device(), sigma=1.5)
+    assert dev.is_on_device
+    ref = np.stack([gaussian_filter(a, 1.5, mode="reflect") for a in arr])
+    np.testing.assert_allclose(np.asarray(dev.array), ref, atol=1e-4)
