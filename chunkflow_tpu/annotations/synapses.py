@@ -293,3 +293,127 @@ class Synapses:
         if path.endswith((".h5", ".hdf5")):
             return self.to_h5(path)
         raise ValueError(f"unsupported synapse file format: {path}")
+
+    # ---- DVID / NeuTu interop (reference synapses.py:128-224,364-455) ----
+    @classmethod
+    def from_dvid_list(cls, syns: List[dict],
+                       resolution=(1, 1, 1)) -> "Synapses":
+        """Build from a DVID annotation-element list (as fetched with
+        fivol/DVID's elements API): dicts with 'Kind' ('PreSyn'/'PostSyn'),
+        'Pos' [x, y, z], 'Prop' {'conf', 'user', ...}, and 'Rels'
+        [{'Rel': 'PostSynTo', 'To': [x, y, z]}].
+
+        Post elements whose presynapse is absent from the list are dropped
+        (the reference logs and skips them the same way)."""
+        pre_list, pre_conf, users = [], [], []
+        for syn in syns:
+            if "Pre" in syn.get("Kind", ""):
+                pre_list.append(tuple(syn["Pos"][::-1]))  # xyz -> zyx
+                prop = syn.get("Prop", {}) or {}
+                pre_conf.append(float(prop.get("conf", 1.0)))
+                users.append(prop.get("user", ""))
+        pre_pos2idx = {pos: i for i, pos in enumerate(pre_list)}
+
+        post_rows = []
+        for syn in syns:
+            if "Post" in syn.get("Kind", ""):
+                rels = syn.get("Rels") or []
+                if not rels:
+                    continue  # post without a presynapse
+                pre_pos = tuple(rels[0]["To"][::-1])
+                pre_idx = pre_pos2idx.get(pre_pos)
+                if pre_idx is None:
+                    continue  # presynapse was deleted
+                z, y, x = syn["Pos"][::-1]
+                post_rows.append((pre_idx, z, y, x))
+
+        pre = np.asarray(pre_list, dtype=np.int32).reshape(-1, 3)
+        post = (
+            np.asarray(post_rows, dtype=np.int32)
+            if post_rows else None
+        )
+        return cls(
+            pre,
+            post=post,
+            pre_confidence=np.asarray(pre_conf, dtype=np.float32),
+            resolution=resolution,
+            users=sorted(set(users)) if users else None,
+        )
+
+    def to_dvid_list_of_dict(self, user: str = "chunkflow",
+                             comment: str = "ingested using chunkflow",
+                             ) -> List[dict]:
+        """Element list for DVID bulk ingestion: one PostSyn dict per post
+        partner (with a PostSynTo relation) and one PreSyn dict per T-bar
+        (with PreSynTo relations to all its partners)."""
+        def xyz(zyx_row):
+            return [int(v) for v in zyx_row[::-1]]
+
+        data = []
+        for post_idx in range(self.post_num):
+            pre_idx = int(self.post[post_idx, 0])
+            conf = (
+                float(self.post_confidence[post_idx])
+                if self.post_confidence is not None else 1.0
+            )
+            data.append({
+                "Kind": "PostSyn",
+                "Pos": xyz(self.post[post_idx, 1:]),
+                "Prop": {"annotation": comment, "conf": str(conf),
+                         "user": user},
+                "Rels": [{"Rel": "PostSynTo", "To": xyz(self.pre[pre_idx])}],
+                "Tags": [],
+            })
+        for pre_idx in range(self.pre_num):
+            rels = [
+                {"Rel": "PreSynTo", "To": xyz(self.post[post_idx, 1:])}
+                for post_idx in self.post_indices_of_pre(pre_idx)
+            ]
+            conf = (
+                float(self.pre_confidence[pre_idx])
+                if self.pre_confidence is not None else 1.0
+            )
+            data.append({
+                "Kind": "PreSyn",
+                "Pos": xyz(self.pre[pre_idx]),
+                "Prop": {"annotation": comment, "conf": str(conf),
+                         "user": user},
+                "Rels": rels,
+                "Tags": [],
+            })
+        return data
+
+    def to_neutu_task(self, path: str,
+                      software_revision: int = 4809,
+                      description: str = "transformed using chunkflow_tpu",
+                      file_version: int = 1,
+                      body_id: Optional[int] = None) -> str:
+        """NeuTu focused-proofreading task JSON (presynapses only, like the
+        reference's exporter)."""
+        import time as _time
+
+        if not path.endswith(".json"):
+            raise ValueError("NeuTu task file must end with .json")
+        task = {
+            "metadata": {
+                "date": _time.strftime("%d-%B-%Y %H:%M"),
+                "session path": "",
+                "software revision": software_revision,
+                "description": description,
+                "coordinate system": "dvid",
+                "software": "chunkflow_tpu",
+                "file version": file_version,
+                "username": "chunkflow_tpu",
+                "computer": "localhost",
+            },
+            "data": [
+                {
+                    "body ID": body_id if body_id is not None else "",
+                    "location": [int(v) for v in self.pre[idx, ::-1]],
+                }
+                for idx in range(self.pre_num)
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(task, f)
+        return path

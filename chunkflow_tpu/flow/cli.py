@@ -80,9 +80,14 @@ def run_pipeline(stages, mip, dry_run, verbose, profile_dir):
 @click.option("--queue-name", "-q", type=str, default=None, help="push tasks to a queue (file://dir or sqs://name)")
 @click.option("--task-index-start", type=int, default=None)
 @click.option("--task-index-stop", type=int, default=None)
+@click.option("--disbatch/--no-disbatch", default=False,
+              help="select the single task at $DISBATCH_REPEAT_INDEX "
+              "(disBatch cluster protocol, reference flow/flow.py:151-156)")
 def generate_tasks_cmd(chunk_size, overlap, roi_start, roi_stop, grid_size,
-                       task_file, queue_name, task_index_start, task_index_stop):
+                       task_file, queue_name, task_index_start,
+                       task_index_stop, disbatch):
     """Fan the seed task into a grid of bbox tasks."""
+    import os
 
     @generator
     def stage(task):
@@ -96,6 +101,19 @@ def generate_tasks_cmd(chunk_size, overlap, roi_start, roi_stop, grid_size,
         boxes = list(bboxes)
         if task_index_start is not None or task_index_stop is not None:
             boxes = boxes[task_index_start:task_index_stop]
+        elif disbatch:
+            if "DISBATCH_REPEAT_INDEX" not in os.environ:
+                raise click.UsageError(
+                    "--disbatch needs $DISBATCH_REPEAT_INDEX in the "
+                    "environment (set by the disBatch launcher)"
+                )
+            idx = int(os.environ["DISBATCH_REPEAT_INDEX"])
+            if idx >= len(boxes):
+                raise click.UsageError(
+                    f"DISBATCH_REPEAT_INDEX={idx} exceeds the "
+                    f"{len(boxes)}-task grid"
+                )
+            boxes = [boxes[idx]]
         if task_file is not None:
             BoundingBoxes(boxes).to_file(task_file)
             print(f"wrote {len(boxes)} tasks to {task_file}")
@@ -202,9 +220,12 @@ def setup_env_cmd(
               help="index into the task list; defaults to $SLURM_ARRAY_TASK_ID")
 @click.option("--granularity", "-g", type=int, default=1,
               help="number of consecutive tasks per job")
-def fetch_task_from_file_cmd(task_file, job_index, granularity):
+@click.option("--disbatch/--no-disbatch", default=False,
+              help="take the job index from $DISBATCH_REPEAT_INDEX instead "
+              "of $SLURM_ARRAY_TASK_ID (reference flow/flow.py:151-156)")
+def fetch_task_from_file_cmd(task_file, job_index, granularity, disbatch):
     """Static sharding: take this job's slice of a task-list file
-    (reference flow/flow.py:554-581, SLURM array protocol)."""
+    (reference flow/flow.py:554-581; SLURM array + disBatch protocols)."""
     import os
 
     @generator
@@ -212,10 +233,22 @@ def fetch_task_from_file_cmd(task_file, job_index, granularity):
         from chunkflow_tpu.flow.runtime import new_task
 
         index = job_index
+        if index is None and disbatch:
+            if "DISBATCH_REPEAT_INDEX" not in os.environ:
+                raise click.UsageError(
+                    "--disbatch needs $DISBATCH_REPEAT_INDEX in the "
+                    "environment (set by the disBatch launcher)"
+                )
+            index = int(os.environ["DISBATCH_REPEAT_INDEX"])
         if index is None:
             index = int(os.environ.get("SLURM_ARRAY_TASK_ID", 0))
         boxes = list(BoundingBoxes.from_file(task_file))
         start = index * granularity
+        if start >= len(boxes):
+            raise click.UsageError(
+                f"job index {index} x granularity {granularity} exceeds "
+                f"the {len(boxes)}-task file — shard silently dropped?"
+            )
         for bbox in boxes[start:start + granularity]:
             t = new_task()
             t["bbox"] = bbox
@@ -445,9 +478,14 @@ def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
               help="zero z-sections listed in the volume's blackout_section_ids.json")
 @click.option("--validate-mip", type=int, default=None,
               help="cross-check the cutout against a re-download at this coarser mip")
+@click.option("--validate-tolerance", type=float, default=0.01,
+              help="max relative mean |pooled - coarse| before the task fails "
+              "(the reference asserts exact equality; >0 tolerates pyramid "
+              "rounding)")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
-                         blackout_sections, validate_mip, output_chunk_name):
+                         blackout_sections, validate_mip, validate_tolerance,
+                         output_chunk_name):
     """Cut out the task bbox (plus margins) from a precomputed volume.
 
     Reference parity: LoadPrecomputedOperator incl. bad-section blackout
@@ -467,7 +505,9 @@ def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
         # validate the RAW cutout; blackout intentionally zeroes data and
         # must not trigger mismatch warnings
         if validate_mip is not None and not state.dry_run:
-            _validate_cutout(vol, chunk, the_mip, validate_mip)
+            _validate_cutout(
+                vol, chunk, the_mip, validate_mip, validate_tolerance
+            )
         if blackout_sections:
             sidecar = vol.read_json("blackout_section_ids.json") or {}
             z0 = int(chunk.voxel_offset.z)
@@ -481,9 +521,14 @@ def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
     return stage(_name="load-precomputed")
 
 
-def _validate_cutout(vol, chunk, mip, validate_mip):
+def _validate_cutout(vol, chunk, mip, validate_mip, tolerance=0.01):
     """Mean-pool the cutout to ``validate_mip`` and compare with a direct
-    coarse-mip read of the same window; print a warning on mismatch."""
+    coarse-mip read of the same window; fail the task on mismatch.
+
+    The reference asserts exact equality after pooling
+    (load_precomputed.py:115-182); a small default tolerance absorbs
+    pyramid rounding while still catching the corrupted / partially-black
+    cutouts this check exists for."""
     from chunkflow_tpu.core.bbox import BoundingBox
     from chunkflow_tpu.ops.downsample import downsample_average
 
@@ -516,11 +561,16 @@ def _validate_cutout(vol, chunk, mip, validate_mip):
     b = np.asarray(ref.array, dtype=np.float64)
     err = float(np.abs(a - b).mean())
     scale = max(float(np.abs(b).mean()), 1e-6)
-    if err / scale > 0.5:
-        print(
-            f"WARNING: cross-mip validation mismatch (mip {mip} vs "
-            f"{validate_mip}): mean|diff|={err:.4f} vs mean|ref|={scale:.4f}"
+    if err / scale > tolerance:
+        import logging
+
+        msg = (
+            f"cross-mip validation mismatch (mip {mip} vs {validate_mip}): "
+            f"mean|diff|={err:.4f} vs mean|ref|={scale:.4f} "
+            f"(relative {err / scale:.4f} > tolerance {tolerance})"
         )
+        logging.warning(msg)
+        raise ValueError(msg)
 
 
 @main.command("save-precomputed")
@@ -1054,7 +1104,10 @@ def threshold_cmd(threshold, input_chunk_name, output_chunk_name):
 @click.option("--threshold", "-t", type=float, default=0.5)
 @click.option("--connectivity", "-c", type=click.Choice(["6", "18", "26"]), default="26")
 @click.option("--device/--host", default=False,
-              help="label on the accelerator (iterative propagation) instead of host union-find")
+              help="label on the accelerator (iterative propagation) instead "
+              "of host union-find; NOTE device labels are non-consecutive "
+              "uint32 (linear-index seeds) — chain a renumber when dense "
+              "ids are required (the host path is already consecutive)")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def connected_components_cmd(threshold, connectivity, device, input_chunk_name, output_chunk_name):
@@ -1597,16 +1650,17 @@ def napari_cmd(chunk_names):
             raise click.ClickException(
                 "the napari package is not installed in this environment"
             ) from e
+        from chunkflow_tpu.flow.viewers import add_napari_layers
+
         viewer = napari.Viewer()
-        for name in chunk_names.split(","):
-            if name not in task:
-                continue
-            chunk = task[name]
-            arr = np.asarray(chunk.array)
-            if chunk.is_segmentation():
-                viewer.add_labels(arr, name=name)
-            else:
-                viewer.add_image(arr, name=name)
+        add_napari_layers(
+            viewer,
+            {
+                name: task[name]
+                for name in chunk_names.split(",")
+                if name in task
+            },
+        )
         napari.run()  # pragma: no cover - interactive
         return task
 

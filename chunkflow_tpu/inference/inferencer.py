@@ -222,13 +222,15 @@ class Inferencer:
         # spatial sharding: static geometry depends on the slab height
         from chunkflow_tpu.parallel.spatial import (
             build_spatial_program,
+            pad_chunk_y,
             partition_patches,
             spatial_geometry,
         )
 
         pin, pout = tuple(self.input_patch_size), tuple(self.output_patch_size)
-        slab, halo_left, halo_right, spill = spatial_geometry(
-            arr.shape[-2], n_dev, pin, pout
+        y = arr.shape[-2]
+        slab, halo_left, halo_right, spill, padded_y = spatial_geometry(
+            y, n_dev, pin, pout
         )
         if slab not in self._spatial_programs:
             self._spatial_programs[slab] = build_spatial_program(
@@ -248,13 +250,15 @@ class Inferencer:
         dev_in, dev_out, dev_valid = partition_patches(
             grid, n_dev, slab, self.batch_size, halo_left
         )
-        return self._spatial_programs[slab](
+        arr = pad_chunk_y(arr, padded_y)
+        result = self._spatial_programs[slab](
             arr,
             jnp.asarray(dev_in),
             jnp.asarray(dev_out),
             jnp.asarray(dev_valid),
             self._device_params,
         )
+        return result[:, :, :y, :]
 
     # ------------------------------------------------------------------
     def __call__(self, chunk: Chunk) -> Chunk:

@@ -46,24 +46,8 @@ def downsample_average(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
     )
 
 
-def downsample_mode(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
-    """Mode (most-frequent-label) pooling for segmentations.
-
-    Gathers the ``prod(factor)`` corner samples of each block and picks the
-    value with the highest count (ties: the first corner wins, which for
-    2x2x2 matches countless-style behavior closely enough for thumbnails).
-    """
-    arr = np.asarray(chunk.array)
-    factor = to_cartesian(factor)
-    squeeze = arr.ndim == 3
-    if squeeze:
-        arr = arr[None]
-    c = arr.shape[0]
-    spatial = Cartesian.from_collection(arr.shape[1:])
-    trimmed = (spatial // factor) * factor
-    arr = arr[:, : trimmed.z, : trimmed.y, : trimmed.x]
-    out_shape = trimmed // factor
-    # corners: [n_corners, c, z', y', x']
+def _stack_corners_numpy(arr: np.ndarray, factor) -> np.ndarray:
+    """[n_corners, c, z', y', x'] corner samples of each pooling block."""
     corners = []
     for dz in range(factor.z):
         for dy in range(factor.y):
@@ -71,15 +55,87 @@ def downsample_mode(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
                 corners.append(
                     arr[:, dz :: factor.z, dy :: factor.y, dx :: factor.x]
                 )
-    stacked = np.stack(corners, axis=0)
+    return np.stack(corners, axis=0)
+
+
+def mode_pool_numpy(arr: np.ndarray, factor) -> np.ndarray:
+    """Reference host implementation: exact mode with ties going to the
+    first corner (z-major corner order)."""
+    stacked = _stack_corners_numpy(arr, factor)
     n = stacked.shape[0]
-    # count matches of each corner value among all corners; argmax wins
     counts = np.zeros(stacked.shape, dtype=np.int8)
     for i in range(n):
         for j in range(n):
             counts[i] += stacked[i] == stacked[j]
     winner = np.argmax(counts, axis=0)
-    pooled = np.take_along_axis(stacked, winner[None], axis=0)[0]
+    return np.take_along_axis(stacked, winner[None], axis=0)[0]
+
+
+def mode_pool_device(arr, factor):
+    """The same mode pooling as one fused XLA program (the tinybrain /
+    countless replacement, SURVEY §2.9): all-pairs equality counting is
+    pure elementwise compare+add, so the whole n²-corner vote fuses into
+    device code — a 512³ uint32 segmentation pools in device time instead
+    of 64 full-array numpy passes.
+
+    Tie semantics match ``mode_pool_numpy`` exactly: argmax returns the
+    first corner with the max count in z-major corner order.
+    """
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(arr)
+    c = arr.shape[0]
+    zp, yp, xp = (
+        arr.shape[1] // factor.z,
+        arr.shape[2] // factor.y,
+        arr.shape[3] // factor.x,
+    )
+    blocks = arr.reshape(c, zp, factor.z, yp, factor.y, xp, factor.x)
+    # [n_corners, c, z', y', x'] in z-major corner order (dz, dy, dx)
+    stacked = blocks.transpose(2, 4, 6, 0, 1, 3, 5).reshape(
+        factor.z * factor.y * factor.x, c, zp, yp, xp
+    )
+    n = stacked.shape[0]
+    counts = jnp.zeros(stacked.shape, dtype=jnp.int8)
+    for j in range(n):  # unrolled compare+add chain; XLA fuses it
+        counts = counts + (stacked == stacked[j][None]).astype(jnp.int8)
+    winner = jnp.argmax(counts, axis=0)
+    return jnp.take_along_axis(stacked, winner[None], axis=0)[0]
+
+
+def downsample_mode(chunk: Chunk, factor=(1, 2, 2)) -> Chunk:
+    """Mode (most-frequent-label) pooling for segmentations.
+
+    Runs on device (XLA) for <=32-bit labels; 64-bit labels fall back to
+    the numpy path unless jax x64 is enabled (jnp would silently truncate
+    them). Ties: the first corner in z-major order wins, in both paths.
+    """
+    factor = to_cartesian(factor)
+    arr = chunk.array
+    squeeze = hasattr(arr, "ndim") and arr.ndim == 3
+    host_in = not chunk.is_on_device
+    if host_in:
+        arr = np.asarray(arr)
+    if squeeze:
+        arr = arr[None]
+    spatial = Cartesian.from_collection(arr.shape[1:])
+    trimmed = (spatial // factor) * factor
+    arr = arr[:, : trimmed.z, : trimmed.y, : trimmed.x]
+
+    use_device = True
+    if np.dtype(chunk.dtype).itemsize > 4:
+        try:
+            import jax
+
+            use_device = bool(jax.config.jax_enable_x64)
+        except Exception:
+            use_device = False
+    if use_device:
+        pooled = mode_pool_device(arr, factor)
+        if host_in:
+            pooled = np.asarray(pooled)
+    else:
+        pooled = mode_pool_numpy(np.asarray(arr), factor)
     if squeeze:
         pooled = pooled[0]
     return Chunk(

@@ -28,15 +28,35 @@ Triple = Tuple[int, int, int]
 
 
 def pallas_mode() -> str:
-    """'on' | 'off' | 'interpret' — resolved from env + backend."""
+    """'on' | 'off' | 'interpret' — resolved from env + backend.
+
+    An explicit truthy CHUNKFLOW_PALLAS ('1'/'on'/'force') force-enables the
+    kernel regardless of platform string: the real chip in this environment
+    reports platform 'axon' (a tunneled TPU PJRT plugin), not 'tpu', so a
+    literal backend-name check would leave the kernel permanently inert on
+    the actual target hardware.  Auto mode (unset env) enables on any
+    TPU-like platform.
+    """
     env = os.environ.get("CHUNKFLOW_PALLAS", "").lower()
     if env in ("0", "off", "false"):
         return "off"
     if env == "interpret":
         return "interpret"
+    if env in ("1", "on", "true", "force"):
+        return "on"
+    return "on" if _tpu_like_backend() else "off"
+
+
+def _tpu_like_backend() -> bool:
     import jax
 
-    return "on" if jax.default_backend() == "tpu" else "off"
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    platform = getattr(dev, "platform", "")
+    kind = getattr(dev, "device_kind", "").lower()
+    return platform in ("tpu", "axon") or "tpu" in kind
 
 
 def accumulate_patches(out, weight, preds, wpatches, out_starts,

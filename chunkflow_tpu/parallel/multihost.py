@@ -20,6 +20,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+_initialized = False
+
 
 def initialize(
     coordinator_address: Optional[str] = None,
@@ -34,17 +36,29 @@ def initialize(
     """
     import jax
 
-    if jax._src.distributed.global_state.client is not None:  # already up
+    global _initialized
+    if _initialized:
         return
     if coordinator_address is None and "SLURM_PROCID" in os.environ:
         process_id = int(os.environ["SLURM_PROCID"])
         num_processes = int(os.environ["SLURM_NTASKS"])
         coordinator_address = os.environ.get("CHUNKFLOW_COORDINATOR")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+        if coordinator_address is None:
+            raise ValueError(
+                "SLURM bring-up needs a coordinator: set "
+                "CHUNKFLOW_COORDINATOR=<host:port> (reachable from every "
+                "task) or pass coordinator_address explicitly"
+            )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # raised when already initialized elsewhere
+        if "already initialized" not in str(e).lower():
+            raise
+    _initialized = True
 
 
 def global_mesh(axis: str = "data"):

@@ -33,23 +33,38 @@ Triple = Tuple[int, int, int]
 
 
 def spatial_geometry(y: int, n_devices: int, pin: Triple, pout: Triple):
-    """(slab, halo_left, halo_right, spill) for y-sharding, with guards.
+    """(slab, halo_left, halo_right, spill, padded_y) for y-sharding.
 
     Single source of the halo math for both Inferencer(--sharding spatial)
-    and spatial_sharded_inference."""
-    if y % n_devices:
-        raise ValueError(f"y={y} must divide over {n_devices} devices")
-    slab = y // n_devices
+    and spatial_sharded_inference. Arbitrary chunk heights are supported
+    (parity: the reference decomposes arbitrary sizes everywhere,
+    lib/cartesian_coordinate.py:316-347): the slab is rounded up to both
+    an even device split and the halo/spill minimum, and callers zero-pad
+    y to ``padded_y = slab * n_devices`` then crop back — padded rows get
+    zero blend weight, so normalization is exact on the real extent."""
     margin_y = (pin[1] - pout[1]) // 2
     halo_left = margin_y
     halo_right = pin[1] - margin_y
     spill = pout[1]
-    if max(halo_left, halo_right, spill) > slab:
-        raise ValueError(
-            f"slab {slab} too thin for halo {(halo_left, halo_right)} / "
-            f"spill {spill}; use fewer devices or a bigger chunk"
-        )
-    return slab, halo_left, halo_right, spill
+    slab = max(-(-y // n_devices), halo_left, halo_right, spill)
+    padded_y = slab * n_devices
+    return slab, halo_left, halo_right, spill, padded_y
+
+
+def pad_chunk_y(arr, padded_y: int):
+    """Zero-pad [C, Z, y, X] on the right of the y axis to ``padded_y``.
+
+    Works on numpy and jax arrays alike (jax arrays pad on device)."""
+    y = arr.shape[-2]
+    if y == padded_y:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[-2] = (0, padded_y - y)
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, pad)
+    import jax.numpy as jnp
+
+    return jnp.pad(arr, pad)
 
 
 def partition_patches(
@@ -218,11 +233,15 @@ def spatial_sharded_inference(
     c, z, y, x = arr.shape
     pin = tuple(input_patch_size)
     pout = tuple(output_patch_size)
-    slab, halo_left, halo_right, spill = spatial_geometry(y, n_dev, pin, pout)
+    slab, halo_left, halo_right, spill, padded_y = spatial_geometry(
+        y, n_dev, pin, pout
+    )
 
+    # patch grid covers the REAL extent; padded rows stay weight-zero
     grid = enumerate_patches(
         arr.shape, input_patch_size, output_patch_size, output_patch_overlap
     )
+    arr = pad_chunk_y(arr, padded_y)
     dev_in, dev_out, dev_valid = partition_patches(
         grid, n_dev, slab, batch_size, halo_left
     )
@@ -241,10 +260,11 @@ def spatial_sharded_inference(
         halo_right,
         spill,
     )
-    return program(
+    result = program(
         jnp.asarray(arr),
         jnp.asarray(dev_in),
         jnp.asarray(dev_out),
         jnp.asarray(dev_valid),
         engine.params,
     )
+    return result[:, :, :y, :]

@@ -91,6 +91,46 @@ def test_generate_tasks_stream_and_file(runner, tmp_path):
     assert "8 task" in result.output
 
 
+def test_disbatch_protocol(runner, tmp_path, monkeypatch):
+    """$DISBATCH_REPEAT_INDEX selects a single task (reference
+    flow/flow.py:151-156) in both generate-tasks and fetch-task-from-file."""
+    monkeypatch.setenv("DISBATCH_REPEAT_INDEX", "3")
+    result = run_ok(
+        runner,
+        [
+            "-v",
+            "generate-tasks", "-c", "4", "4", "4",
+            "--roi-start", "0", "0", "0", "--roi-stop", "8", "8", "8",
+            "--disbatch",
+        ],
+    )
+    assert "1 task" in result.output
+
+    task_file = str(tmp_path / "tasks.npy")
+    run_ok(
+        runner,
+        [
+            "generate-tasks", "-c", "4", "4", "4",
+            "--roi-start", "0", "0", "0", "--roi-stop", "8", "8", "8",
+            "--task-file", task_file,
+        ],
+    )
+    result = run_ok(
+        runner,
+        ["-v", "fetch-task-from-file", "-f", task_file, "--disbatch"],
+    )
+    assert "1 task" in result.output
+
+    # out-of-range index fails loudly
+    monkeypatch.setenv("DISBATCH_REPEAT_INDEX", "99")
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "4", "4", "4",
+        "--roi-start", "0", "0", "0", "--roi-stop", "8", "8", "8",
+        "--disbatch",
+    ])
+    assert result.exit_code != 0
+
+
 def test_queue_workflow(runner, tmp_path):
     qdir = str(tmp_path / "queue")
     run_ok(
@@ -331,7 +371,20 @@ def test_load_precomputed_cross_mip_validation(runner, tmp_path, capsys):
         "save-h5", "--file-name", str(out),
     ])
     assert result.exit_code == 0, result.output
-    assert "WARNING: cross-mip validation mismatch" not in result.output
+    assert "cross-mip validation mismatch" not in result.output
+
+    # corrupt the coarse mip: validation must now FAIL the task (the
+    # reference asserts equality, load_precomputed.py:115-182)
+    zero = Chunk.create((8, 8, 8), dtype=np.uint8, pattern="zero")
+    vol.save(zero, mip=1)
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16",
+        "load-precomputed", "-v", str(root), "--validate-mip", "1",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code != 0
+    assert "cross-mip validation mismatch" in str(result.exception)
 
 
 def test_profile_dir_writes_trace(runner, tmp_path):

@@ -1,0 +1,206 @@
+"""Viewer-path smoke tests against stubbed neuroglancer / napari modules.
+
+The reference has no viewer tests at all; these exercise the full layer
+dispatch (reference flow/neuroglancer.py:340-423) without a browser or the
+real packages.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.annotations.point_cloud import PointCloud
+from chunkflow_tpu.annotations.synapses import Synapses
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+class _Record:
+    """Generic stand-in that just records its constructor kwargs."""
+
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+
+class _Layers:
+    def __init__(self):
+        self.entries = []
+
+    def append(self, name=None, layer=None, **kwargs):
+        assert name is not None and layer is not None
+        self.entries.append({"name": name, "layer": layer, **kwargs})
+
+
+class _Txn:
+    def __init__(self):
+        self.layers = _Layers()
+
+
+@pytest.fixture
+def stub_ng(monkeypatch):
+    ng = types.ModuleType("neuroglancer")
+    for cls in (
+        "CoordinateSpace",
+        "LocalVolume",
+        "LocalAnnotationLayer",
+        "AnnotationPropertySpec",
+        "PointAnnotation",
+        "LineAnnotation",
+    ):
+        setattr(ng, cls, type(cls, (_Record,), {}))
+    monkeypatch.setitem(sys.modules, "neuroglancer", ng)
+    return ng
+
+
+def _chunk(layer_type, dtype=np.float32, nchan=None):
+    shape = (4, 8, 8) if nchan is None else (nchan, 4, 8, 8)
+    arr = np.arange(np.prod(shape), dtype=np.float64).reshape(shape)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        arr = arr.astype(dtype)
+    else:
+        arr = (arr / arr.max()).astype(dtype)
+    return Chunk(arr, voxel_offset=(1, 2, 3), voxel_size=(40, 8, 8),
+                 layer_type=layer_type)
+
+
+def test_build_layers_every_chunk_type(stub_ng):
+    from chunkflow_tpu.flow.viewers import build_layers
+
+    txn = _Txn()
+    n = build_layers(
+        txn,
+        {
+            "img": _chunk(LayerType.IMAGE),
+            "seg": _chunk(LayerType.SEGMENTATION, dtype=np.uint32),
+            "aff": _chunk(LayerType.AFFINITY_MAP, nchan=3),
+            "prob": _chunk(LayerType.PROBABILITY_MAP, nchan=1),
+        },
+    )
+    assert n == 4
+    by_name = {e["name"]: e for e in txn.layers.entries}
+    assert set(by_name) == {"img", "seg", "aff", "prob"}
+    # image gets the grayscale shader, affinity the multichannel shader
+    assert "emitGrayscale" in by_name["img"]["shader"]
+    assert "emitRGB" in by_name["aff"]["shader"]
+    assert "getDataValue(0)" in by_name["prob"]["shader"]
+    # segmentation layers carry no shader
+    assert "shader" not in by_name["seg"]
+    # data was transposed to xyz for neuroglancer
+    assert by_name["img"]["layer"].kwargs["data"].shape == (8, 8, 4)
+
+
+def test_build_layers_segmentation_dtypes(stub_ng):
+    from chunkflow_tpu.flow.viewers import build_layers
+
+    for dtype, expected in (
+        (bool, np.uint32),  # bool -> uint8 -> uint32, as in the reference
+        (np.int64, np.uint64),
+        (np.uint8, np.uint32),
+        (np.uint32, np.uint32),
+    ):
+        txn = _Txn()
+        build_layers(
+            txn, {"seg": _chunk(LayerType.SEGMENTATION, dtype=dtype)}
+        )
+        data = txn.layers.entries[0]["layer"].kwargs["data"]
+        assert data.dtype == expected, dtype
+
+
+def test_build_layers_annotations(stub_ng):
+    from chunkflow_tpu.flow.viewers import build_layers
+
+    pre = np.array([[1, 2, 3], [4, 5, 6]], dtype=np.int32)
+    post = np.array([[0, 1, 2, 4], [1, 4, 6, 7]], dtype=np.int32)
+    syn = Synapses(pre, post, resolution=(40, 8, 8))
+    points = PointCloud(np.array([[0, 1, 2]]), voxel_size=(40, 8, 8))
+
+    class _Skel:
+        vertices = np.array([[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]])
+        edges = np.array([[0, 1]])
+
+    txn = _Txn()
+    n = build_layers(
+        txn,
+        {
+            "syn": syn,
+            "pts": points,
+            "raw_pts": np.array([[7, 8, 9]]),
+            "skel": {42: _Skel()},
+        },
+    )
+    assert n == 4
+    names = [e["name"] for e in txn.layers.entries]
+    # synapses produce a line layer plus a <name>_pre T-bar point layer
+    assert "syn" in names and "syn_pre" in names
+    assert "pts" in names and "raw_pts" in names and "skel" in names
+    syn_layer = next(e for e in txn.layers.entries if e["name"] == "syn")
+    lines = syn_layer["layer"].kwargs["annotations"]
+    assert len(lines) == 2
+    # physical (nm) coordinates, xyz order: pre[0]=(1,2,3)*res -> (24,16,40)
+    assert lines[0].kwargs["pointA"] == [24.0, 16.0, 40.0]
+
+
+def test_build_layers_skips_none_and_rejects_unknown(stub_ng):
+    from chunkflow_tpu.flow.viewers import build_layers
+
+    txn = _Txn()
+    assert build_layers(txn, {"x": None}) == 0
+    with pytest.raises(ValueError, match="cannot render"):
+        build_layers(txn, {"bad": object()})
+    # an empty skeleton dict renders an empty annotation layer, not a crash
+    txn = _Txn()
+    assert build_layers(txn, {"skel": {}}) == 1
+    assert txn.layers.entries[0]["layer"].kwargs["annotations"] == []
+
+
+def test_napari_layer_dispatch():
+    from chunkflow_tpu.flow.viewers import add_napari_layers
+
+    calls = []
+
+    class _Viewer:
+        def add_labels(self, arr, name=None):
+            calls.append(("labels", name))
+
+        def add_image(self, arr, name=None):
+            calls.append(("image", name))
+
+    n = add_napari_layers(
+        _Viewer(),
+        {
+            "seg": _chunk(LayerType.SEGMENTATION, dtype=np.uint32),
+            "img": _chunk(LayerType.IMAGE),
+            "none": None,
+        },
+    )
+    assert n == 2
+    assert ("labels", "seg") in calls and ("image", "img") in calls
+
+
+def test_neuroglancer_cli_command(stub_ng, monkeypatch, tmp_path):
+    """The CLI command path up to serve_neuroglancer with a stubbed server."""
+    served = {}
+
+    class _Viewer:
+        def txn(self):
+            import contextlib
+
+            @contextlib.contextmanager
+            def cm():
+                yield _Txn()
+
+            return cm()
+
+        def get_viewer_url(self):
+            return "http://stub"
+
+    stub_ng.set_server_bind_address = lambda **kw: served.update(kw)
+    stub_ng.Viewer = _Viewer
+
+    from chunkflow_tpu.flow.viewers import serve_neuroglancer
+
+    serve_neuroglancer(
+        {"img": _chunk(LayerType.IMAGE)}, port=0, blocking=False
+    )
+    assert served["bind_address"] == "0.0.0.0"

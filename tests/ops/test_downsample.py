@@ -40,6 +40,44 @@ def test_mode_downsample_majority_wins():
     assert np.asarray(down.array)[0, 1, 1] == 0
 
 
+def test_mode_device_matches_numpy_exactly():
+    """Device (XLA) mode pooling == the slow numpy reference, including
+    tie-breaking by first corner in z-major order."""
+    from chunkflow_tpu.core.cartesian import to_cartesian
+    from chunkflow_tpu.ops.downsample import mode_pool_device, mode_pool_numpy
+
+    rng = np.random.default_rng(7)
+    for factor in ((1, 2, 2), (2, 2, 2)):
+        # few labels -> lots of genuine ties to exercise tie-breaking
+        arr = rng.integers(0, 4, size=(2, 8, 12, 12)).astype(np.uint32)
+        fac = to_cartesian(factor)
+        dev = np.asarray(mode_pool_device(arr, fac))
+        ref = mode_pool_numpy(arr, fac)
+        np.testing.assert_array_equal(dev, ref)
+
+
+def test_mode_all_distinct_first_corner_wins():
+    # 2x2 block with four distinct labels: every corner counts 1 -> the
+    # z-major first corner (dz=0, dy=0, dx=0) wins in both paths
+    arr = np.array([[[1, 2], [3, 4]]], dtype=np.uint32)
+    seg = Chunk(arr)
+    down = downsample_mode(seg, (1, 2, 2))
+    assert np.asarray(down.array)[0, 0, 0] == 1
+
+
+def test_mode_uint64_falls_back_to_numpy():
+    import jax
+
+    big = np.uint64(2**40 + 5)  # would truncate in 32-bit jnp
+    arr = np.full((2, 2, 2), big, dtype=np.uint64)
+    arr[1, 1, 1] = 0
+    seg = Chunk(arr)
+    down = downsample_mode(seg, (2, 2, 2))
+    assert down.dtype == np.uint64
+    if not jax.config.jax_enable_x64:
+        assert np.asarray(down.array)[0, 0, 0] == big
+
+
 def test_downsample_dispatches_by_layer():
     seg = Chunk(np.ones((2, 2, 2), dtype=np.uint32))
     img = Chunk(np.ones((2, 2, 2), dtype=np.uint8))

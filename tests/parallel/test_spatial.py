@@ -43,6 +43,34 @@ def test_spatial_identity_oracle(mesh):
     np.testing.assert_allclose(arr[0], chunk, atol=1e-5)
 
 
+@pytest.mark.parametrize("y", [100, 120, 130])
+def test_spatial_identity_non_divisible_y(mesh, y):
+    """Arbitrary chunk heights: y is padded to an even device split and
+    cropped back, so the oracle holds for shapes that don't divide by 8
+    (reference decomposes arbitrary sizes, cartesian_coordinate.py:316-347)."""
+    rng = np.random.default_rng(2)
+    chunk = rng.random((8, y, 32)).astype(np.float32)
+    patch = (4, 16, 16)
+    engine = engines.create_identity_engine(
+        input_patch_size=patch,
+        output_patch_size=patch,
+        num_input_channels=1,
+        num_output_channels=1,
+    )
+    out = spatial_sharded_inference(
+        chunk,
+        engine,
+        input_patch_size=patch,
+        output_patch_size=patch,
+        output_patch_overlap=(2, 8, 8),
+        batch_size=2,
+        mesh=mesh,
+    )
+    arr = np.asarray(out)
+    assert arr.shape == (1, 8, y, 32)
+    np.testing.assert_allclose(arr[0], chunk, atol=1e-5)
+
+
 def test_spatial_identity_with_crop_margin(mesh):
     """Smaller output patches: interior equals input, margin is zero."""
     rng = np.random.default_rng(1)

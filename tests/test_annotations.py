@@ -43,6 +43,45 @@ class TestSynapses:
             assert loaded == synapses
             assert loaded.resolution == synapses.resolution
 
+    def test_dvid_roundtrip(self, synapses):
+        """Synapses -> DVID element list -> Synapses preserves geometry
+        (reference synapses.py:128-224,364-...)."""
+        elements = synapses.to_dvid_list_of_dict(user="tester")
+        # 3 post + 3 pre elements, xyz positions
+        kinds = [e["Kind"] for e in elements]
+        assert kinds.count("PostSyn") == 3 and kinds.count("PreSyn") == 3
+        pre0 = next(e for e in elements if e["Kind"] == "PreSyn")
+        assert pre0["Pos"] == [10, 10, 10]  # zyx (10,10,10) -> xyz
+        assert {r["Rel"] for r in pre0["Rels"]} == {"PreSynTo"}
+
+        back = Synapses.from_dvid_list(elements, resolution=(40, 4, 4))
+        assert back == synapses
+
+    def test_dvid_list_drops_orphan_posts(self):
+        elements = [
+            {"Kind": "PreSyn", "Pos": [1, 2, 3], "Prop": {}, "Rels": []},
+            # post pointing at a deleted presynapse
+            {"Kind": "PostSyn", "Pos": [9, 9, 9], "Prop": {},
+             "Rels": [{"Rel": "PostSynTo", "To": [7, 7, 7]}]},
+            # post with no relation at all
+            {"Kind": "PostSyn", "Pos": [8, 8, 8], "Prop": {}, "Rels": []},
+        ]
+        syn = Synapses.from_dvid_list(elements)
+        assert syn.pre_num == 1 and syn.post_num == 0
+
+    def test_neutu_task_export(self, synapses, tmp_path):
+        import json
+
+        path = str(tmp_path / "task.json")
+        synapses.to_neutu_task(path, body_id=77)
+        with open(path) as f:
+            task = json.load(f)
+        assert task["metadata"]["coordinate system"] == "dvid"
+        assert len(task["data"]) == synapses.pre_num
+        assert task["data"][0] == {"body ID": 77, "location": [10, 10, 10]}
+        with pytest.raises(ValueError):
+            synapses.to_neutu_task(str(tmp_path / "task.txt"))
+
     def test_filter_by_bbox_remaps_indices(self, synapses):
         cropped = synapses.filter_by_bbox(BoundingBox((40, 40, 40), (100, 100, 100)))
         assert cropped.pre_num == 2

@@ -1,12 +1,14 @@
 """One-process TPU validation + measurement battery.
 
-The TPU tunnel in this environment serves a single client at a time and
-wedges if probed concurrently or killed mid-compile, so every hardware
-question is answered in ONE process, in priority order, with results
-appended to ``tools/tpu_validation.json`` as they arrive (a crash keeps
-earlier answers).
+The TPU tunnel in this environment serves a single client at a time, takes
+minutes to acquire a device, and wedges if probed concurrently or killed
+mid-compile, so every hardware question is answered in ONE process, in
+priority order (cheapest first), with results appended to
+``tools/tpu_validation.json`` as they arrive (a crash keeps earlier
+answers).  The persistent XLA compilation cache is enabled, so a completed
+run also warms the cache for the driver's later ``bench.py`` invocation.
 
-Run:  python tools/tpu_validation.py
+Run:  nohup python tools/tpu_validation.py > tools/tpu_validation.log 2>&1 &
 """
 from __future__ import annotations
 
@@ -32,6 +34,7 @@ def record(name, value):
 def step(name):
     def deco(fn):
         def run():
+            print(f"--- starting {name} ---", flush=True)
             t0 = time.perf_counter()
             try:
                 value = fn()
@@ -49,12 +52,122 @@ def step(name):
 
 @step("tunnel")
 def check_tunnel():
+    import bench
+
+    bench._enable_compilation_cache()
     import jax
     import jax.numpy as jnp
 
     d = jax.devices()
-    y = (jnp.ones((512, 512)) @ jnp.ones((512, 512))).block_until_ready()
+    (jnp.ones((512, 512)) @ jnp.ones((512, 512))).block_until_ready()
     return str(d)
+
+
+@step("compile_split")
+def compile_split():
+    """Trace / compile / run split for the fused identity program at a
+    medium shape — isolates whether round-1's ~25 min/config was XLA
+    compile or execution."""
+    import jax
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.inference import engines
+    from chunkflow_tpu.inference.bump import bump_map
+    from chunkflow_tpu.inference.patching import (
+        enumerate_patches,
+        pad_to_batch,
+    )
+    from chunkflow_tpu.ops.blend import build_local_blend, normalize_blend
+
+    pin = pout = (16, 128, 128)
+    engine = engines.create_identity_engine(
+        input_patch_size=pin, output_patch_size=pout,
+        num_input_channels=1, num_output_channels=3,
+    )
+    local_blend = build_local_blend(
+        engine.apply, 1, 3, pin, pout, 2, bump_map(pout))
+
+    def program(chunk, s_in, s_out, valid, params):
+        return normalize_blend(*local_blend(chunk, s_in, s_out, valid, params))
+
+    shape = (1, 32, 256, 256)
+    grid = enumerate_patches(shape, pin, pout, (4, 32, 32))
+    s_in, s_out, valid = pad_to_batch(grid, 2)
+    args = (jnp.zeros(shape, jnp.float32), jnp.asarray(s_in),
+            jnp.asarray(s_out), jnp.asarray(valid), engine.params)
+    t0 = time.perf_counter()
+    lowered = jax.jit(program).lower(*args)
+    t1 = time.perf_counter()
+    compiled = lowered.compile()
+    t2 = time.perf_counter()
+    compiled(*args)[0].block_until_ready()
+    t3 = time.perf_counter()
+    return {"trace_s": round(t1 - t0, 2), "compile_s": round(t2 - t1, 2),
+            "run_s": round(t3 - t2, 3)}
+
+
+def _fwd_time(model, params, x, n=3):
+    import jax
+
+    f = jax.jit(lambda p, v: model.apply({"params": p}, v))
+    t0 = time.perf_counter()
+    f(params, x).block_until_ready()
+    warmup = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n):
+        f(params, x).block_until_ready()
+    dt = (time.perf_counter() - t0) / n
+    print(f"  fwd warmup {warmup:.1f}s steady {dt * 1e3:.1f}ms", flush=True)
+    return dt
+
+
+@step("fwd_parity_f32")
+def fwd_parity():
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.UNet3D(in_channels=1, out_channels=3)
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((2, 20, 256, 256, 1), jnp.float32)
+    dt = _fwd_time(model, params, x)
+    return {"ms": round(dt * 1e3, 1),
+            "mvox_s": round(2 * 20 * 256 * 256 / dt / 1e6, 2)}
+
+
+def _bench(pallas: str, variant: str, dtype: str, batch: int):
+    import bench
+
+    os.environ["CHUNKFLOW_PALLAS"] = pallas
+    return {k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in bench.run_config({
+                "model_variant": variant, "dtype": dtype,
+                "batch_size": batch, "pallas": pallas,
+            }).items()}
+
+
+@step("bench_parity_f32")
+def bench_parity():
+    return _bench("0", "parity", "float32", 2)
+
+
+@step("fwd_tpu_bf16")
+def fwd_tpu_variant():
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.create_tpu_optimized_model()
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
+    dt = _fwd_time(model, params, x)
+    return {"ms": round(dt * 1e3, 1),
+            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
+
+
+@step("bench_tpu_bf16_xla")
+def bench_flagship_xla():
+    return _bench("0", "tpu", "bfloat16", 4)
 
 
 @step("pallas_oracle")
@@ -81,74 +194,9 @@ def check_pallas_oracle():
     return {"mse": mse}
 
 
-def _fwd_time(model, params, x, n=3):
-    import jax
-
-    f = jax.jit(lambda p, v: model.apply({"params": p}, v))
-    f(params, x).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(n):
-        f(params, x).block_until_ready()
-    return (time.perf_counter() - t0) / n
-
-
-@step("fwd_parity_f32")
-def fwd_parity():
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.UNet3D(in_channels=1, out_channels=3)
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((2, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(2 * 20 * 256 * 256 / dt / 1e6, 2)}
-
-
-@step("fwd_tpu_bf16")
-def fwd_tpu_variant():
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.create_tpu_optimized_model()
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
-
-
-def _bench(pallas: str, variant: str, dtype: str, batch: int):
-    import importlib
-
-    os.environ["CHUNKFLOW_PALLAS"] = pallas
-    os.environ["CHUNKFLOW_BENCH_VARIANT"] = variant
-    os.environ["CHUNKFLOW_BENCH_DTYPE"] = dtype
-    os.environ["CHUNKFLOW_BENCH_BATCH"] = str(batch)
-    import bench
-
-    importlib.reload(bench)
-    return {"mvox_s": round(bench.run_config({
-        "model_variant": variant, "dtype": dtype,
-        "batch_size": batch, "pallas": pallas,
-    }), 2)}
-
-
-@step("bench_tpu_bf16_xla")
-def bench_flagship_xla():
-    return _bench("0", "tpu", "bfloat16", 4)
-
-
 @step("bench_tpu_bf16_pallas")
 def bench_flagship_pallas():
     return _bench("1", "tpu", "bfloat16", 4)
-
-
-@step("bench_parity_f32")
-def bench_parity():
-    return _bench("0", "parity", "float32", 2)
 
 
 @step("entry_compile")
@@ -164,9 +212,9 @@ def entry_compile():
 
 
 def main():
-    steps = [check_tunnel, check_pallas_oracle, fwd_parity, fwd_tpu_variant,
-             bench_flagship_xla, bench_flagship_pallas, bench_parity,
-             entry_compile]
+    steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
+             fwd_tpu_variant, bench_flagship_xla, check_pallas_oracle,
+             bench_flagship_pallas, entry_compile]
     if not steps[0]():
         print("tunnel unavailable; aborting", file=sys.stderr)
         return 1
