@@ -198,7 +198,7 @@ def main():
             signal.alarm(budget_s)
         try:
             stats = run_config(cfg)
-        except (_ConfigTimeout, Exception):
+        except Exception:  # incl. _ConfigTimeout
             _record(results, name, {
                 "ok": False,
                 "error": traceback.format_exc()[-4000:],

@@ -245,10 +245,17 @@ def fetch_task_from_file_cmd(task_file, job_index, granularity, disbatch):
         boxes = list(BoundingBoxes.from_file(task_file))
         start = index * granularity
         if start >= len(boxes):
-            raise click.UsageError(
-                f"job index {index} x granularity {granularity} exceeds "
-                f"the {len(boxes)}-task file — shard silently dropped?"
-            )
+            if disbatch:
+                # a disBatch index addresses exactly one task; out of range
+                # is a dropped shard (the reference asserts the same,
+                # flow/flow.py:154)
+                raise click.UsageError(
+                    f"DISBATCH_REPEAT_INDEX={index} x granularity "
+                    f"{granularity} exceeds the {len(boxes)}-task file"
+                )
+            # ragged tail of an over-provisioned SLURM array: a valid no-op
+            print(f"job index {index}: no tasks in the {len(boxes)}-task "
+                  "file; exiting cleanly")
         for bbox in boxes[start:start + granularity]:
             t = new_task()
             t["bbox"] = bbox
@@ -1018,7 +1025,8 @@ def copy_var_cmd(from_name, to_name):
 @click.option("--mask-myelin-threshold", type=float, default=None)
 @click.option("--dtype", type=click.Choice(["float32", "bfloat16"]), default="float32")
 @click.option(
-    "--model-variant", type=click.Choice(["parity", "tpu"]), default="parity",
+    "--model-variant", type=click.Choice(["parity", "rsunet", "tpu"]),
+    default="parity",
     help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
 )
 @click.option(

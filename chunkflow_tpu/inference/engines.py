@@ -69,26 +69,34 @@ def create_flax_engine(
 ) -> Engine:
     """The native convnet engine: a Flax 3D UNet (or user model file).
 
-    ``model_path`` may be empty (use the built-in UNet) or a python file
+    ``model_path`` may be empty (use the built-in model), a python file
     exposing ``create_model(num_input_channels, num_output_channels)`` that
-    returns a Flax module. ``weight_path`` may be a ``.pt`` torch state dict
+    returns a Flax module, or a reference-chunkflow pytorch ``model.py``
+    (``InstantiatedModel`` / ``load_model`` contract, patch/pytorch.py:48-83)
+    whose weights are converted by name into the Flax mirror selected by
+    ``model_variant``. ``weight_path`` may be a ``.pt`` torch state dict
     (converted) or an orbax/msgpack flax checkpoint. ``model_variant``:
-    'parity' is the reference-class UNet (torch-convertible); 'tpu' is the
-    space-to-depth flagship (unet3d.create_tpu_optimized_model).
+    'parity' is the reference-class UNet; 'rsunet' the production RSUNet
+    mirror (models/rsunet.py); 'tpu' the space-to-depth flagship
+    (unet3d.create_tpu_optimized_model).
     """
-    from chunkflow_tpu.models import unet3d
+    from chunkflow_tpu.models import rsunet, unet3d
 
     compute_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    if model_path and model_variant != "parity":
-        raise ValueError(
-            "--model-path and --model-variant are mutually exclusive: a "
-            "user model file defines its own architecture"
-        )
+    module = None
     if model_path:
         module = _load_user_module(model_path, "chunkflow_user_model")
+
+    if module is not None and hasattr(module, "create_model"):
         model = module.create_model(num_input_channels, num_output_channels)
     elif model_variant == "tpu":
         model = unet3d.create_tpu_optimized_model(
+            in_channels=num_input_channels,
+            out_channels=num_output_channels,
+            dtype=compute_dtype,
+        )
+    elif model_variant == "rsunet":
+        model = rsunet.RSUNet(
             in_channels=num_input_channels,
             out_channels=num_output_channels,
             dtype=compute_dtype,
@@ -100,9 +108,20 @@ def create_flax_engine(
             dtype=compute_dtype,
         )
 
-    params = unet3d.init_or_load_params(
-        model, weight_path, input_patch_size, num_input_channels
-    )
+    if module is not None and not hasattr(module, "create_model"):
+        # reference pytorch engine contract: migrate the torch weights
+        from chunkflow_tpu.models.migrate import (
+            flax_params_from_reference_model,
+        )
+
+        params = flax_params_from_reference_model(
+            model_path, weight_path, model, input_patch_size,
+            num_input_channels, module=module,
+        )
+    else:
+        params = unet3d.init_or_load_params(
+            model, weight_path, input_patch_size, num_input_channels
+        )
 
     def apply(params, batch):
         # batch: [B, C, z, y, x] float32 -> channels-last for TPU conv

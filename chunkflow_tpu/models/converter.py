@@ -86,6 +86,119 @@ def _torch_to_flax_layout(name: str, value: np.ndarray, target_shape) -> np.ndar
     return value
 
 
+def _as_numpy_state(path_or_state) -> Dict[str, np.ndarray]:
+    if isinstance(path_or_state, str):
+        return load_torch_state_dict(path_or_state)
+    return {
+        k.removeprefix("module."): (
+            v.detach().cpu().numpy() if hasattr(v, "detach")
+            else np.asarray(v)
+        )
+        for k, v in path_or_state.items()
+    }
+
+
+_BN_STATS = (".running_mean", ".running_var", ".num_batches_tracked")
+
+
+class NameConversionError(KeyError):
+    """Name-based conversion failed; ``matched`` counts the flax leaves
+    that DID find a torch parameter (0 means the trees share no names and a
+    positional fallback is safe; >0 means the names were meant to match and
+    falling back would risk silent mis-pairing)."""
+
+    def __init__(self, message: str, matched: int):
+        super().__init__(message)
+        self.matched = matched
+
+
+def torch_to_flax_by_name(path_or_state, flax_template, name_map=None,
+                          eps: float = 1e-5):
+    """Convert a torch state dict to flax params by PARAMETER NAME.
+
+    Unlike :func:`torch_to_flax` (positional pairing, which requires the
+    torch model to define submodules in execution order), this pairs each
+    flax leaf ``a/b/c/kernel`` with the torch key ``a.b.c.weight`` — robust
+    to arbitrary torch ``__init__`` definition order, which is what real
+    reference-user checkpoints have (patch/pytorch.py:48-60 loads whatever
+    the user's model.py defines).
+
+    BatchNorm folding: a flax ``scale``/``bias`` leaf whose torch module
+    has ``running_mean``/``running_var`` is converted to the inference
+    affine ``scale = gamma / sqrt(var + eps)``, ``bias = beta - mean *
+    scale`` (the same fold the reference's BatchNorm3d->InstanceNorm3d
+    migration script exists to avoid, examples/inference/
+    batchnorm3d_to_instancenorm3d.py).
+
+    ``name_map`` renames flax module prefixes to torch ones (e.g.
+    ``{"embed": "input_block.conv"}``) when the trees don't share names.
+    """
+    state = _as_numpy_state(path_or_state)
+    name_map = name_map or {}
+    converted: Dict[Tuple[str, ...], np.ndarray] = {}
+    used: set = set()
+    missing: List[str] = []
+
+    for path, fval in _flatten(flax_template):
+        mods, leaf = path[:-1], path[-1]
+        prefix = ".".join(mods)
+        prefix = name_map.get(prefix, prefix)
+        out = None
+        if leaf == "kernel":
+            key = f"{prefix}.weight"
+            if key in state:
+                out = _torch_to_flax_layout(key, state[key], np.shape(fval))
+                used.add(key)
+        elif leaf in ("scale", "bias"):
+            mean_key = f"{prefix}.running_mean"
+            if mean_key in state:  # BatchNorm -> folded affine
+                var = state[f"{prefix}.running_var"]
+                gamma = state.get(f"{prefix}.weight", np.ones_like(var))
+                beta = state.get(f"{prefix}.bias", np.zeros_like(var))
+                scale = gamma / np.sqrt(var + eps)
+                out = scale if leaf == "scale" else beta - state[mean_key] * scale
+                used.update(
+                    k for k in (
+                        f"{prefix}.weight", f"{prefix}.bias", mean_key,
+                        f"{prefix}.running_var",
+                        f"{prefix}.num_batches_tracked",
+                    ) if k in state
+                )
+            else:
+                key = f"{prefix}.weight" if leaf == "scale" else f"{prefix}.bias"
+                if key in state:
+                    out = state[key]
+                    used.add(key)
+        if out is None:
+            missing.append(f"{'/'.join(path)} (looked for '{prefix}.*')")
+            continue
+        if np.shape(out) != np.shape(fval):
+            raise ValueError(
+                f"shape mismatch converting {prefix} {np.shape(out)} -> "
+                f"{'/'.join(path)} {np.shape(fval)}"
+            )
+        converted[path] = jnp.asarray(out)
+
+    if missing:
+        raise NameConversionError(
+            f"no torch parameter found for flax leaves: {missing[:8]}"
+            f"{'...' if len(missing) > 8 else ''}; available torch keys "
+            f"include {sorted(state)[:8]}... (pass name_map to bridge "
+            f"naming differences)",
+            matched=len(converted),
+        )
+    leftovers = [
+        k for k in state
+        if k not in used and not k.endswith(_BN_STATS)
+    ]
+    if leftovers:
+        raise ValueError(
+            f"torch parameters not consumed by the flax template: "
+            f"{leftovers[:8]}{'...' if len(leftovers) > 8 else ''}"
+        )
+    return _unflatten(converted)
+
+
 def torch_to_flax(path_or_state, flax_template):
     """Convert a torch state dict to params matching ``flax_template``.
 
@@ -93,14 +206,7 @@ def torch_to_flax(path_or_state, flax_template):
     scales, biases), which is robust for mirrored architectures; every pair
     is shape-checked after layout transposition.
     """
-    if isinstance(path_or_state, str):
-        state = load_torch_state_dict(path_or_state)
-    else:
-        state = {
-            k: (v.detach().cpu().numpy() if hasattr(v, "detach") else np.asarray(v))
-            for k, v in path_or_state.items()
-        }
-
+    state = _as_numpy_state(path_or_state)
     flax_leaves = _flatten(flax_template)
 
     def category(name: str, value: np.ndarray) -> str:

@@ -1,0 +1,84 @@
+"""Load reference-chunkflow pytorch model files into the Flax engine.
+
+The reference's pytorch engine contract (patch/pytorch.py:48-83): a user
+``model.py`` exposing ``InstantiatedModel`` (a constructed torch module),
+and optionally ``load_model(weight_path)`` (custom deserialization),
+``pre_process`` and ``post_process`` hooks.  An existing chunkflow user
+migrates by pointing ``--framework flax --model-path model.py
+--weight-path model.pt`` at the same files: this module executes the
+model.py with the same conventions, extracts the torch ``state_dict``,
+and converts it BY PARAMETER NAME into the Flax mirror (RSUNet by default)
+with BatchNorm folding.
+
+``pre_process``/``post_process`` are torch-tensor hooks and cannot run
+inside an XLA program; models that need them (dict-unwrapping, custom
+activations) should expose ``create_model`` (a Flax factory) or use the
+``universal`` engine, which runs arbitrary user code.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def load_torch_module(path: str):
+    """Execute a user model.py the way the reference does
+    (chunkflow/lib/__init__.py:5-16 load_source)."""
+    name = "chunkflow_user_torch_model"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def state_dict_from_reference_model(model_py: str,
+                                    weight_path: Optional[str],
+                                    module=None):
+    """Torch state dict via the reference model.py contract.
+
+    Honors ``load_model(weight_path)`` when defined; otherwise uses
+    ``InstantiatedModel`` + ``load_state_dict`` (accepting checkpoints
+    that wrap the state dict under a 'state_dict' key, like the
+    reference at patch/pytorch.py:58-60). Pass ``module`` when the
+    model.py has already been executed — re-executing it would rebuild
+    the torch model and replay any module-level side effects.
+    """
+    import torch
+
+    if module is None:
+        module = load_torch_module(model_py)
+    if hasattr(module, "load_model"):
+        model = module.load_model(weight_path)
+    elif hasattr(module, "InstantiatedModel"):
+        model = module.InstantiatedModel
+        if weight_path:
+            chkpt = torch.load(weight_path, map_location="cpu",
+                               weights_only=True)
+            if isinstance(chkpt, dict) and "state_dict" in chkpt:
+                chkpt = chkpt["state_dict"]
+            model.load_state_dict(chkpt)
+    else:
+        raise ValueError(
+            f"{model_py} defines neither load_model nor InstantiatedModel "
+            "(the reference pytorch engine contract)"
+        )
+    return {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+
+
+def flax_params_from_reference_model(model_py: str, weight_path: str,
+                                     flax_model, input_patch_size,
+                                     num_input_channels: int = 1,
+                                     name_map=None, module=None):
+    """state_dict(model.py/.pt) -> flax params for ``flax_model``."""
+    from chunkflow_tpu.models.converter import torch_to_flax_by_name
+    from chunkflow_tpu.models.unet3d import init_params
+
+    state = state_dict_from_reference_model(model_py, weight_path,
+                                            module=module)
+    template = init_params(flax_model, input_patch_size, num_input_channels)
+    return torch_to_flax_by_name(state, template, name_map=name_map)

@@ -33,14 +33,20 @@ class ConvBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        # submodule names mirror the torch conventions (conv1/norm1/...)
+        # so checkpoint conversion can pair parameters by name
         residual = x
-        x = nn.Conv(self.features, (3, 3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.Conv(self.features, (3, 3, 3), padding="SAME",
+                    dtype=self.dtype, name="conv1")(x)
         x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
-                         dtype=self.dtype, use_fast_variance=False)(x)
+                         dtype=self.dtype, use_fast_variance=False,
+                         name="norm1")(x)
         x = nn.elu(x)
-        x = nn.Conv(self.features, (3, 3, 3), padding="SAME", dtype=self.dtype)(x)
+        x = nn.Conv(self.features, (3, 3, 3), padding="SAME",
+                    dtype=self.dtype, name="conv2")(x)
         x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
-                         dtype=self.dtype, use_fast_variance=False)(x)
+                         dtype=self.dtype, use_fast_variance=False,
+                         name="norm2")(x)
         if residual.shape[-1] == self.features:
             x = x + residual
         x = nn.elu(x)
@@ -196,10 +202,27 @@ def init_or_load_params(
     if not os.path.exists(weight_path):
         raise FileNotFoundError(f"weights not found: {weight_path}")
     if weight_path.endswith((".pt", ".pth")):
-        from chunkflow_tpu.models.converter import torch_to_flax
+        from chunkflow_tpu.models.converter import (
+            NameConversionError,
+            load_torch_state_dict,
+            torch_to_flax,
+            torch_to_flax_by_name,
+        )
 
         template = init_params(model, input_patch_size, num_input_channels)
-        return torch_to_flax(weight_path, template)
+        state = load_torch_state_dict(weight_path)
+        try:
+            # name-based pairing first: exact for mirrored module names
+            # (e.g. RSUNet checkpoints), independent of definition order
+            return torch_to_flax_by_name(state, template)
+        except NameConversionError as e:
+            if e.matched > 0:
+                # the trees clearly share names; a positional fallback
+                # could silently pair same-shape tensors to wrong layers
+                raise
+            # disjoint naming: positional pairing for models whose
+            # definition order mirrors execution order
+            return torch_to_flax(state, template)
     if weight_path.endswith(".msgpack"):
         from flax import serialization
 

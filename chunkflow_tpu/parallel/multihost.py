@@ -56,7 +56,10 @@ def initialize(
             process_id=process_id,
         )
     except RuntimeError as e:  # raised when already initialized elsewhere
-        if "already initialized" not in str(e).lower():
+        # jax has used both "already initialized" and "should only be
+        # called once" for this condition across versions
+        msg = str(e).lower()
+        if "already initialized" not in msg and "called once" not in msg:
             raise
     _initialized = True
 

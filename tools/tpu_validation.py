@@ -215,6 +215,11 @@ def main():
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
              fwd_tpu_variant, bench_flagship_xla, check_pallas_oracle,
              bench_flagship_pallas, entry_compile]
+    # NOTE: jax caches backend-init failure in-process, so a failed tunnel
+    # cannot be retried here — rerun the whole script (fresh process) after
+    # a cool-down, e.g.:
+    #   for i in $(seq 8); do python tools/tpu_validation.py && break; \
+    #       sleep 300; done
     if not steps[0]():
         print("tunnel unavailable; aborting", file=sys.stderr)
         return 1
