@@ -3,9 +3,10 @@
 This is the pure function version of the hot loop (reference inferencer.py
 :404-455 + chunk/base.py:792-807, redesigned as one XLA program): scan over
 patch batches, vmap(dynamic_slice) gather, engine forward, bump multiply,
-fori_loop scatter-add into output + weight buffers. ``Inferencer`` runs it
-per chip; ``parallel.distributed`` wraps it in shard_map and psums the
-buffers over the mesh.
+then one ``lax.scatter_add`` per buffer per batch (or the pallas DMA kernel
+on TPU backends) to accumulate into the output + weight buffers.
+``Inferencer`` runs it per chip; ``parallel.distributed`` wraps it in
+shard_map and psums the buffers over the mesh.
 """
 from __future__ import annotations
 
@@ -38,11 +39,18 @@ def build_local_blend(
 
     mode = pallas_blend.pallas_mode()
 
+    # The pallas kernel only DMAs (8,128)-aligned windows, so its buffers
+    # carry high-side padding that is cropped off after the scan.
+    pad_y, pad_x = (
+        pallas_blend.buffer_padding(pout) if mode != "off" else (0, 0)
+    )
+
     def local_blend(chunk, in_starts, out_starts, valid, params):
         zyx = chunk.shape[1:]
+        zyx_buf = (zyx[0], zyx[1] + pad_y, zyx[2] + pad_x)
         num_batches = in_starts.shape[0] // batch_size
-        out0 = jnp.zeros((co,) + zyx, dtype=jnp.float32)
-        w0 = jnp.zeros(zyx, dtype=jnp.float32)
+        out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
+        w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
 
         def step(carry, b):
             out, weight = carry
@@ -68,23 +76,34 @@ def build_local_blend(
                 )
                 return (out, weight), None
 
-            def blend_one(j, ow):
-                out, weight = ow
-                s = s_out[j]
-                at4 = (0, s[0], s[1], s[2])
-                cur = lax.dynamic_slice(out, at4, (co,) + pout)
-                out = lax.dynamic_update_slice(out, cur + weighted[j], at4)
-                at3 = (s[0], s[1], s[2])
-                curw = lax.dynamic_slice(weight, at3, pout)
-                weight = lax.dynamic_update_slice(weight, curw + wpatch[j], at3)
-                return out, weight
-
-            out, weight = lax.fori_loop(
-                0, batch_size, blend_one, (out, weight)
+            # One scatter-add per buffer per batch. The obvious
+            # slice+add+update_slice loop forces XLA to materialize a full
+            # buffer copy per patch (read-modify-write hazard): measured
+            # 0.63 Mvoxel/s end-to-end on a v5e vs 9.2 for the raw forward.
+            # scatter-add has no read hazard, so XLA keeps it in place;
+            # duplicate (overlapping) windows are legal for the add variant.
+            out = lax.scatter_add(
+                out, s_out, weighted,
+                lax.ScatterDimensionNumbers(
+                    update_window_dims=(1, 2, 3, 4),
+                    inserted_window_dims=(),
+                    scatter_dims_to_operand_dims=(1, 2, 3),
+                ),
+            )
+            weight = lax.scatter_add(
+                weight, s_out, wpatch,
+                lax.ScatterDimensionNumbers(
+                    update_window_dims=(1, 2, 3),
+                    inserted_window_dims=(),
+                    scatter_dims_to_operand_dims=(0, 1, 2),
+                ),
             )
             return (out, weight), None
 
         (out, weight), _ = lax.scan(step, (out0, w0), jnp.arange(num_batches))
+        if pad_y or pad_x:
+            out = out[:, :, : zyx[1], : zyx[2]]
+            weight = weight[:, : zyx[1], : zyx[2]]
         return out, weight
 
     return local_blend

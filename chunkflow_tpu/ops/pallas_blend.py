@@ -2,18 +2,21 @@
 
 The fused inference program's scatter-add (ops/blend.py) is, per patch, a
 read-modify-write of a [co, *pout] region of the HBM-resident output buffer
-plus the same for the weight buffer. The XLA path expresses it as
-``fori_loop`` + ``dynamic_update_slice``; this kernel does the same job as
-one ``pallas_call`` over a (B, co, pz) grid with explicit HBM<->VMEM DMAs:
+plus the same for the weight buffer. The XLA path expresses it as one
+``lax.scatter_add`` per batch; this kernel does the same job as one
+``pallas_call`` over a (B, co, pz) grid with explicit HBM<->VMEM DMAs:
 
 - the output/weight buffers stay in HBM (``pl.ANY``) and are aliased
   in-place (``input_output_aliases``), so no full-buffer copies;
-- per grid step one (py, px) tile rides DMA into VMEM scratch, the
-  pre-weighted prediction tile is added (the multiply happened on the VPU
-  as part of the producing fusion), and the tile rides back;
+- per grid step one (8,128)-aligned window covering the patch tile rides
+  DMA into VMEM scratch, the pre-weighted prediction tile (pre-scattered
+  into the same aligned window on the XLA side) is added, and the window
+  rides back — Mosaic requires DMA slice corners provably divisible by
+  the (8,128) tiling, which raw patch strides do not satisfy;
 - the TPU grid is sequential, so overlapping patches accumulate without
-  races — exactly the property the reference gets from its Python loop
-  (chunk/base.py:792-807) and the XLA path gets from ``fori_loop``.
+  races — the property the reference gets from its Python loop
+  (chunk/base.py:792-807) and the XLA path gets from scatter-add's
+  defined duplicate-index semantics.
 
 Selection: ``blend.build_local_blend`` uses this kernel on TPU backends
 (opt out with CHUNKFLOW_PALLAS=0); tests run it in interpret mode on CPU
@@ -59,22 +62,71 @@ def _tpu_like_backend() -> bool:
     return platform in ("tpu", "axon") or "tpu" in kind
 
 
+# Mosaic tiling of the two minor dims: DMA slice offsets into a tiled HBM
+# memref must be *provably* divisible by these (round-1 hardware failure:
+# "Failed to prove that a tile index in dimension 2 is divisible by the
+# tiling (8)"). Patch strides carry no such guarantee, so the kernel only
+# ever DMAs windows whose corners are rounded down to this alignment; the
+# patch is pre-scattered into its aligned window on the XLA side.
+_SUBLANE = 8
+_LANE = 128
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def padded_patch_shape(py: int, px: int) -> Tuple[int, int]:
+    """(py_pad, px_pad): the aligned window that covers a (py, px) patch
+    placed at any within-window offset (dy, dx) in [0,8) x [0,128)."""
+    return (_round_up(py + _SUBLANE - 1, _SUBLANE),
+            _round_up(px + _LANE - 1, _LANE))
+
+
+def buffer_padding(pout: Triple) -> Tuple[int, int]:
+    """Extra (Y, X) high-side padding the out/weight buffers need so every
+    aligned window lies in bounds (worst case: a patch ending flush at the
+    buffer edge whose aligned corner rounds down by up to 7/127)."""
+    py_pad, px_pad = padded_patch_shape(pout[1], pout[2])
+    return (py_pad - pout[1], px_pad - pout[2])
+
+
 def accumulate_patches(out, weight, preds, wpatches, out_starts,
                        interpret: bool = False):
     """out[:, s:s+p] += preds[b]; weight[s:s+p] += wpatches[b] for every b.
 
-    out:      [co, Z, Y, X] f32   (donated, updated in place)
-    weight:   [Z, Y, X] f32       (donated, updated in place)
+    out:      [co, Z, Y+pad, X+pad] f32  (donated, updated in place;
+              padded per ``buffer_padding`` — caller crops afterwards)
+    weight:   [Z, Y+pad, X+pad] f32      (donated, updated in place)
     preds:    [B, co, pz, py, px] f32, already bump*validity weighted
     wpatches: [B, pz, py, px] f32
     out_starts: [B, 3] int32 zyx corners (within-bounds, batch-padded)
     """
     import jax
     import jax.numpy as jnp
+    from jax import lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, co, pz, py, px = preds.shape
+    py_pad, px_pad = padded_patch_shape(py, px)
+
+    # Aligned window corner per patch + the patch's offset within it.
+    z0 = out_starts[:, 0]
+    y0a = (out_starts[:, 1] // _SUBLANE) * _SUBLANE
+    x0a = (out_starts[:, 2] // _LANE) * _LANE
+    starts_aligned = jnp.stack([z0, y0a, x0a], axis=1)
+    dyx = jnp.stack([out_starts[:, 1] - y0a, out_starts[:, 2] - x0a], axis=1)
+
+    # Pre-scatter each patch into its zero-padded aligned window (VPU work
+    # fused by XLA into the producing bump-multiply).
+    def place(patch, d):
+        padded = jnp.zeros(patch.shape[:-2] + (py_pad, px_pad), patch.dtype)
+        at = (0,) * (patch.ndim - 2) + (d[0], d[1])
+        return lax.dynamic_update_slice(padded, patch, at)
+
+    preds_pad = jax.vmap(place)(preds, dyx)
+    wpatches_pad = jax.vmap(place)(wpatches, dyx)
 
     def kernel(starts_ref, preds_ref, wpatch_ref, out_in, w_in, out_ref,
                w_ref, scratch, sem_in, sem_out):
@@ -82,10 +134,10 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
         c = pl.program_id(1)
         k = pl.program_id(2)
         z0 = starts_ref[b, 0]
-        y0 = starts_ref[b, 1]
-        x0 = starts_ref[b, 2]
+        y0 = pl.multiple_of(starts_ref[b, 1], _SUBLANE)
+        x0 = pl.multiple_of(starts_ref[b, 2], _LANE)
 
-        tile = out_ref.at[c, z0 + k, pl.ds(y0, py), pl.ds(x0, px)]
+        tile = out_ref.at[c, z0 + k, pl.ds(y0, py_pad), pl.ds(x0, px_pad)]
         load = pltpu.make_async_copy(tile, scratch, sem_in)
         load.start()
         load.wait()
@@ -96,7 +148,7 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
 
         @pl.when(c == 0)
         def _():
-            wtile = w_ref.at[z0 + k, pl.ds(y0, py), pl.ds(x0, px)]
+            wtile = w_ref.at[z0 + k, pl.ds(y0, py_pad), pl.ds(x0, px_pad)]
             wload = pltpu.make_async_copy(wtile, scratch, sem_in)
             wload.start()
             wload.wait()
@@ -110,9 +162,12 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
         grid=(B, co, pz),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 1, py, px), lambda b, c, k, starts: (b, c, k, 0, 0)
+                (1, 1, 1, py_pad, px_pad),
+                lambda b, c, k, starts: (b, c, k, 0, 0),
             ),
-            pl.BlockSpec((1, 1, py, px), lambda b, c, k, starts: (b, k, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, py_pad, px_pad), lambda b, c, k, starts: (b, k, 0, 0)
+            ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -121,7 +176,7 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         scratch_shapes=[
-            pltpu.VMEM((py, px), jnp.float32),
+            pltpu.VMEM((py_pad, px_pad), jnp.float32),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
         ],
@@ -134,8 +189,9 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
             jax.ShapeDtypeStruct(out.shape, out.dtype),
             jax.ShapeDtypeStruct(weight.shape, weight.dtype),
         ],
-        # tensor inputs (after the scalar-prefetch arg): preds, wpatches,
-        # out, weight -> indices 1..4; alias out->output0, weight->output1
+        # tensor inputs (after the scalar-prefetch arg): preds_pad,
+        # wpatches_pad, out, weight -> indices 1..4; alias out->output0,
+        # weight->output1
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
-    )(out_starts, preds, wpatches, out, weight)
+    )(starts_aligned, preds_pad, wpatches_pad, out, weight)

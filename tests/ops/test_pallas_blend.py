@@ -7,7 +7,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 
-def _run_identity(monkeypatch, mode):
+def _run_identity(monkeypatch, mode, shape=(8, 32, 32)):
     monkeypatch.setenv("CHUNKFLOW_PALLAS", mode)
     # build_local_blend reads CHUNKFLOW_PALLAS when the Inferencer is built
     from chunkflow_tpu.inference.inferencer import Inferencer
@@ -22,22 +22,52 @@ def _run_identity(monkeypatch, mode):
         crop_output_margin=False,
     )
     rng = np.random.default_rng(0)
-    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
-    return np.asarray(inferencer(chunk).array)
+    chunk = Chunk(rng.random(shape).astype(np.float32))
+    return chunk, np.asarray(inferencer(chunk).array)
 
 
-def test_pallas_accumulate_matches_xla(monkeypatch):
-    ref = _run_identity(monkeypatch, "0")
-    got = _run_identity(monkeypatch, "interpret")
+# (9, 35, 33) produces patch corners with no (8,128) alignment at all —
+# exercises the aligned-window machinery end to end
+@pytest.mark.parametrize("shape", [(8, 32, 32), (9, 35, 33)])
+def test_pallas_accumulate_matches_xla(monkeypatch, shape):
+    _, ref = _run_identity(monkeypatch, "0", shape)
+    _, got = _run_identity(monkeypatch, "interpret", shape)
     np.testing.assert_allclose(got, ref, atol=1e-5)
 
 
-def test_pallas_identity_oracle(monkeypatch):
-    got = _run_identity(monkeypatch, "interpret")
+@pytest.mark.parametrize("shape", [(8, 32, 32), (9, 35, 33)])
+def test_pallas_identity_oracle(monkeypatch, shape):
+    chunk, got = _run_identity(monkeypatch, "interpret", shape)
     # identity oracle holds through the pallas scatter path
-    from chunkflow_tpu.chunk.base import Chunk
+    arr = np.asarray(chunk.array)
+    np.testing.assert_allclose(got[0], arr, atol=1e-5)
+    np.testing.assert_allclose(got[1], arr, atol=1e-5)
 
-    rng = np.random.default_rng(0)
-    chunk = rng.random((8, 32, 32)).astype(np.float32)
-    np.testing.assert_allclose(got[0], chunk, atol=1e-5)
-    np.testing.assert_allclose(got[1], chunk, atol=1e-5)
+
+def test_accumulate_patches_unaligned_offsets_vs_numpy():
+    """Direct kernel check: arbitrary (not 8/128-divisible) corners."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.ops import pallas_blend
+
+    rng = np.random.default_rng(3)
+    co, Z, Y, X = 2, 6, 40, 48
+    B, pz, py, px = 3, 2, 9, 11
+    pad_y, pad_x = pallas_blend.buffer_padding((pz, py, px))
+    out = np.zeros((co, Z, Y + pad_y, X + pad_x), np.float32)
+    weight = np.zeros((Z, Y + pad_y, X + pad_x), np.float32)
+    preds = rng.random((B, co, pz, py, px)).astype(np.float32)
+    wpatches = rng.random((B, pz, py, px)).astype(np.float32)
+    starts = np.array([[0, 1, 5], [3, 17, 30], [1, 31, 37]], np.int32)
+
+    got_out, got_w = pallas_blend.accumulate_patches(
+        jnp.asarray(out), jnp.asarray(weight), jnp.asarray(preds),
+        jnp.asarray(wpatches), jnp.asarray(starts), interpret=True,
+    )
+    exp_out, exp_w = out.copy(), weight.copy()
+    for b in range(B):
+        z, y, x = starts[b]
+        exp_out[:, z:z + pz, y:y + py, x:x + px] += preds[b]
+        exp_w[z:z + pz, y:y + py, x:x + px] += wpatches[b]
+    np.testing.assert_allclose(np.asarray(got_out), exp_out, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_w), exp_w, atol=1e-6)

@@ -23,6 +23,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 RESULTS_PATH = os.path.join(os.path.dirname(__file__), "tpu_validation.json")
 RESULTS: dict = {}
 
+# The tunnel can drop mid-battery (observed: 26 min hang, then connection
+# refused). Reruns keep prior successes and only redo failed/missing steps,
+# so a flaky tunnel converges across attempts:
+#   for i in $(seq 8); do python tools/tpu_validation.py && break; sleep 300; done
+# Set CHUNKFLOW_REVALIDATE=1 to force every step to rerun.
+# tpu_validation.json is a gitignored per-run artifact (it doubles as this
+# resume cache, so a tracked copy would skip steps against stale results);
+# completed batteries are committed as frozen tpu_validation_r{N}.json
+# snapshots that nothing reads back.
+if (os.path.exists(RESULTS_PATH)
+        and os.environ.get("CHUNKFLOW_REVALIDATE", "") != "1"):
+    try:
+        with open(RESULTS_PATH) as f:
+            RESULTS = json.load(f)
+    except Exception:
+        RESULTS = {}
+
 
 def record(name, value):
     RESULTS[name] = value
@@ -34,6 +51,13 @@ def record(name, value):
 def step(name):
     def deco(fn):
         def run():
+            prior = RESULTS.get(name)
+            # "tunnel" is the cheap liveness gate for this attempt — a
+            # prior success says nothing about the tunnel being up now
+            if name != "tunnel" and isinstance(prior, dict) and prior.get("ok"):
+                print(f"--- {name}: ok from prior run, skipping ---",
+                      flush=True)
+                return True
             print(f"--- starting {name} ---", flush=True)
             t0 = time.perf_counter()
             try:
@@ -223,9 +247,10 @@ def main():
     if not steps[0]():
         print("tunnel unavailable; aborting", file=sys.stderr)
         return 1
+    ok = True
     for s in steps[1:]:
-        s()
-    return 0
+        ok = s() and ok
+    return 0 if ok else 2
 
 
 if __name__ == "__main__":
