@@ -45,62 +45,96 @@ def build_local_blend(
         pallas_blend.buffer_padding(pout) if mode != "off" else (0, 0)
     )
 
+    # Stacking every weighted prediction and accumulating ONCE (vs once per
+    # scan batch) removes the last per-batch full-buffer traffic: the scan
+    # then carries nothing, its stacked output is written in place, and the
+    # single trailing scatter/pallas-call touches each output window once.
+    # Gated by predicted stack size so jumbo chunks (e.g. 108x2048x2048
+    # production tasks, where the stack would be GBs next to a 5 GB output
+    # buffer) fall back to per-batch accumulation inside the scan.
+    import os
+
+    stack_max_bytes = int(
+        float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2")) * 2**30
+    )
+
+    _DNUMS4 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3, 4),
+        inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(1, 2, 3),
+    )
+    _DNUMS3 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3),
+        inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0, 1, 2),
+    )
+
+    def accumulate(out, weight, weighted, wpatch, starts):
+        if mode != "off":
+            return pallas_blend.accumulate_patches(
+                out, weight, weighted, wpatch, starts,
+                interpret=(mode == "interpret"),
+            )
+        out = lax.scatter_add(out, starts, weighted, _DNUMS4)
+        weight = lax.scatter_add(weight, starts, wpatch, _DNUMS3)
+        return out, weight
+
+    # Per-patch f32 bytes the stacked path keeps alive: the pallas kernel
+    # additionally materializes an (8,128)-aligned padded copy of the stack
+    # (up to several x wider for small patches), so the OOM gate must count
+    # the padded shape, not just pout.
+    patch_bytes = co * pout[0] * pout[1] * pout[2] * 4
+    if mode != "off":
+        py_pad, px_pad = pallas_blend.padded_patch_shape(pout[1], pout[2])
+        patch_bytes += (co + 1) * pout[0] * py_pad * px_pad * 4
+
     def local_blend(chunk, in_starts, out_starts, valid, params):
         zyx = chunk.shape[1:]
         zyx_buf = (zyx[0], zyx[1] + pad_y, zyx[2] + pad_x)
-        num_batches = in_starts.shape[0] // batch_size
+        n = in_starts.shape[0]
+        num_batches = n // batch_size
         out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
         w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
 
-        def step(carry, b):
-            out, weight = carry
+        def forward_batch(b):
             i0 = b * batch_size
             s_in = lax.dynamic_slice(in_starts, (i0, 0), (batch_size, 3))
-            s_out = lax.dynamic_slice(out_starts, (i0, 0), (batch_size, 3))
             v = lax.dynamic_slice(valid, (i0,), (batch_size,))
-
             patches = jax.vmap(
                 lambda s: lax.dynamic_slice(
                     chunk, (0, s[0], s[1], s[2]), (ci,) + pin
                 )
             )(s_in)
             preds = forward(params, patches)
-            weighted = preds * bump[None, None] * v[:, None, None, None, None]
-            wpatch = bump[None] * v[:, None, None, None]
+            return preds * bump[None, None] * v[:, None, None, None, None]
 
-            if mode != "off":
-                # pallas scatter-accumulate: in-place HBM tiles via DMA
-                out, weight = pallas_blend.accumulate_patches(
-                    out, weight, weighted, wpatch, s_out,
-                    interpret=(mode == "interpret"),
+        if n * patch_bytes <= stack_max_bytes:
+            _, all_w = lax.scan(
+                lambda c, b: (c, forward_batch(b)),
+                None,
+                jnp.arange(num_batches),
+            )
+            all_w = all_w.reshape((n, co) + pout)
+            all_wp = bump[None] * valid[:, None, None, None]
+            out, weight = accumulate(out0, w0, all_w, all_wp, out_starts)
+        else:
+            def step(carry, b):
+                out, weight = carry
+                i0 = b * batch_size
+                s_out = lax.dynamic_slice(
+                    out_starts, (i0, 0), (batch_size, 3)
+                )
+                v = lax.dynamic_slice(valid, (i0,), (batch_size,))
+                weighted = forward_batch(b)
+                wpatch = bump[None] * v[:, None, None, None]
+                out, weight = accumulate(
+                    out, weight, weighted, wpatch, s_out
                 )
                 return (out, weight), None
 
-            # One scatter-add per buffer per batch. The obvious
-            # slice+add+update_slice loop forces XLA to materialize a full
-            # buffer copy per patch (read-modify-write hazard): measured
-            # 0.63 Mvoxel/s end-to-end on a v5e vs 9.2 for the raw forward.
-            # scatter-add has no read hazard, so XLA keeps it in place;
-            # duplicate (overlapping) windows are legal for the add variant.
-            out = lax.scatter_add(
-                out, s_out, weighted,
-                lax.ScatterDimensionNumbers(
-                    update_window_dims=(1, 2, 3, 4),
-                    inserted_window_dims=(),
-                    scatter_dims_to_operand_dims=(1, 2, 3),
-                ),
+            (out, weight), _ = lax.scan(
+                step, (out0, w0), jnp.arange(num_batches)
             )
-            weight = lax.scatter_add(
-                weight, s_out, wpatch,
-                lax.ScatterDimensionNumbers(
-                    update_window_dims=(1, 2, 3),
-                    inserted_window_dims=(),
-                    scatter_dims_to_operand_dims=(0, 1, 2),
-                ),
-            )
-            return (out, weight), None
-
-        (out, weight), _ = lax.scan(step, (out0, w0), jnp.arange(num_batches))
         if pad_y or pad_x:
             out = out[:, :, : zyx[1], : zyx[2]]
             weight = weight[:, : zyx[1], : zyx[2]]

@@ -44,6 +44,16 @@ def test_pallas_identity_oracle(monkeypatch, shape):
     np.testing.assert_allclose(got[1], arr, atol=1e-5)
 
 
+@pytest.mark.parametrize("mode", ["0", "interpret"])
+def test_blend_per_batch_fallback_matches_stacked(monkeypatch, mode):
+    """Jumbo-chunk fallback (per-batch accumulation inside the scan) must
+    agree with the default stacked single-accumulation path."""
+    _, ref = _run_identity(monkeypatch, mode, (9, 35, 33))
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.0000001")
+    _, got = _run_identity(monkeypatch, mode, (9, 35, 33))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
 def test_accumulate_patches_unaligned_offsets_vs_numpy():
     """Direct kernel check: arbitrary (not 8/128-divisible) corners."""
     import jax.numpy as jnp
