@@ -79,11 +79,11 @@ def build_local_blend(
         weight = lax.scatter_add(weight, starts, wpatch, _DNUMS3)
         return out, weight
 
-    # Per-patch f32 bytes the stacked path keeps alive: the pallas kernel
-    # additionally materializes an (8,128)-aligned padded copy of the stack
-    # (up to several x wider for small patches), so the OOM gate must count
-    # the padded shape, not just pout.
-    patch_bytes = co * pout[0] * pout[1] * pout[2] * 4
+    # Per-patch f32 bytes the stacked path keeps alive: the prediction
+    # stack plus the equal-footprint weight-patch stack, and on the pallas
+    # path additionally their (8,128)-aligned padded copies (up to several
+    # x wider for small patches).
+    patch_bytes = (co + 1) * pout[0] * pout[1] * pout[2] * 4
     if mode != "off":
         py_pad, px_pad = pallas_blend.padded_patch_shape(pout[1], pout[2])
         patch_bytes += (co + 1) * pout[0] * py_pad * px_pad * 4

@@ -225,6 +225,11 @@ def bench_flagship_pallas():
 
 @step("entry_compile")
 def entry_compile():
+    # pin the blend-kernel selection to auto (platform default) so the
+    # certified program doesn't depend on which earlier bench steps ran
+    # (they leak CHUNKFLOW_PALLAS into os.environ) — auto is also what the
+    # driver's own entry() compile-check sees
+    os.environ.pop("CHUNKFLOW_PALLAS", None)
     import jax
 
     import __graft_entry__
