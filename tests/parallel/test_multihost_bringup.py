@@ -8,6 +8,7 @@ coordinator bring-up, global device view, and a cross-process allgather —
 the same code path a v5e-16 pod slice uses (minus ICI).
 """
 import socket
+import time
 import subprocess
 import sys
 
@@ -67,10 +68,20 @@ def test_two_process_distributed_bringup(tmp_path):
         for pid in range(2)
     ]
     try:
-        for pid, p in enumerate(procs):
-            out, _ = p.communicate(timeout=180)
-            assert p.returncode == 0, f"worker {pid} failed:\n{out}"
-            assert f"WORKER_OK {pid}" in out
+        # poll both: a worker that dies before the coordinator barrier
+        # must surface ITS traceback, not a timeout on the healthy peer
+        # (which blocks inside jax.distributed.initialize waiting for it)
+        deadline = time.monotonic() + 180
+        pending = dict(enumerate(procs))
+        while pending and time.monotonic() < deadline:
+            for pid, p in list(pending.items()):
+                if p.poll() is not None:
+                    out, _ = p.communicate()
+                    assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+                    assert f"WORKER_OK {pid}" in out
+                    del pending[pid]
+            time.sleep(0.2)
+        assert not pending, f"workers {sorted(pending)} timed out"
     finally:
         # a failed/hung worker must not leave its peer blocked at the
         # coordinator holding the port
