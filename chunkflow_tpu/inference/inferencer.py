@@ -11,9 +11,9 @@ jit-compiled program over an HBM-resident chunk:
     lax.scan over patch batches
       -> vmap(dynamic_slice) gather         [B, Ci, *Pi]
       -> engine.apply (MXU matmuls/convs)   [B, Co, *Po]
-      -> (optional 8x TTA average)
+      -> (optional 8x TTA average, scanned)
       -> bump multiply + validity mask
-      -> fori_loop scatter-add into output + weight buffers
+      -> single scatter-add / pallas DMA accumulation (ops/blend.py)
     -> out / weight  (exact everywhere, including chunk edges)
 
 Design deltas from the reference, on purpose:
@@ -121,15 +121,21 @@ class Inferencer:
         """Engine forward with optional 8-fold test-time augmentation.
 
         TTA variants are the product of {yx-transpose, y-flip, x-flip}
-        (reference transform.py:114-156), applied statically so XLA unrolls
-        all eight forwards into one program.
+        (reference transform.py:114-156). The eight forwards run as a
+        ``lax.scan`` over the stacked pre-transformed variants so XLA
+        compiles the engine once (instead of unrolling eight compiled
+        UNet copies into the program); the per-variant inverse transforms
+        are static ops applied to the stacked scan output.
         """
         import jax.numpy as jnp
+        from jax import lax
 
         if not self.augment:
             return self.engine.apply(params, patches)
-        acc = None
-        for transpose, flip_y, flip_x in itertools.product((False, True), repeat=3):
+
+        combos = list(itertools.product((False, True), repeat=3))
+        variants = []
+        for transpose, flip_y, flip_x in combos:
             x = patches
             if flip_y:
                 x = jnp.flip(x, axis=-2)
@@ -137,7 +143,16 @@ class Inferencer:
                 x = jnp.flip(x, axis=-1)
             if transpose:
                 x = jnp.swapaxes(x, -1, -2)
-            y = self.engine.apply(params, x)
+            variants.append(x)
+        xs = jnp.stack(variants)  # [8, B, ci, *pin]
+
+        _, ys = lax.scan(
+            lambda c, x: (c, self.engine.apply(params, x)), None, xs
+        )
+
+        acc = None
+        for i, (transpose, flip_y, flip_x) in enumerate(combos):
+            y = ys[i]
             if transpose:
                 y = jnp.swapaxes(y, -1, -2)
             if flip_x:

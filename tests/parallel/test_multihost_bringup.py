@@ -59,14 +59,18 @@ def test_two_process_distributed_bringup(tmp_path):
 
     repo = str(next(iter(chunkflow_tpu.__path__)).rsplit("/", 1)[0])
     coord = f"127.0.0.1:{_free_port()}"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c",
-             WORKER.format(repo=repo, coord=coord, pid=pid)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for pid in range(2)
-    ]
+    # worker output goes to files, not PIPEs: nobody drains a pipe while
+    # polling, so a verbose worker would block in write() and be
+    # misreported as timed out
+    logs = [tmp_path / f"worker{pid}.log" for pid in range(2)]
+    procs = []
+    for pid in range(2):
+        with open(logs[pid], "w") as log:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 WORKER.format(repo=repo, coord=coord, pid=pid)],
+                stdout=log, stderr=subprocess.STDOUT,
+            ))
     try:
         # poll both: a worker that dies before the coordinator barrier
         # must surface ITS traceback, not a timeout on the healthy peer
@@ -76,7 +80,7 @@ def test_two_process_distributed_bringup(tmp_path):
         while pending and time.monotonic() < deadline:
             for pid, p in list(pending.items()):
                 if p.poll() is not None:
-                    out, _ = p.communicate()
+                    out = logs[pid].read_text()
                     assert p.returncode == 0, f"worker {pid} failed:\n{out}"
                     assert f"WORKER_OK {pid}" in out
                     del pending[pid]

@@ -70,6 +70,7 @@ def step(name):
                               "error": traceback.format_exc()[-2000:],
                               "seconds": round(time.perf_counter() - t0, 1)})
                 return False
+        run.step_name = name
         return run
     return deco
 
@@ -254,8 +255,25 @@ def main():
         return 1
     ok = True
     for s in steps[1:]:
-        ok = s() and ok
+        good = s()
+        ok = good and ok
+        if not good and _tunnel_lost(s.step_name):
+            # each further step would hang ~25-50 min inside the axon
+            # client's retry loop before failing the same way; bail so the
+            # outer retry loop gets a fresh process sooner
+            print("tunnel lost mid-battery; aborting remaining steps",
+                  file=sys.stderr)
+            return 1
     return 0 if ok else 2
+
+
+def _tunnel_lost(step_name: str) -> bool:
+    """Did THIS step's failure look like a dead tunnel? (Checking the
+    named entry, not the last dict entry: RESULTS also carries stale
+    errors loaded from a prior run's JSON.)"""
+    entry = RESULTS.get(step_name)
+    err = entry.get("error", "") if isinstance(entry, dict) else ""
+    return "Connection refused" in err or "Connection Failed" in err
 
 
 if __name__ == "__main__":
