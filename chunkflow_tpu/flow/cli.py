@@ -36,6 +36,17 @@ def cartesian_option(*names, default=None, required=False, help=""):
     )
 
 
+def name_option(default):
+    """--name: the operator's key in the task log timer (reference parity:
+    every operator command takes --name so repeated operators — e.g. an
+    input mask and an output mask — get distinct timer entries in
+    log-summary; task-source generators keep fixed names)."""
+    return click.option(
+        "--name", "op_name", type=str, default=default,
+        help="operator name key in the task log timer",
+    )
+
+
 @click.group(chain=True)
 @click.option("--mip", type=int, default=0, help="storage hierarchy level")
 @click.option("--dry-run/--real-run", default=False)
@@ -265,7 +276,8 @@ def fetch_task_from_file_cmd(task_file, job_index, granularity, disbatch):
 
 
 @main.command("debug")
-def debug_cmd():
+@name_option("debug")
+def debug_cmd(op_name, ):
     """Drop into a debugger with the flowing task bound to ``task``."""
 
     @operator
@@ -273,7 +285,7 @@ def debug_cmd():
         breakpoint()  # noqa: T100
         return task
 
-    return stage(_name="debug")
+    return stage(_name=op_name)
 
 
 @main.command("prefetch")
@@ -324,7 +336,8 @@ def fetch_task_cmd(queue_name, visibility_timeout, num):
 
 
 @main.command("delete-task-in-queue")
-def delete_task_cmd():
+@name_option("delete-task-in-queue")
+def delete_task_cmd(op_name, ):
     """Ack the current task: delete it from its queue (commit point)."""
 
     @operator
@@ -334,20 +347,21 @@ def delete_task_cmd():
             queue.delete(task["task_handle"])
         return task
 
-    return stage(_name="delete-task-in-queue")
+    return stage(_name=op_name)
 
 
 # ---------------------------------------------------------------------------
 # chunk creation / I/O
 # ---------------------------------------------------------------------------
 @main.command("create-chunk")
+@name_option("create-chunk")
 @cartesian_option("--size", "-s", default=(64, 64, 64))
 @click.option("--dtype", type=str, default="uint8")
 @click.option("--pattern", type=click.Choice(["sin", "random", "zero"]), default="sin")
 @cartesian_option("--voxel-offset", "-t", default=(0, 0, 0))
 @cartesian_option("--voxel-size", default=(1, 1, 1))
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def create_chunk_cmd(size, dtype, pattern, voxel_offset, voxel_size, output_chunk_name):
+def create_chunk_cmd(op_name, size, dtype, pattern, voxel_offset, voxel_size, output_chunk_name):
     """Create a synthetic chunk (sin/random/zero pattern)."""
 
     @operator
@@ -361,15 +375,16 @@ def create_chunk_cmd(size, dtype, pattern, voxel_offset, voxel_size, output_chun
         )
         return task
 
-    return stage(_name="create-chunk")
+    return stage(_name=op_name)
 
 
 @main.command("load-h5")
+@name_option("load-h5")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--dataset-path", type=str, default="main")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 @cartesian_option("--voxel-offset", default=None)
-def load_h5_cmd(file_name, dataset_path, output_chunk_name, voxel_offset):
+def load_h5_cmd(op_name, file_name, dataset_path, output_chunk_name, voxel_offset):
     @operator
     def stage(task):
         task[output_chunk_name] = Chunk.from_h5(
@@ -380,15 +395,16 @@ def load_h5_cmd(file_name, dataset_path, output_chunk_name, voxel_offset):
         )
         return task
 
-    return stage(_name="load-h5")
+    return stage(_name=op_name)
 
 
 @main.command("save-h5")
+@name_option("save-h5")
 @click.option("--file-name", "-f", type=str, default=None)
 @click.option("--file-name-prefix", type=str, default=None,
               help="write one file per task: <prefix><bbox-string>.h5")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_h5_cmd(file_name, file_name_prefix, input_chunk_name):
+def save_h5_cmd(op_name, file_name, file_name_prefix, input_chunk_name):
     if (file_name is None) == (file_name_prefix is None):
         raise click.UsageError(
             "save-h5 needs exactly one of --file-name / --file-name-prefix"
@@ -405,15 +421,16 @@ def save_h5_cmd(file_name, file_name_prefix, input_chunk_name):
         chunk.to_h5(path)
         return task
 
-    return stage(_name="save-h5")
+    return stage(_name=op_name)
 
 
 @main.command("load-tif")
+@name_option("load-tif")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
 @click.option("--dtype", type=str, default=None)
-def load_tif_cmd(file_name, output_chunk_name, voxel_offset, dtype):
+def load_tif_cmd(op_name, file_name, output_chunk_name, voxel_offset, dtype):
     @operator
     def stage(task):
         task[output_chunk_name] = Chunk.from_tif(
@@ -423,25 +440,27 @@ def load_tif_cmd(file_name, output_chunk_name, voxel_offset, dtype):
         )
         return task
 
-    return stage(_name="load-tif")
+    return stage(_name=op_name)
 
 
 @main.command("save-tif")
+@name_option("save-tif")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_tif_cmd(file_name, input_chunk_name):
+def save_tif_cmd(op_name, file_name, input_chunk_name):
     @operator
     def stage(task):
         task[input_chunk_name].to_tif(file_name)
         return task
 
-    return stage(_name="save-tif")
+    return stage(_name=op_name)
 
 
 # ---------------------------------------------------------------------------
 # precomputed volumes
 # ---------------------------------------------------------------------------
 @main.command("create-info")
+@name_option("create-info")
 @click.option("--volume-path", "-v", type=str, required=True)
 @cartesian_option("--volume-size", "-s", required=True)
 @cartesian_option("--voxel-size", default=(1, 1, 1))
@@ -452,7 +471,7 @@ def save_tif_cmd(file_name, input_chunk_name):
 @cartesian_option("--block-size", default=(64, 64, 64))
 @click.option("--max-mip", type=int, default=0)
 @cartesian_option("--factor", default=(1, 2, 2))
-def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
+def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
                     num_channels, dtype, layer_type, block_size, max_mip, factor):
     """Create a precomputed volume info file (with mip pyramid)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
@@ -473,10 +492,11 @@ def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
         )
         return task
 
-    return stage(_name="create-info")
+    return stage(_name=op_name)
 
 
 @main.command("load-precomputed")
+@name_option("load-precomputed")
 @click.option("--volume-path", "-v", type=str, required=True)
 @click.option("--mip", type=int, default=None, help="defaults to global --mip")
 @cartesian_option("--expand-margin-size", "-e", default=(0, 0, 0))
@@ -490,7 +510,7 @@ def create_info_cmd(volume_path, volume_size, voxel_size, voxel_offset,
               "(the reference asserts exact equality; >0 tolerates pyramid "
               "rounding)")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
+def load_precomputed_cmd(op_name, volume_path, mip, expand_margin_size, fill_missing,
                          blackout_sections, validate_mip, validate_tolerance,
                          output_chunk_name):
     """Cut out the task bbox (plus margins) from a precomputed volume.
@@ -525,7 +545,7 @@ def load_precomputed_cmd(volume_path, mip, expand_margin_size, fill_missing,
         task[output_chunk_name] = chunk
         return task
 
-    return stage(_name="load-precomputed")
+    return stage(_name=op_name)
 
 
 def _validate_cutout(vol, chunk, mip, validate_mip, tolerance=0.01):
@@ -581,12 +601,13 @@ def _validate_cutout(vol, chunk, mip, validate_mip, tolerance=0.01):
 
 
 @main.command("save-precomputed")
+@name_option("save-precomputed")
 @click.option("--volume-path", "-v", type=str, required=True)
 @click.option("--mip", type=int, default=None)
 @click.option("--upload-log/--no-upload-log", default=True)
 @click.option("--create-thumbnail/--no-create-thumbnail", default=False)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_precomputed_cmd(volume_path, mip, upload_log, create_thumbnail,
+def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail,
                          input_chunk_name):
     """Write the chunk to a precomputed volume (+ timing log sidecar)."""
     import json
@@ -634,7 +655,7 @@ def save_precomputed_cmd(volume_path, mip, upload_log, create_thumbnail,
                     json.dump(record, f)
         return task
 
-    return stage(_name="save-precomputed")
+    return stage(_name=op_name)
 
 
 @main.command("log-summary")
@@ -660,9 +681,10 @@ def log_summary_cmd(log_dir, output_size):
 # annotations / misc I/O
 # ---------------------------------------------------------------------------
 @main.command("load-synapses")
+@name_option("load-synapses")
 @click.option("--file-name", "-f", type=str, required=True, help=".json or .h5")
 @click.option("--output-name", "-o", type=str, default="synapses")
-def load_synapses_cmd(file_name, output_name):
+def load_synapses_cmd(op_name, file_name, output_name):
     from chunkflow_tpu.annotations.synapses import Synapses
 
     @operator
@@ -673,25 +695,27 @@ def load_synapses_cmd(file_name, output_name):
         task[output_name] = synapses
         return task
 
-    return stage(_name="load-synapses")
+    return stage(_name=op_name)
 
 
 @main.command("save-synapses")
+@name_option("save-synapses")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-name", "-i", type=str, default="synapses")
-def save_synapses_cmd(file_name, input_name):
+def save_synapses_cmd(op_name, file_name, input_name):
     @operator
     def stage(task):
         task[input_name].to_file(file_name)
         return task
 
-    return stage(_name="save-synapses")
+    return stage(_name=op_name)
 
 
 @main.command("save-points")
+@name_option("save-points")
 @click.option("--file-name", "-f", type=str, required=True, help=".h5 or .npy")
 @click.option("--input-name", "-i", type=str, default="points")
-def save_points_cmd(file_name, input_name):
+def save_points_cmd(op_name, file_name, input_name):
     from chunkflow_tpu.annotations.point_cloud import PointCloud
 
     @operator
@@ -705,13 +729,14 @@ def save_points_cmd(file_name, input_name):
             points.to_h5(file_name)
         return task
 
-    return stage(_name="save-points")
+    return stage(_name=op_name)
 
 
 @main.command("load-skeleton")
+@name_option("load-skeleton")
 @click.option("--file-name", "-f", type=str, required=True, help=".swc file")
 @click.option("--output-name", "-o", type=str, default="skeleton")
-def load_skeleton_cmd(file_name, output_name):
+def load_skeleton_cmd(op_name, file_name, output_name):
     from chunkflow_tpu.annotations.skeleton import Skeleton
 
     @operator
@@ -719,26 +744,28 @@ def load_skeleton_cmd(file_name, output_name):
         task[output_name] = Skeleton.from_swc(file_name)
         return task
 
-    return stage(_name="load-skeleton")
+    return stage(_name=op_name)
 
 
 @main.command("save-swc")
+@name_option("save-swc")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-name", "-i", type=str, default="skeleton")
-def save_swc_cmd(file_name, input_name):
+def save_swc_cmd(op_name, file_name, input_name):
     @operator
     def stage(task):
         task[input_name].to_swc(file_name)
         return task
 
-    return stage(_name="save-swc")
+    return stage(_name=op_name)
 
 
 @main.command("load-npy")
+@name_option("load-npy")
 @click.option("--file-name", "-f", type=str, required=True)
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_npy_cmd(file_name, voxel_offset, output_chunk_name):
+def load_npy_cmd(op_name, file_name, voxel_offset, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = Chunk.from_npy(
@@ -746,25 +773,27 @@ def load_npy_cmd(file_name, voxel_offset, output_chunk_name):
         )
         return task
 
-    return stage(_name="load-npy")
+    return stage(_name=op_name)
 
 
 @main.command("save-npy")
+@name_option("save-npy")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_npy_cmd(file_name, input_chunk_name):
+def save_npy_cmd(op_name, file_name, input_chunk_name):
     @operator
     def stage(task):
         task[input_chunk_name].to_npy(file_name)
         return task
 
-    return stage(_name="save-npy")
+    return stage(_name=op_name)
 
 
 @main.command("load-json")
+@name_option("load-json")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--output-name", "-o", type=str, default="json")
-def load_json_cmd(file_name, output_name):
+def load_json_cmd(op_name, file_name, output_name):
     import json as _json
 
     @operator
@@ -773,14 +802,15 @@ def load_json_cmd(file_name, output_name):
             task[output_name] = _json.load(f)
         return task
 
-    return stage(_name="load-json")
+    return stage(_name=op_name)
 
 
 @main.command("load-zarr")
+@name_option("load-zarr")
 @click.option("--store-path", "-p", type=str, required=True)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
-def load_zarr_cmd(store_path, output_chunk_name, voxel_offset):
+def load_zarr_cmd(op_name, store_path, output_chunk_name, voxel_offset):
     """Load a zyx zarr array (tensorstore zarr driver)."""
     import tensorstore as ts
 
@@ -799,14 +829,15 @@ def load_zarr_cmd(store_path, output_chunk_name, voxel_offset):
             )
         return task
 
-    return stage(_name="load-zarr")
+    return stage(_name=op_name)
 
 
 @main.command("save-zarr")
+@name_option("save-zarr")
 @click.option("--store-path", "-p", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @cartesian_option("--volume-size", default=None, help="create store of this size first")
-def save_zarr_cmd(store_path, input_chunk_name, volume_size):
+def save_zarr_cmd(op_name, store_path, input_chunk_name, volume_size):
     """Write the chunk into a zyx zarr array at its voxel offset."""
     import tensorstore as ts
 
@@ -842,14 +873,15 @@ def save_zarr_cmd(store_path, input_chunk_name, volume_size):
         store[chunk.bbox.slices] = arr
         return task
 
-    return stage(_name="save-zarr")
+    return stage(_name=op_name)
 
 
 @main.command("create-bbox")
+@name_option("create-bbox")
 @cartesian_option("--start", "-s", required=True)
 @cartesian_option("--stop", "-e", default=None)
 @cartesian_option("--size", default=None)
-def create_bbox_cmd(start, stop, size):
+def create_bbox_cmd(op_name, start, stop, size):
     """Set the task bbox explicitly (single-task pipelines)."""
 
     @operator
@@ -862,13 +894,14 @@ def create_bbox_cmd(start, stop, size):
             raise click.UsageError("need --stop or --size")
         return task
 
-    return stage(_name="create-bbox")
+    return stage(_name=op_name)
 
 
 @main.command("cleanup")
+@name_option("cleanup")
 @click.option("--dir", "-d", "directory", type=str, required=True)
 @click.option("--suffix", type=str, default=".h5")
-def cleanup_cmd(directory, suffix):
+def cleanup_cmd(op_name, directory, suffix):
     """Remove per-task intermediate files for the task bbox."""
     import os
 
@@ -879,15 +912,16 @@ def cleanup_cmd(directory, suffix):
             os.remove(path)
         return task
 
-    return stage(_name="cleanup")
+    return stage(_name=op_name)
 
 
 # ---------------------------------------------------------------------------
 # flow control
 # ---------------------------------------------------------------------------
 @main.command("skip-all-zero")
+@name_option("skip-all-zero")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def skip_all_zero_cmd(input_chunk_name):
+def skip_all_zero_cmd(op_name, input_chunk_name):
     """Drop the task if the chunk is entirely zero."""
 
     @operator
@@ -896,25 +930,27 @@ def skip_all_zero_cmd(input_chunk_name):
             return None
         return task
 
-    return stage(_name="skip-all-zero")
+    return stage(_name=op_name)
 
 
 @main.command("skip-none")
+@name_option("skip-none")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def skip_none_cmd(input_chunk_name):
+def skip_none_cmd(op_name, input_chunk_name):
     @operator
     def stage(task):
         if task.get(input_chunk_name) is None:
             return None
         return task
 
-    return stage(_name="skip-none")
+    return stage(_name=op_name)
 
 
 @main.command("skip-task-by-file")
+@name_option("skip-task-by-file")
 @click.option("--prefix", "-p", type=str, required=True, help="marker path prefix")
 @click.option("--suffix", "-s", type=str, default=".h5")
-def skip_task_by_file_cmd(prefix, suffix):
+def skip_task_by_file_cmd(op_name, prefix, suffix):
     """Skip tasks whose marker/output file already exists (resume)."""
     import os
 
@@ -925,13 +961,14 @@ def skip_task_by_file_cmd(prefix, suffix):
             return None
         return task
 
-    return stage(_name="skip-task-by-file")
+    return stage(_name=op_name)
 
 
 @main.command("skip-task-by-blocks-in-volume")
+@name_option("skip-task-by-blocks-in-volume")
 @click.option("--volume-path", "-v", type=str, required=True)
 @click.option("--mip", type=int, default=None)
-def skip_task_by_blocks_cmd(volume_path, mip):
+def skip_task_by_blocks_cmd(op_name, volume_path, mip):
     """Skip tasks whose output blocks all exist in the volume (resume)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
 
@@ -945,13 +982,14 @@ def skip_task_by_blocks_cmd(volume_path, mip):
             return None
         return task
 
-    return stage(_name="skip-task-by-blocks-in-volume")
+    return stage(_name=op_name)
 
 
 @main.command("mark-complete")
+@name_option("mark-complete")
 @click.option("--prefix", "-p", type=str, required=True)
 @click.option("--suffix", "-s", type=str, default=".done")
-def mark_complete_cmd(prefix, suffix):
+def mark_complete_cmd(op_name, prefix, suffix):
     """Touch a completion marker file for the task bbox."""
     import os
 
@@ -963,23 +1001,25 @@ def mark_complete_cmd(prefix, suffix):
                 pass
         return task
 
-    return stage(_name="mark-complete")
+    return stage(_name=op_name)
 
 
 @main.command("adjust-bbox")
+@name_option("adjust-bbox")
 @cartesian_option("--corner-offset", required=True, help="grow(+)/shrink(-) both corners")
-def adjust_bbox_cmd(corner_offset):
+def adjust_bbox_cmd(op_name, corner_offset):
     @operator
     def stage(task):
         task["bbox"] = task["bbox"].adjust(corner_offset)
         return task
 
-    return stage(_name="adjust-bbox")
+    return stage(_name=op_name)
 
 
 @main.command("delete-var")
+@name_option("delete-var")
 @click.option("--var-names", "-v", type=str, required=True, help="comma-separated task keys")
-def delete_var_cmd(var_names):
+def delete_var_cmd(op_name, var_names):
     """Release chunks mid-pipeline to bound memory."""
 
     @operator
@@ -988,42 +1028,63 @@ def delete_var_cmd(var_names):
             task.pop(name.strip(), None)
         return task
 
-    return stage(_name="delete-var")
+    return stage(_name=op_name)
 
 
 @main.command("copy-var")
+@name_option("copy-var")
 @click.option("--from-name", "-f", type=str, required=True)
 @click.option("--to-name", "-t", type=str, required=True)
-def copy_var_cmd(from_name, to_name):
+def copy_var_cmd(op_name, from_name, to_name):
     @operator
     def stage(task):
         task[to_name] = task[from_name]
         return task
 
-    return stage(_name="copy-var")
+    return stage(_name=op_name)
 
 
 # ---------------------------------------------------------------------------
 # compute
 # ---------------------------------------------------------------------------
 @main.command("inference")
-@cartesian_option("--input-patch-size", "-p", required=True)
-@cartesian_option("--output-patch-size", default=None)
-@cartesian_option("--output-patch-overlap", default=(0, 0, 0))
-@click.option("--num-output-channels", type=int, default=3)
+@name_option("inference")
+@cartesian_option("--input-patch-size", "-p", "-s", required=True)
+@cartesian_option("--output-patch-size", "-z", default=None)
+@cartesian_option("--output-patch-overlap", "-v", default=(0, 0, 0))
+@cartesian_option(
+    "--output-crop-margin", default=None,
+    help="explicit output crop margin (reference semantics); default: "
+         "(input-output)//2 patch margin when cropping is on",
+)
+@cartesian_option(
+    "--patch-num", "-n", default=None,
+    help="expected patch grid in z,y,x; errors if the chunk's derived "
+         "grid differs (reference aligned-mode contract)",
+)
+@click.option("--num-output-channels", "-c", type=int, default=3)
 @click.option("--num-input-channels", type=int, default=1)
 @click.option(
     "--framework", "-f",
     type=click.Choice(["identity", "flax", "jax", "pytorch", "universal"]),
     default="flax",
 )
-@click.option("--model-path", "-m", type=str, default="")
-@click.option("--weight-path", "-w", type=str, default=None, help=".pt/.msgpack/orbax weights")
+@click.option("--model-path", "--convnet-model", "-m", type=str, default="",
+              help="flax factory module or reference pytorch model.py "
+                   "(--convnet-model is the reference spelling)")
+@click.option("--weight-path", "--convnet-weight-path", "-w", type=str,
+              default=None, help=".pt/.msgpack/orbax weights")
 @click.option("--batch-size", "-b", type=int, default=1)
+@click.option("--bump", type=click.Choice(["wu", "zung"]), default="wu",
+              help="bump function type (only wu is implemented, matching "
+                   "the reference)")
 @click.option("--augment/--no-augment", default=False, help="8x test-time augmentation")
 @click.option("--crop-output-margin/--no-crop-output-margin", default=True)
-@click.option("--mask-myelin-threshold", type=float, default=None)
-@click.option("--dtype", type=click.Choice(["float32", "bfloat16"]), default="float32")
+@click.option("--mask-myelin-threshold", "-y", type=float, default=None)
+@click.option("--dtype", "-d", type=click.Choice(["float32", "bfloat16", "float16"]),
+              default="float32",
+              help="compute dtype; float16 is accepted for reference "
+                   "compatibility and mapped to bfloat16 (the TPU half type)")
 @click.option(
     "--model-variant", type=click.Choice(["parity", "rsunet", "tpu"]),
     default="parity",
@@ -1037,14 +1098,30 @@ def copy_var_cmd(from_name, to_name):
 )
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
+def inference_cmd(op_name, input_patch_size, output_patch_size,
+                  output_patch_overlap, output_crop_margin, patch_num,
                   num_output_channels, num_input_channels, framework,
-                  model_path, weight_path, batch_size, augment,
+                  model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
                   model_variant, sharding, input_chunk_name,
                   output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
+
+    if dtype == "float16":
+        dtype = "bfloat16"
+    if bump != "wu":
+        # same capability as the reference (zung is accepted by its CLI and
+        # unimplemented, pytorch.py:34-35) but fail cleanly at parse level
+        raise click.UsageError(
+            f"bump '{bump}' is not implemented; only 'wu' is (matching the "
+            "reference)"
+        )
+    # click yields None when these nargs=3 options are unset, so zeros
+    # stay meaningful: --output-crop-margin 0 0 0 means "do not crop"
+    # (reference semantics), which a truthiness check would misread
+    explicit_crop = output_crop_margin
+    expected_patch_num = tuple(patch_num) if patch_num is not None else None
 
     # one Inferencer (and its compiled program cache) shared across tasks
     inferencer = Inferencer(
@@ -1058,7 +1135,9 @@ def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
         weight_path=weight_path,
         batch_size=batch_size,
         augment=augment,
-        crop_output_margin=crop_output_margin,
+        bump=bump,
+        # explicit margin crops below instead of the derived patch margin
+        crop_output_margin=crop_output_margin and explicit_crop is None,
         mask_myelin_threshold=mask_myelin_threshold,
         dtype=dtype,
         model_variant=model_variant,
@@ -1068,18 +1147,30 @@ def inference_cmd(input_patch_size, output_patch_size, output_patch_overlap,
 
     @operator
     def stage(task):
-        task[output_chunk_name] = inferencer(task[input_chunk_name])
+        chunk = task[input_chunk_name]
+        if expected_patch_num is not None:
+            got = inferencer.patch_grid_shape(chunk.shape)
+            if got != expected_patch_num:
+                raise click.UsageError(
+                    f"--patch-num {expected_patch_num} but chunk "
+                    f"{tuple(chunk.shape)} decomposes into {got} patches"
+                )
+        out = inferencer(chunk)
+        if explicit_crop is not None:
+            out = out.crop_margin(explicit_crop)
+        task[output_chunk_name] = out
         task["log"]["compute_device"] = inferencer.compute_device
         return task
 
-    return stage(_name="inference")
+    return stage(_name=op_name)
 
 
 @main.command("crop-margin")
+@name_option("crop-margin")
 @cartesian_option("--margin-size", "-m", default=None)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def crop_margin_cmd(margin_size, input_chunk_name, output_chunk_name):
+def crop_margin_cmd(op_name, margin_size, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         chunk = task[input_chunk_name]
@@ -1092,23 +1183,25 @@ def crop_margin_cmd(margin_size, input_chunk_name, output_chunk_name):
         task[output_chunk_name] = cropped
         return task
 
-    return stage(_name="crop-margin")
+    return stage(_name=op_name)
 
 
 @main.command("threshold")
+@name_option("threshold")
 @click.option("--threshold", "-t", type=float, default=0.5)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def threshold_cmd(threshold, input_chunk_name, output_chunk_name):
+def threshold_cmd(op_name, threshold, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = task[input_chunk_name].threshold(threshold)
         return task
 
-    return stage(_name="threshold")
+    return stage(_name=op_name)
 
 
 @main.command("connected-components")
+@name_option("connected-components")
 @click.option("--threshold", "-t", type=float, default=0.5)
 @click.option("--connectivity", "-c", type=click.Choice(["6", "18", "26"]), default="26")
 @click.option("--device/--host", default=False,
@@ -1118,7 +1211,7 @@ def threshold_cmd(threshold, input_chunk_name, output_chunk_name):
               "ids are required (the host path is already consecutive)")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def connected_components_cmd(threshold, connectivity, device, input_chunk_name, output_chunk_name):
+def connected_components_cmd(op_name, threshold, connectivity, device, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = task[input_chunk_name].connected_component(
@@ -1126,27 +1219,29 @@ def connected_components_cmd(threshold, connectivity, device, input_chunk_name, 
         )
         return task
 
-    return stage(_name="connected-components")
+    return stage(_name=op_name)
 
 
 @main.command("channel-voting")
+@name_option("channel-voting")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def channel_voting_cmd(input_chunk_name, output_chunk_name):
+def channel_voting_cmd(op_name, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = task[input_chunk_name].channel_voting()
         return task
 
-    return stage(_name="channel-voting")
+    return stage(_name=op_name)
 
 
 @main.command("normalize-contrast")
+@name_option("normalize-contrast")
 @click.option("--lower-clip-fraction", type=float, default=0.01)
 @click.option("--upper-clip-fraction", type=float, default=0.01)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def normalize_contrast_cmd(lower_clip_fraction, upper_clip_fraction, input_chunk_name, output_chunk_name):
+def normalize_contrast_cmd(op_name, lower_clip_fraction, upper_clip_fraction, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         img = task[input_chunk_name]
@@ -1158,13 +1253,14 @@ def normalize_contrast_cmd(lower_clip_fraction, upper_clip_fraction, input_chunk
         )
         return task
 
-    return stage(_name="normalize-contrast")
+    return stage(_name=op_name)
 
 
 @main.command("normalize-intensity")
+@name_option("normalize-intensity")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def normalize_intensity_cmd(input_chunk_name, output_chunk_name):
+def normalize_intensity_cmd(op_name, input_chunk_name, output_chunk_name):
     """uint8 grey image -> float32 in (-1, 1): x/127.5 - 1
     (reference flow/flow.py:1650-1668)."""
 
@@ -1179,10 +1275,11 @@ def normalize_intensity_cmd(input_chunk_name, output_chunk_name):
         task[output_chunk_name] = out
         return task
 
-    return stage(_name="normalize-intensity")
+    return stage(_name=op_name)
 
 
 @main.command("normalize-section-shang")
+@name_option("normalize-section-shang")
 @click.option("--nominalmin", type=float, default=None,
               help="targeted minimum of the transformed chunk")
 @click.option("--nominalmax", type=float, default=None,
@@ -1191,7 +1288,7 @@ def normalize_intensity_cmd(input_chunk_name, output_chunk_name):
               help="clip transformed values to the target range")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def normalize_section_shang_cmd(
+def normalize_section_shang_cmd(op_name, 
     nominalmin, nominalmax, clipvalues, input_chunk_name, output_chunk_name
 ):
     """Slice-wise min/max normalization, Shang's method
@@ -1207,10 +1304,11 @@ def normalize_section_shang_cmd(
         )
         return task
 
-    return stage(_name="normalize-section-shang")
+    return stage(_name=op_name)
 
 
 @main.command("mask")
+@name_option("mask")
 @click.option("--volume-path", "-v", type=str, required=True,
               help="mask volume (its voxel size may be any integer multiple of the chunk's)")
 @click.option("--mip", type=int, default=0, help="scale index within the mask volume")
@@ -1218,7 +1316,7 @@ def normalize_section_shang_cmd(
 @click.option("--fill-missing/--no-fill-missing", default=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def mask_cmd(volume_path, mip, inverse, fill_missing, input_chunk_name, output_chunk_name):
+def mask_cmd(op_name, volume_path, mip, inverse, fill_missing, input_chunk_name, output_chunk_name):
     """Multiply the chunk by a (usually coarser-resolution) mask volume."""
     import math
 
@@ -1245,28 +1343,30 @@ def mask_cmd(volume_path, mip, inverse, fill_missing, input_chunk_name, output_c
         task[output_chunk_name] = maskout(chunk, mask_chunk, inverse=inverse)
         return task
 
-    return stage(_name="mask")
+    return stage(_name=op_name)
 
 
 @main.command("multiply")
+@name_option("multiply")
 @click.option("--input-names", "-i", type=str, required=True, help="comma-separated: a,b")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def multiply_cmd(input_names, output_chunk_name):
+def multiply_cmd(op_name, input_names, output_chunk_name):
     @operator
     def stage(task):
         a, b = (task[n.strip()] for n in input_names.split(","))
         task[output_chunk_name] = a * b
         return task
 
-    return stage(_name="multiply")
+    return stage(_name=op_name)
 
 
 @main.command("mask-out-objects")
+@name_option("mask-out-objects")
 @click.option("--dust-size-threshold", "-d", type=int, default=0)
 @click.option("--selected-obj-ids", "-s", type=str, default=None, help="comma-separated keep list")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def mask_out_objects_cmd(dust_size_threshold, selected_obj_ids,
+def mask_out_objects_cmd(op_name, dust_size_threshold, selected_obj_ids,
                          input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
@@ -1281,14 +1381,15 @@ def mask_out_objects_cmd(dust_size_threshold, selected_obj_ids,
         task[output_chunk_name] = seg
         return task
 
-    return stage(_name="mask-out-objects")
+    return stage(_name=op_name)
 
 
 @main.command("quantize")
+@name_option("quantize")
 @click.option("--mode", type=click.Choice(["xy", "z"]), default="xy")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def quantize_cmd(mode, input_chunk_name, output_chunk_name):
+def quantize_cmd(op_name, mode, input_chunk_name, output_chunk_name):
     """Compress an affinity map into a uint8 thumbnail image."""
     from chunkflow_tpu.chunk import AffinityMap
 
@@ -1303,14 +1404,15 @@ def quantize_cmd(mode, input_chunk_name, output_chunk_name):
         task[output_chunk_name] = aff.quantize(mode=mode)
         return task
 
-    return stage(_name="quantize")
+    return stage(_name=op_name)
 
 
 @main.command("downsample")
+@name_option("downsample")
 @cartesian_option("--factor", "-f", default=(1, 2, 2))
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def downsample_cmd(factor, input_chunk_name, output_chunk_name):
+def downsample_cmd(op_name, factor, input_chunk_name, output_chunk_name):
     from chunkflow_tpu.ops.downsample import downsample
 
     @operator
@@ -1318,16 +1420,17 @@ def downsample_cmd(factor, input_chunk_name, output_chunk_name):
         task[output_chunk_name] = downsample(task[input_chunk_name], factor)
         return task
 
-    return stage(_name="downsample")
+    return stage(_name=op_name)
 
 
 @main.command("downsample-upload")
+@name_option("downsample-upload")
 @click.option("--volume-path", "-v", type=str, required=True)
 @cartesian_option("--factor", "-f", default=(1, 2, 2))
 @click.option("--start-mip", type=int, default=1)
 @click.option("--stop-mip", type=int, default=None, help="exclusive; defaults to volume num_mips")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def downsample_upload_cmd(volume_path, factor, start_mip, stop_mip, input_chunk_name):
+def downsample_upload_cmd(op_name, volume_path, factor, start_mip, stop_mip, input_chunk_name):
     """Build a mip pyramid of the chunk and upload every level."""
     from chunkflow_tpu.ops.downsample import downsample
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
@@ -1344,20 +1447,21 @@ def downsample_upload_cmd(volume_path, factor, start_mip, stop_mip, input_chunk_
                 vol.save(current, mip=level)
         return task
 
-    return stage(_name="downsample-upload")
+    return stage(_name=op_name)
 
 
 @main.command("gaussian-filter")
+@name_option("gaussian-filter")
 @click.option("--sigma", "-s", type=float, default=1.0)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def gaussian_filter_cmd(sigma, input_chunk_name, output_chunk_name):
+def gaussian_filter_cmd(op_name, sigma, input_chunk_name, output_chunk_name):
     @operator
     def stage(task):
         task[output_chunk_name] = task[input_chunk_name].gaussian_filter_2d(sigma)
         return task
 
-    return stage(_name="gaussian-filter")
+    return stage(_name=op_name)
 
 
 @main.command("plugin")
@@ -1386,9 +1490,10 @@ def plugin_cmd(name, input_names, output_names, args):
 
 
 @main.command("save-pngs")
+@name_option("save-pngs")
 @click.option("--output-path", "-o", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_pngs_cmd(output_path, input_chunk_name):
+def save_pngs_cmd(op_name, output_path, input_chunk_name):
     from chunkflow_tpu.volume.io_png import save_pngs
 
     @operator
@@ -1396,15 +1501,16 @@ def save_pngs_cmd(output_path, input_chunk_name):
         save_pngs(task[input_chunk_name], output_path)
         return task
 
-    return stage(_name="save-pngs")
+    return stage(_name=op_name)
 
 
 @main.command("load-png")
+@name_option("load-png")
 @click.option("--path", "-p", type=str, required=True, help="directory of z-section pngs")
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
 @click.option("--dtype", type=str, default=None)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
+def load_png_cmd(op_name, path, voxel_offset, dtype, output_chunk_name):
     from chunkflow_tpu.volume.io_png import load_pngs
 
     @operator
@@ -1419,10 +1525,11 @@ def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
         )
         return task
 
-    return stage(_name="load-png")
+    return stage(_name=op_name)
 
 
 @main.command("mesh")
+@name_option("mesh")
 @click.option("--output-path", "-o", type=str, required=True)
 @click.option("--output-format", "-t", type=click.Choice(["precomputed", "obj", "ply"]), default="precomputed")
 @click.option("--ids", type=str, default=None, help="comma-separated object ids (default: all)")
@@ -1431,7 +1538,7 @@ def load_png_cmd(path, voxel_offset, dtype, output_chunk_name):
 @click.option("--simplification-error", type=float, default=0.0,
               help="max geometric error in nm for vertex-clustering simplification (0 = off)")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def mesh_cmd(output_path, output_format, ids, skip_ids, manifest,
+def mesh_cmd(op_name, output_path, output_format, ids, skip_ids, manifest,
              simplification_error, input_chunk_name):
     """Mesh every object of a segmentation chunk (surface nets)."""
     from chunkflow_tpu.flow.mesh import MeshOperator
@@ -1452,7 +1559,7 @@ def mesh_cmd(output_path, output_format, ids, skip_ids, manifest,
             print(f"meshed {count} objects")
         return task
 
-    return stage(_name="mesh")
+    return stage(_name=op_name)
 
 
 @main.command("mesh-manifest")
@@ -1472,6 +1579,7 @@ def mesh_manifest_cmd(mesh_dir):
 
 
 @main.command("download-mesh")
+@name_option("download-mesh")
 @click.option("--mesh-dir", "-v", type=str, required=True,
               help="directory holding mesh fragments + manifests")
 @click.option("--ids", "-i", type=str, default=None,
@@ -1483,7 +1591,7 @@ def mesh_manifest_cmd(mesh_dir):
 @click.option("--out-pre", "-o", type=str, default="./")
 @click.option("--output-format", "-f",
               type=click.Choice(["ply", "obj"]), default="ply")
-def download_mesh_cmd(mesh_dir, ids, input_chunk_name, start_rank, stop_rank,
+def download_mesh_cmd(op_name, mesh_dir, ids, input_chunk_name, start_rank, stop_rank,
                       out_pre, output_format):
     """Fuse an object's mesh fragments and write ply/obj files
     (reference flow/flow.py:2160-2210)."""
@@ -1527,7 +1635,7 @@ def download_mesh_cmd(mesh_dir, ids, input_chunk_name, start_rank, stop_rank,
             print(f"wrote {out} ({vertices.shape[0]} vertices)")
         return task
 
-    return stage(_name="download-mesh")
+    return stage(_name=op_name)
 
 
 @main.command("aggregate-skeleton-fragments")
@@ -1548,9 +1656,10 @@ def aggregate_skeleton_fragments_cmd(fragments_path, output_path):
 
 
 @main.command("save-nrrd")
+@name_option("save-nrrd")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_nrrd_cmd(file_name, input_chunk_name):
+def save_nrrd_cmd(op_name, file_name, input_chunk_name):
     """Save the chunk as an NRRD file (reference flow/flow.py:853)."""
     from chunkflow_tpu.volume.io_nrrd import save_nrrd
 
@@ -1565,15 +1674,16 @@ def save_nrrd_cmd(file_name, input_chunk_name):
         )
         return task
 
-    return stage(_name="save-nrrd")
+    return stage(_name=op_name)
 
 
 @main.command("view")
+@name_option("view")
 @click.option("--image-chunk-name", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--segmentation-chunk-name", type=str, default=None)
 @click.option("--screenshot", type=str, default=None,
               help="save a middle-section png instead of opening a window")
-def view_cmd(image_chunk_name, segmentation_chunk_name, screenshot):
+def view_cmd(op_name, image_chunk_name, segmentation_chunk_name, screenshot):
     """Quick-look viewer: middle z-section via matplotlib
     (reference flow/view.py microviewer equivalent)."""
 
@@ -1608,15 +1718,16 @@ def view_cmd(image_chunk_name, segmentation_chunk_name, screenshot):
         plt.close(fig)
         return task
 
-    return stage(_name="view")
+    return stage(_name=op_name)
 
 
 @main.command("neuroglancer")
+@name_option("neuroglancer")
 @click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME,
               help="comma-separated chunk names to serve as layers")
 @click.option("--port", "-p", type=int, default=0)
 @click.option("--voxel-size", type=int, nargs=3, default=None)
-def neuroglancer_cmd(chunk_names, port, voxel_size):
+def neuroglancer_cmd(op_name, chunk_names, port, voxel_size):
     """Serve chunks in an in-process neuroglancer viewer
     (reference flow/neuroglancer.py; requires the neuroglancer package)."""
 
@@ -1642,12 +1753,13 @@ def neuroglancer_cmd(chunk_names, port, voxel_size):
         )
         return task
 
-    return stage(_name="neuroglancer")
+    return stage(_name=op_name)
 
 
 @main.command("napari")
+@name_option("napari")
 @click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME)
-def napari_cmd(chunk_names):
+def napari_cmd(op_name, chunk_names):
     """Open chunks in napari (requires the napari package)."""
 
     @operator
@@ -1672,13 +1784,14 @@ def napari_cmd(chunk_names):
         napari.run()  # pragma: no cover - interactive
         return task
 
-    return stage(_name="napari")
+    return stage(_name=op_name)
 
 
 @main.command("evaluate-segmentation")
+@name_option("evaluate-segmentation")
 @click.option("--segmentation-chunk-name", "-s", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--groundtruth-chunk-name", "-g", type=str, required=True)
-def evaluate_segmentation_cmd(segmentation_chunk_name, groundtruth_chunk_name):
+def evaluate_segmentation_cmd(op_name, segmentation_chunk_name, groundtruth_chunk_name):
     @operator
     def stage(task):
         seg = task[segmentation_chunk_name]
@@ -1689,7 +1802,7 @@ def evaluate_segmentation_cmd(segmentation_chunk_name, groundtruth_chunk_name):
         task["evaluation"] = scores
         return task
 
-    return stage(_name="evaluate-segmentation")
+    return stage(_name=op_name)
 
 
 if __name__ == "__main__":

@@ -109,6 +109,26 @@ class Inferencer:
         self._device_params = None
 
     # ------------------------------------------------------------------
+    def patch_grid_shape(self, chunk_shape) -> Tuple[int, int, int]:
+        """Patches per axis for a chunk shape (reference --patch-num
+        contract: the caller may assert the grid it planned for)."""
+        from chunkflow_tpu.inference.patching import starts_1d
+
+        shape = tuple(chunk_shape)[-3:]
+        stride = self.output_patch_size - self.output_patch_overlap
+        if not stride.all_positive():
+            raise ValueError(
+                f"output overlap {tuple(self.output_patch_overlap)} must be "
+                f"smaller than output patch size "
+                f"{tuple(self.output_patch_size)}"
+            )
+        return tuple(
+            len(starts_1d(shape[i], int(self.input_patch_size[i]),
+                          int(stride[i])))
+            for i in range(3)
+        )
+
+    # ------------------------------------------------------------------
     @property
     def compute_device(self) -> str:
         import jax

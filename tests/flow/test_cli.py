@@ -443,3 +443,41 @@ def test_save_precomputed_with_thumbnail_and_log(runner, tmp_path):
 
     record = json.loads(next(log_dir.iterdir()).read_text())
     assert "timer" in record and "compute_device" in record
+
+
+def test_inference_reference_migration_options(runner, tmp_path):
+    """Reference spellings work verbatim: -s/-v/-c short flags, --name
+    timer key, --patch-num grid assertion, --dtype float16 (mapped to
+    bfloat16), --output-crop-margin explicit crop
+    (reference flow/flow.py:1852-1894)."""
+    out = tmp_path / "o.h5"
+    result = runner.invoke(main, [
+        "--verbose",
+        "create-chunk", "-s", "16", "48", "48", "--pattern", "sin",
+        "inference", "--name", "my-inference",
+        "-s", "8", "24", "24", "-v", "2", "8", "8", "-c", "1",
+        "-f", "identity", "-b", "2", "--bump", "wu",
+        "--patch-num", "3", "3", "3",
+        "--dtype", "float16",
+        "--output-crop-margin", "2", "4", "4",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    assert "my-inference" in result.output  # custom timer key
+    import h5py
+
+    with h5py.File(out, "r") as f:
+        key = [k for k in f if "voxel" not in k and "layer" not in k][0]
+        # 16,48,48 minus 2*(2,4,4) crop
+        assert f[key].shape == (1, 12, 40, 40)
+
+
+def test_inference_patch_num_mismatch_errors(runner):
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "16", "48", "48",
+        "inference", "-s", "8", "24", "24", "-v", "2", "8", "8",
+        "-c", "1", "-f", "identity", "--patch-num", "2", "2", "2",
+        "--no-crop-output-margin",
+    ])
+    assert result.exit_code != 0
+    assert "decomposes into (3, 3, 3)" in result.output
