@@ -111,21 +111,17 @@ class Inferencer:
     # ------------------------------------------------------------------
     def patch_grid_shape(self, chunk_shape) -> Tuple[int, int, int]:
         """Patches per axis for a chunk shape (reference --patch-num
-        contract: the caller may assert the grid it planned for)."""
-        from chunkflow_tpu.inference.patching import starts_1d
-
-        shape = tuple(chunk_shape)[-3:]
-        stride = self.output_patch_size - self.output_patch_overlap
-        if not stride.all_positive():
-            raise ValueError(
-                f"output overlap {tuple(self.output_patch_overlap)} must be "
-                f"smaller than output patch size "
-                f"{tuple(self.output_patch_size)}"
-            )
+        contract: the caller may assert the grid it planned for). Derived
+        from the same enumerate_patches call the engine runs, so the
+        asserted grid can never drift from the executed one."""
+        grid = enumerate_patches(
+            tuple(chunk_shape)[-3:],
+            self.input_patch_size,
+            self.output_patch_size,
+            self.output_patch_overlap,
+        )
         return tuple(
-            len(starts_1d(shape[i], int(self.input_patch_size[i]),
-                          int(stride[i])))
-            for i in range(3)
+            int(np.unique(grid.input_starts[:, i]).size) for i in range(3)
         )
 
     # ------------------------------------------------------------------

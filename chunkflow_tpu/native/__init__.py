@@ -17,7 +17,7 @@ import numpy as np
 _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "lib")
 _LIB_PATH = os.path.join(_LIB_DIR, "libchunkflow_native.so")
-_SOURCES = ("cc3d.cpp", "watershed.cpp", "surface_nets.cpp")
+_SOURCES = ("cc3d.cpp", "watershed.cpp", "surface_nets.cpp", "remap.cpp")
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -72,6 +72,18 @@ def load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_void_p,
         ctypes.POINTER(i64), ctypes.POINTER(i64),
     ]
+    for fn in (lib.cf_renumber_u32, lib.cf_renumber_u64):
+        fn.restype = i64
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64, ctypes.c_uint64,
+            ctypes.c_void_p, ctypes.c_void_p, i64,
+        ]
+    for fn in (lib.cf_remap_u32, lib.cf_remap_u64):
+        fn.restype = i64
+        fn.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, i64,
+            ctypes.c_void_p, ctypes.c_void_p, i64, ctypes.c_int,
+        ]
     _lib = lib
     return lib
 
@@ -147,9 +159,72 @@ def mesh_object(seg: np.ndarray, obj_id: int) -> Tuple[np.ndarray, np.ndarray]:
     return vertices, faces
 
 
+def renumber(arr: np.ndarray, start_id: int = 1):
+    """Compact-relabel a segmentation (0 stays 0): single-pass hash table
+    (fastremap.renumber equivalent). Returns (relabeled, {old: new})."""
+    lib = load()
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    fns = {
+        np.dtype(np.uint32): lib.cf_renumber_u32,
+        np.dtype(np.uint64): lib.cf_renumber_u64,
+    }
+    if flat.dtype not in fns:
+        raise TypeError(f"native renumber supports uint32/uint64, got {flat.dtype}")
+    out = np.empty_like(flat)
+    # generous first buffer (<=64 MB): EM supervoxel chunks run to millions
+    # of labels, and a retry repeats the full O(n) relabel pass
+    max_pairs = min(flat.size, 1 << 22) or 1
+    while True:
+        keys = np.empty(max_pairs, dtype=np.uint64)
+        vals = np.empty(max_pairs, dtype=np.uint64)
+        n = fns[flat.dtype](
+            flat.ctypes.data, out.ctypes.data, flat.size, int(start_id),
+            keys.ctypes.data, vals.ctypes.data, max_pairs,
+        )
+        if n >= 0:
+            break
+        max_pairs = -n
+    if n and int(start_id) + n - 1 > np.iinfo(flat.dtype).max:
+        raise OverflowError(
+            f"renumbered ids exceed {flat.dtype} (start_id={start_id}, "
+            f"{n} labels)"
+        )
+    mapping = dict(zip(keys[:n].tolist(), vals[:n].tolist()))
+    return out.reshape(arr.shape), mapping
+
+
+def remap(arr: np.ndarray, mapping, preserve_missing: bool = True) -> np.ndarray:
+    """Apply an explicit old->new id mapping (fastremap.remap equivalent)."""
+    lib = load()
+    flat = np.ascontiguousarray(arr).reshape(-1)
+    fns = {
+        np.dtype(np.uint32): lib.cf_remap_u32,
+        np.dtype(np.uint64): lib.cf_remap_u64,
+    }
+    if flat.dtype not in fns:
+        raise TypeError(f"native remap supports uint32/uint64, got {flat.dtype}")
+    keys = np.fromiter(mapping.keys(), dtype=np.uint64, count=len(mapping))
+    vals = np.fromiter(mapping.values(), dtype=np.uint64, count=len(mapping))
+    if vals.size and int(vals.max()) > np.iinfo(flat.dtype).max:
+        # the numpy path raises here too; the C++ cast would silently wrap
+        raise OverflowError(
+            f"mapping value {int(vals.max())} does not fit {flat.dtype}"
+        )
+    out = np.empty_like(flat)
+    fns[flat.dtype](
+        flat.ctypes.data, out.ctypes.data, flat.size,
+        keys.ctypes.data, vals.ctypes.data, keys.size,
+        1 if preserve_missing else 0,
+    )
+    return out.reshape(arr.shape)
+
+
 def available() -> bool:
     try:
         load()
         return True
-    except (subprocess.CalledProcessError, OSError):
+    except (subprocess.CalledProcessError, OSError, AttributeError):
+        # AttributeError: a stale cached .so missing newly added symbols
+        # (e.g. left behind across a package upgrade) must degrade to the
+        # numpy fallbacks, not break every native entry point
         return False

@@ -1,7 +1,9 @@
 """Segmentation id remapping — the fastremap (C++) equivalent.
 
-Vectorized numpy (np.unique/searchsorted); O(n log n) but allocation-light.
-Parity: fastremap.renumber / remap / mask usage in reference
+Large uint32/uint64 arrays take the native single-pass hash-table path
+(native/src/remap.cpp); everything else uses vectorized numpy
+(np.unique/searchsorted, O(n log n) but allocation-light). Parity:
+fastremap.renumber / remap / mask usage in reference
 chunk/segmentation.py:69-109.
 """
 from __future__ import annotations
@@ -10,15 +12,33 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+# below this the ctypes round trip costs more than numpy's sort
+_NATIVE_MIN_SIZE = 1 << 20
+
+
+def _native_or_none():
+    from chunkflow_tpu import native
+
+    return native if native.available() else None
+
 
 def renumber(arr: np.ndarray, start_id: int = 1) -> Tuple[np.ndarray, Dict[int, int]]:
     """Relabel ids to a compact range [start_id, ...); 0 stays 0.
 
     Returns the relabeled array and the old->new mapping.
     """
-    ids = np.unique(arr)
-    nonzero = ids[ids != 0]
-    new_ids = np.arange(start_id, start_id + nonzero.size, dtype=arr.dtype)
+    if arr.size >= _NATIVE_MIN_SIZE and arr.dtype in (np.uint32, np.uint64):
+        native = _native_or_none()
+        if native is not None:
+            out, mapping = native.renumber(arr, start_id=start_id)
+            return out, mapping
+    # new ids follow FIRST APPEARANCE order (fastremap.renumber semantics,
+    # and what the native path produces) so both paths are bit-identical
+    ids, first_idx = np.unique(arr, return_index=True)
+    keep = ids != 0
+    nonzero, first_idx = ids[keep], first_idx[keep]
+    order = np.argsort(np.argsort(first_idx, kind="stable"), kind="stable")
+    new_ids = (start_id + order).astype(arr.dtype)
     lookup = np.zeros(ids.size, dtype=arr.dtype)
     lookup[np.searchsorted(ids, nonzero)] = new_ids
     out = lookup[np.searchsorted(ids, arr)]
@@ -30,6 +50,10 @@ def remap(arr: np.ndarray, mapping: Dict[int, int], preserve_missing: bool = Tru
     """Apply an explicit old->new id mapping."""
     if not mapping:
         return arr.copy()
+    if arr.size >= _NATIVE_MIN_SIZE and arr.dtype in (np.uint32, np.uint64):
+        native = _native_or_none()
+        if native is not None:
+            return native.remap(arr, mapping, preserve_missing=preserve_missing)
     keys = np.array(sorted(mapping.keys()), dtype=arr.dtype)
     vals = np.array([mapping[int(k)] for k in keys], dtype=arr.dtype)
     idx = np.searchsorted(keys, arr)

@@ -98,3 +98,71 @@ def test_gaussian_filter_2d_device_matches_scipy():
     assert dev.is_on_device
     ref = np.stack([gaussian_filter(a, 1.5, mode="reflect") for a in arr])
     np.testing.assert_allclose(np.asarray(dev.array), ref, atol=1e-4)
+
+
+def test_native_renumber_remap_matches_numpy_semantics():
+    """native/src/remap.cpp (fastremap-equivalent hash path) agrees with
+    the numpy path on everything observable: zero preservation, compact id
+    range, partition structure, and mapping roundtrips."""
+    import pytest
+
+    from chunkflow_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(3)
+    arr = (rng.integers(0, 500, (32, 32, 32)) * 97).astype(np.uint64)
+
+    out_nat, map_nat = native.renumber(arr, start_id=5)
+    out_np, map_np = remap.renumber(arr, start_id=5)
+
+    assert ((out_nat == 0) == (arr == 0)).all()
+    nz = np.unique(out_nat[out_nat != 0])
+    assert nz.min() == 5 and nz.size == np.unique(arr[arr != 0]).size
+    assert nz.max() == 5 + nz.size - 1  # compact
+    # same partition as the numpy relabeling: ids correspond 1:1
+    pairs = np.unique(
+        np.stack([out_nat.ravel(), out_np.ravel()]), axis=1
+    )
+    assert pairs.shape[1] == nz.size + 1  # bijection (+ the 0-0 pair)
+    # mapping roundtrip
+    back = native.remap(out_nat, {v: k for k, v in map_nat.items()})
+    assert (back == arr).all()
+    # preserve_missing semantics
+    some = int(arr[arr != 0].flat[0])
+    kept = native.remap(arr, {some: 1}, preserve_missing=True)
+    dropped = native.remap(arr, {some: 1}, preserve_missing=False)
+    assert (kept[arr == some] == 1).all()
+    assert (kept[arr != some] == arr[arr != some]).all()
+    assert (dropped[(arr != some) & (arr != 0)] == 0).all()
+    assert (dropped[arr == 0] == 0).all()
+
+
+def test_renumber_paths_bit_identical():
+    """numpy and native renumber both use first-appearance ordering
+    (fastremap semantics): outputs and mappings are bit-identical, so
+    results don't change with array size or toolchain availability."""
+    import pytest
+
+    from chunkflow_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(11)
+    arr = (rng.integers(0, 97, (24, 24, 24)) * 1009).astype(np.uint32)
+    out_np, m_np = remap.renumber(arr)          # small -> numpy path
+    out_nat, m_nat = native.renumber(arr)
+    assert (out_np == out_nat).all()
+    assert m_np == m_nat
+
+
+def test_native_remap_overflow_guard():
+    import pytest
+
+    from chunkflow_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    arr = np.full((128,), 7, dtype=np.uint32)
+    with pytest.raises(OverflowError):
+        native.remap(arr, {7: 2 ** 40})
