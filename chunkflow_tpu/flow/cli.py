@@ -82,32 +82,84 @@ def run_pipeline(stages, mip, dry_run, verbose, profile_dir):
 # task sources
 # ---------------------------------------------------------------------------
 @main.command("generate-tasks")
+@click.option("--volume-path", "-v", type=str, default=None,
+              help="derive default roi bounds from this volume's metadata "
+                   "at --mip (reference cartesian_coordinate.py:567-580)")
+@click.option("--mip", "-m", type=int, default=None,
+              help="scale level for --volume-path metadata "
+                   "(default: the group-level --mip)")
 @cartesian_option("--chunk-size", "-c", required=True, help="task chunk size")
 @cartesian_option("--overlap", default=(0, 0, 0), help="chunk overlap")
-@cartesian_option("--roi-start", default=(0, 0, 0))
-@cartesian_option("--roi-stop", default=None)
-@cartesian_option("--grid-size", default=None)
-@click.option("--task-file", type=str, default=None, help="write tasks to .txt/.npy instead of streaming")
+@cartesian_option("--roi-start", "-s", default=None)
+@cartesian_option("--roi-stop", "-r", default=None)
+@cartesian_option("--roi-size", "-z", default=None,
+                  help="alternative to --roi-stop: start + size")
+@click.option("--bounding-box", "-b", type=str, default=None,
+              help="roi as a canonical zs-ze_ys-ye_xs-xe string")
+@cartesian_option("--grid-size", "-g", default=None)
+@cartesian_option("--aligned-block-size", "-a", default=None,
+                  help="snap chunk starts/stops to storage block multiples "
+                       "(write-conflict avoidance)")
+@click.option("--bounded/--no-bounded", default=False,
+              help="shift trailing chunks back inside the roi instead of "
+                   "spilling past it")
+@click.option("--task-file", "--file-path", "-f", type=str, default=None,
+              help="write tasks to .txt/.npy instead of streaming")
 @click.option("--queue-name", "-q", type=str, default=None, help="push tasks to a queue (file://dir or sqs://name)")
-@click.option("--task-index-start", type=int, default=None)
-@click.option("--task-index-stop", type=int, default=None)
+@click.option("--task-index-start", "-i", type=int, default=None)
+@click.option("--task-index-stop", "-p", type=int, default=None)
 @click.option("--disbatch/--no-disbatch", default=False,
               help="select the single task at $DISBATCH_REPEAT_INDEX "
               "(disBatch cluster protocol, reference flow/flow.py:151-156)")
-def generate_tasks_cmd(chunk_size, overlap, roi_start, roi_stop, grid_size,
-                       task_file, queue_name, task_index_start,
-                       task_index_stop, disbatch):
+def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
+                       roi_stop, roi_size, bounding_box, grid_size,
+                       aligned_block_size, bounded, task_file, queue_name,
+                       task_index_start, task_index_stop, disbatch):
     """Fan the seed task into a grid of bbox tasks."""
     import os
+
+    start, stop, size = roi_start, roi_stop, roi_size
+    block = aligned_block_size
+    if stop is not None and size is not None:
+        raise click.UsageError("give --roi-stop OR --roi-size, not both")
+    if bounding_box is not None:
+        if start is not None or stop is not None or size is not None:
+            raise click.UsageError(
+                "--bounding-box replaces --roi-start/--roi-stop/--roi-size"
+            )
+        box = BoundingBox.from_string(bounding_box)
+        start, stop = tuple(box.start), tuple(box.stop)
+    if volume_path is not None:
+        # reference behavior: unspecified roi bounds come from the dataset
+        from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+        vol = PrecomputedVolume(volume_path)
+        vmip = mip if mip is not None else state.mip
+        bounds = vol.bounds(vmip)
+        derived = start is None and stop is None and size is None
+        if start is None:
+            start = tuple(bounds.start)
+        if stop is None and size is None:
+            stop = tuple(bounds.stop)
+        # auto-align to storage blocks only when the bounds themselves came
+        # from the volume; an explicit roi must not be silently expanded
+        # (pass -a to opt in)
+        if block is None and derived:
+            block = tuple(vol.block_size(vmip))
+    if start is None:
+        start = (0, 0, 0)
 
     @generator
     def stage(task):
         bboxes = BoundingBoxes.from_manual_setup(
             chunk_size=chunk_size,
             overlap=overlap,
-            roi_start=roi_start,
-            roi_stop=roi_stop if roi_stop and any(roi_stop) else None,
-            grid_size=grid_size if grid_size and any(grid_size) else None,
+            roi_start=start,
+            roi_stop=stop,
+            roi_size=size,
+            grid_size=grid_size,
+            aligned_block_size=block,
+            bounded=bounded,
         )
         boxes = list(bboxes)
         if task_index_start is not None or task_index_stop is not None:

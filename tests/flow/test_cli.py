@@ -481,3 +481,44 @@ def test_inference_patch_num_mismatch_errors(runner):
     ])
     assert result.exit_code != 0
     assert "decomposes into (3, 3, 3)" in result.output
+
+
+def test_generate_tasks_reference_forms(runner, tmp_path):
+    """Reference generate-tasks forms (flow/flow.py:73-183): roi from a
+    volume's metadata (-v, with block-size snapping), a canonical
+    bounding-box string (-b), and --roi-size with --bounded."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(32, 64, 64), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(16, 32, 32),
+        voxel_offset=(8, 16, 16),
+    )
+    tf = tmp_path / "tasks.txt"
+    result = runner.invoke(main, [
+        "generate-tasks", "-v", str(root), "-c", "16", "32", "32",
+        "--task-file", str(tf),
+    ])
+    assert result.exit_code == 0, result.output
+    tasks = tf.read_text().split()
+    # roi (8,16,16)-(40,80,80) snapped to (16,32,32) blocks -> 3^3 grid
+    assert len(tasks) == 27 and tasks[0] == "0-16_0-32_0-32"
+
+    result = runner.invoke(main, [
+        "generate-tasks", "-b", "0-32_0-64_0-64", "-c", "16", "32", "32",
+        "--task-file", str(tf),
+    ])
+    assert result.exit_code == 0, result.output
+    assert len(tf.read_text().split()) == 8
+
+    result = runner.invoke(main, [
+        "generate-tasks", "-s", "0", "0", "0", "-z", "20", "40", "40",
+        "-c", "16", "32", "32", "--bounded", "--task-file", str(tf),
+    ])
+    assert result.exit_code == 0, result.output
+    # bounded: nothing spills past the roi stop
+    assert all(
+        int(s.split("_")[0].split("-")[1]) <= 20 for s in tf.read_text().split()
+    )
