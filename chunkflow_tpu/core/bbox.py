@@ -227,6 +227,7 @@ class BoundingBoxes:
         roi_size=None,
         grid_size=None,
         aligned_block_size=None,
+        block_offset=None,
         bounded: bool = False,
     ) -> "BoundingBoxes":
         """Build the overlapping chunk grid covering an ROI.
@@ -262,16 +263,25 @@ class BoundingBoxes:
             roi_stop = to_cartesian(roi_stop)
 
         if aligned_block_size is not None:
+            # block grids anchor at the volume's voxel_offset, not the
+            # absolute origin (storage blocks of an offset volume start at
+            # the offset; snapping without it straddles block boundaries)
             roi = BoundingBox(roi_start, roi_stop).snap_to_blocks(
-                aligned_block_size, outward=True
+                aligned_block_size, offset=block_offset, outward=True
             )
             roi_start, roi_stop = roi.start, roi.stop
 
         roi_shape = roi_stop - roi_start
+        if not roi_shape.all_positive():
+            raise ValueError(
+                f"empty roi: start {tuple(roi_start)} stop {tuple(roi_stop)}"
+            )
         if grid_size is None:
             # number of strides needed so chunks cover [roi_start, roi_stop)
             grid_size = (roi_shape - overlap).maximum(1).ceildiv(stride)
         grid_size = to_cartesian(grid_size)
+        if not grid_size.all_positive():
+            raise ValueError(f"grid size must be positive, got {tuple(grid_size)}")
 
         boxes = []
         for idx in itertools.product(*(range(g) for g in grid_size)):

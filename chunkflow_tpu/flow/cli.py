@@ -19,6 +19,7 @@ import numpy as np
 
 from chunkflow_tpu.chunk import Chunk, Image, Segmentation
 from chunkflow_tpu.core.bbox import BoundingBox, BoundingBoxes
+from chunkflow_tpu.core.cartesian import to_cartesian
 from chunkflow_tpu.flow.runtime import (
     DEFAULT_CHUNK_NAME,
     PipelineState,
@@ -120,6 +121,7 @@ def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
 
     start, stop, size = roi_start, roi_stop, roi_size
     block = aligned_block_size
+    block_anchor = None
     if stop is not None and size is not None:
         raise click.UsageError("give --roi-stop OR --roi-size, not both")
     if bounding_box is not None:
@@ -146,6 +148,9 @@ def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
         # (pass -a to opt in)
         if block is None and derived:
             block = tuple(vol.block_size(vmip))
+        if block is not None:
+            # the volume's block grid anchors at its voxel_offset
+            block_anchor = tuple(vol.voxel_offset(vmip))
     if start is None:
         start = (0, 0, 0)
 
@@ -159,6 +164,7 @@ def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
             roi_size=size,
             grid_size=grid_size,
             aligned_block_size=block,
+            block_offset=block_anchor,
             bounded=bounded,
         )
         boxes = list(bboxes)
@@ -552,6 +558,10 @@ def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
 @click.option("--volume-path", "-v", type=str, required=True)
 @click.option("--mip", type=int, default=None, help="defaults to global --mip")
 @cartesian_option("--expand-margin-size", "-e", default=(0, 0, 0))
+@cartesian_option("--chunk-start", "-s", default=None,
+                  help="cut this explicit box instead of the task bbox")
+@cartesian_option("--chunk-size", "-z", default=None,
+                  help="with --chunk-start: the box extent")
 @click.option("--fill-missing/--no-fill-missing", default=True)
 @click.option("--blackout-sections/--no-blackout-sections", default=False,
               help="zero z-sections listed in the volume's blackout_section_ids.json")
@@ -562,24 +572,50 @@ def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
               "(the reference asserts exact equality; >0 tolerates pyramid "
               "rounding)")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_precomputed_cmd(op_name, volume_path, mip, expand_margin_size, fill_missing,
+def load_precomputed_cmd(op_name, volume_path, mip, expand_margin_size,
+                         chunk_start, chunk_size, fill_missing,
                          blackout_sections, validate_mip, validate_tolerance,
                          output_chunk_name):
     """Cut out the task bbox (plus margins) from a precomputed volume.
 
     Reference parity: LoadPrecomputedOperator incl. bad-section blackout
-    (load_precomputed.py:99-113) and cross-mip re-download validation
-    (load_precomputed.py:115-182)."""
+    (load_precomputed.py:99-113), cross-mip re-download validation
+    (load_precomputed.py:115-182), and explicit --chunk-start/--chunk-size
+    boxes (flow.py:1185-1191)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
 
     vol = PrecomputedVolume(volume_path)
+    use_explicit = chunk_start is not None or chunk_size is not None
+
+    def explicit_bbox(mip):
+        # reference semantics (flow.py:1234-1243): a missing start/size
+        # defaults from the volume's bounds at this mip
+        bounds = vol.bounds(mip)
+        start = chunk_start if chunk_start is not None else tuple(bounds.start)
+        size = (
+            chunk_size if chunk_size is not None
+            else tuple(bounds.stop - to_cartesian(start))
+        )
+        return BoundingBox.from_delta(start, size)
 
     @operator
     def stage(task):
-        bbox = task["bbox"]
+        the_mip_ = mip if mip is not None else state.mip
+        # the task's own bbox wins (reference flow.py:1228-1232); the
+        # explicit box is the no-task-grid fallback
+        bbox = (
+            task["bbox"] if task.get("bbox") is not None
+            else explicit_bbox(the_mip_) if use_explicit
+            else None
+        )
+        if bbox is None:
+            raise click.UsageError(
+                "no task bbox: run after generate-tasks/fetch-task, or "
+                "give --chunk-start/--chunk-size"
+            )
         if expand_margin_size and any(expand_margin_size):
             bbox = bbox.adjust(expand_margin_size)
-        the_mip = mip if mip is not None else state.mip
+        the_mip = the_mip_
         chunk = vol.cutout(bbox, mip=the_mip, fill_missing=fill_missing)
         # validate the RAW cutout; blackout intentionally zeroes data and
         # must not trigger mismatch warnings
@@ -658,8 +694,16 @@ def _validate_cutout(vol, chunk, mip, validate_mip, tolerance=0.01):
 @click.option("--mip", type=int, default=None)
 @click.option("--upload-log/--no-upload-log", default=True)
 @click.option("--create-thumbnail/--no-create-thumbnail", default=False)
+@click.option("--intensity-threshold", type=float, default=None,
+              help="skip the write when the chunk's max intensity is below "
+                   "this (reference flow.py:2286-2309: don't waste storage "
+                   "on near-empty chunks)")
+@click.option("--parallel", type=int, default=1,
+              help="accepted for reference compatibility; tensorstore "
+                   "already writes blocks concurrently")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail,
+                         intensity_threshold, parallel,
                          input_chunk_name):
     """Write the chunk to a precomputed volume (+ timing log sidecar)."""
     import json
@@ -673,6 +717,10 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
     def stage(task):
         chunk = task[input_chunk_name]
         if state.dry_run:
+            return task
+        if (intensity_threshold is not None
+                and float(np.asarray(chunk.array).max()) < intensity_threshold):
+            print(f"skip save: max intensity below {intensity_threshold}")
             return task
         vol.save(chunk, mip=mip if mip is not None else state.mip)
         if create_thumbnail:
@@ -1479,10 +1527,15 @@ def downsample_cmd(op_name, factor, input_chunk_name, output_chunk_name):
 @name_option("downsample-upload")
 @click.option("--volume-path", "-v", type=str, required=True)
 @cartesian_option("--factor", "-f", default=(1, 2, 2))
-@click.option("--start-mip", type=int, default=1)
+@click.option("--chunk-mip", type=int, default=None,
+              help="mip level of the incoming chunk (default: the "
+                   "group-level --mip); pyramid levels count from here")
+@click.option("--start-mip", type=int, default=None,
+              help="first level written (default: chunk mip + 1)")
 @click.option("--stop-mip", type=int, default=None, help="exclusive; defaults to volume num_mips")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def downsample_upload_cmd(op_name, volume_path, factor, start_mip, stop_mip, input_chunk_name):
+def downsample_upload_cmd(op_name, volume_path, factor, chunk_mip, start_mip,
+                          stop_mip, input_chunk_name):
     """Build a mip pyramid of the chunk and upload every level."""
     from chunkflow_tpu.ops.downsample import downsample
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
@@ -1491,11 +1544,18 @@ def downsample_upload_cmd(op_name, volume_path, factor, start_mip, stop_mip, inp
 
     @operator
     def stage(task):
+        base = chunk_mip if chunk_mip is not None else state.mip
+        first = start_mip if start_mip is not None else base + 1
+        if first <= base:
+            # reference downsample_upload.py asserts start_mip > chunk_mip
+            raise click.UsageError(
+                f"--start-mip ({first}) must be above the chunk mip ({base})"
+            )
         stop = stop_mip if stop_mip is not None else vol.num_mips
         current = task[input_chunk_name]
-        for level in range(1, stop):
+        for level in range(base + 1, stop):
             current = downsample(current, factor)
-            if level >= start_mip and not state.dry_run:
+            if level >= first and not state.dry_run:
                 vol.save(current, mip=level)
         return task
 

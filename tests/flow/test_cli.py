@@ -503,8 +503,9 @@ def test_generate_tasks_reference_forms(runner, tmp_path):
     ])
     assert result.exit_code == 0, result.output
     tasks = tf.read_text().split()
-    # roi (8,16,16)-(40,80,80) snapped to (16,32,32) blocks -> 3^3 grid
-    assert len(tasks) == 27 and tasks[0] == "0-16_0-32_0-32"
+    # roi (8,16,16)-(40,80,80) snapped to (16,32,32) blocks ANCHORED at the
+    # volume's voxel_offset (storage blocks start there) -> exact 2^3 grid
+    assert len(tasks) == 8 and tasks[0] == "8-24_16-48_16-48"
 
     result = runner.invoke(main, [
         "generate-tasks", "-b", "0-32_0-64_0-64", "-c", "16", "32", "32",
@@ -522,3 +523,118 @@ def test_generate_tasks_reference_forms(runner, tmp_path):
     assert all(
         int(s.split("_")[0].split("-")[1]) <= 20 for s in tf.read_text().split()
     )
+
+
+def test_load_save_precomputed_reference_options(runner, tmp_path):
+    """--chunk-start/--chunk-size explicit boxes on load;
+    --intensity-threshold save skip (reference flow.py:1185-1191,
+    :2286-2309)."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(8, 8, 8),
+    )
+    out = tmp_path / "o.h5"
+    # write constant-1 data, then explicit-box load without any task bbox
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--intensity-threshold", "300",
+    ])
+    assert result.exit_code == 0, result.output
+    assert "skip save" in result.output  # uint8 max < 300
+
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--intensity-threshold", "10",
+    ])
+    assert result.exit_code == 0, result.output
+    assert "skip save" not in result.output
+
+    result = runner.invoke(main, [
+        "load-precomputed", "-v", str(root),
+        "--chunk-start", "0", "0", "8", "--chunk-size", "8", "16", "8",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    import h5py
+
+    with h5py.File(out, "r") as f:
+        key = [k for k in f if "voxel" not in k and "layer" not in k][0]
+        assert f[key].shape[-3:] == (8, 16, 8)
+
+
+def test_downsample_upload_chunk_mip_semantics(runner, tmp_path):
+    """Pyramid levels count from --chunk-mip; --start-mip at or below the
+    chunk mip fails fast (reference downsample_upload.py asserts
+    start_mip > chunk_mip)."""
+    pytest.importorskip("tensorstore")
+    import json
+
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 32, 32), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(8, 8, 8), num_mips=3,
+        downsample_factor=(1, 2, 2),
+    )
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "32", "32", "--roi-stop", "8", "32", "32",
+        "create-chunk", "-s", "8", "32", "32", "--pattern", "sin",
+        "downsample-upload", "-v", str(root), "--factor", "1", "2", "2",
+    ])
+    assert result.exit_code == 0, result.output
+    vol = PrecomputedVolume(str(root))
+    # levels 1 and 2 written, shapes halved in yx
+    assert np.asarray(vol.cutout(vol.bounds(1), mip=1).array).shape[-2:] == (16, 16)
+    assert np.asarray(vol.cutout(vol.bounds(2), mip=2).array).shape[-2:] == (8, 8)
+
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "32", "32",
+        "downsample-upload", "-v", str(root), "--chunk-mip", "1",
+        "--start-mip", "1",
+    ])
+    assert result.exit_code != 0
+    assert "must be above the chunk mip" in str(result.output) + str(result.exception)
+
+
+def test_load_precomputed_task_bbox_wins_over_explicit(runner, tmp_path):
+    """Reference precedence (flow.py:1228-1243): the task's own bbox wins;
+    --chunk-start/--chunk-size is the no-task fallback, and a lone
+    --chunk-size defaults its start from the volume bounds."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(8, 8, 8),
+    )
+    out = tmp_path / "o.h5"
+    # task bbox (from generate-tasks) wins over the explicit box
+    result = runner.invoke(main, [
+        "generate-tasks", "-c", "8", "8", "8", "--roi-start", "0", "8", "8",
+        "--roi-stop", "8", "16", "16",
+        "load-precomputed", "-v", str(root),
+        "--chunk-start", "0", "0", "0", "--chunk-size", "8", "16", "16",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    import h5py
+
+    with h5py.File(out, "r") as f:
+        key = [k for k in f if "voxel" not in k and "layer" not in k][0]
+        assert f[key].shape[-3:] == (8, 8, 8)  # the task's box, not the explicit one
+
+    # lone --chunk-size: start defaults from the volume bounds
+    result = runner.invoke(main, [
+        "load-precomputed", "-v", str(root), "--chunk-size", "8", "16", "8",
+        "save-h5", "--file-name", str(out),
+    ])
+    assert result.exit_code == 0, result.output
+    with h5py.File(out, "r") as f:
+        key = [k for k in f if "voxel" not in k and "layer" not in k][0]
+        assert f[key].shape[-3:] == (8, 16, 8)
