@@ -447,16 +447,26 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         path: str,
         compression: str = "gzip",
         with_unique: bool = False,
+        chunk_size=None,
+        with_offset: bool = True,
     ) -> str:
         import h5py
 
         if not path.endswith(".h5"):
             path = os.path.join(path, f"{self.bbox.string}.h5")
         with h5py.File(path, "w") as f:
+            arr = np.asarray(self.array)
+            chunks = None
+            if chunk_size is not None:
+                chunks = tuple(chunk_size)
+                if arr.ndim == 4 and len(chunks) == 3:
+                    chunks = (arr.shape[0],) + chunks
+                chunks = tuple(min(c, s) for c, s in zip(chunks, arr.shape))
             f.create_dataset(
-                "main", data=np.asarray(self.array), compression=compression
+                "main", data=arr, compression=compression, chunks=chunks
             )
-            f.create_dataset("voxel_offset", data=self.voxel_offset.vec)
+            if with_offset:
+                f.create_dataset("voxel_offset", data=self.voxel_offset.vec)
             f.create_dataset("voxel_size", data=self.voxel_size.vec)
             f.attrs["layer_type"] = self.layer_type.value
             if with_unique and self.is_segmentation:
@@ -475,6 +485,7 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         voxel_size=None,
         bbox: Optional[BoundingBox] = None,
         dtype=None,
+        channels=None,
     ) -> "Chunk":
         import h5py
 
@@ -495,6 +506,12 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
                 voxel_offset = bbox.start
             else:
                 arr = dset[()]
+        if channels is not None and arr.ndim == 4:
+            if isinstance(channels, str):
+                idx = [int(c) for c in channels.split(",") if c.strip()]
+            else:
+                idx = [int(c) for c in channels]
+            arr = arr[idx]
         if dtype is not None:
             arr = arr.astype(dtype)
         return cls(
@@ -504,10 +521,16 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
             layer_type=layer_type,
         )
 
-    def to_tif(self, path: str) -> str:
+    def to_tif(self, path: str, compression: str = "zlib") -> str:
         from chunkflow_tpu.volume import io_tif
 
-        return io_tif.write_tif(self, path)
+        return io_tif.write_tif(self, path, compression=compression)
+
+    def with_voxel_size(self, voxel_size) -> "Chunk":
+        """Same data, different physical voxel size."""
+        out = self._with_array(self.array)
+        out.voxel_size = Cartesian.from_collection(voxel_size)
+        return out
 
     @classmethod
     def from_tif(cls, path: str, voxel_offset=None, voxel_size=None, dtype=None):

@@ -18,6 +18,7 @@ import click
 import numpy as np
 
 from chunkflow_tpu.chunk import Chunk, Image, Segmentation
+from chunkflow_tpu.chunk.base import LayerType
 from chunkflow_tpu.core.bbox import BoundingBox, BoundingBoxes
 from chunkflow_tpu.core.cartesian import to_cartesian
 from chunkflow_tpu.flow.runtime import (
@@ -35,6 +36,24 @@ def cartesian_option(*names, default=None, required=False, help=""):
     return click.option(
         *names, type=int, nargs=3, default=default, required=required, help=help
     )
+
+
+def _h5_task_path(prefix: str, bbox) -> str:
+    """Complete a non-.h5 prefix as <prefix><bbox>.h5 (reference naming)."""
+    return f"{prefix}{bbox.string}.h5"
+
+
+def _touch_marker(prefix, bbox, suffix):
+    """Touch <prefix><bbox><suffix> as a skip/resume marker (never under
+    --dry-run: a dry preview must not fabricate resume state)."""
+    import os
+    from pathlib import Path
+
+    if state.dry_run:
+        return
+    fname = f"{prefix}{bbox.string}{suffix}"
+    if not os.path.exists(fname):
+        Path(fname).touch()
 
 
 def name_option(default):
@@ -208,7 +227,9 @@ def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
 @cartesian_option("--volume-start", required=True)
 @cartesian_option("--volume-stop", default=None)
 @cartesian_option("--volume-size", "-s", default=None)
-@click.option("--volume-path", "-l", type=str, required=True)
+@click.option("--volume-path", "--layer-path", "-l", type=str, required=True)
+@click.option("--visibility-timeout", type=int, default=None,
+              help="visibility timeout for the task queue being seeded")
 @click.option("--max-ram-size", "-r", type=float, default=15.0,
               help="RAM budget in GB; half goes to the output buffer")
 @cartesian_option("--output-patch-size", "-z", required=True)
@@ -228,8 +249,8 @@ def generate_tasks_cmd(volume_path, mip, chunk_size, overlap, roi_start,
 @click.option("--queue-name", "-q", type=str, default=None,
               help="also push the task grid to this queue")
 def setup_env_cmd(
-    volume_start, volume_stop, volume_size, volume_path, max_ram_size,
-    output_patch_size, input_patch_size, output_patch_overlap,
+    volume_start, volume_stop, volume_size, volume_path, visibility_timeout,
+    max_ram_size, output_patch_size, input_patch_size, output_patch_overlap,
     crop_chunk_margin, channel_num, dtype, env_mip, thumbnail_mip, max_mip,
     thumbnail, encoding, voxel_size, overwrite_info, queue_name,
 ):
@@ -268,7 +289,11 @@ def setup_env_cmd(
         if queue_name is not None and not state.dry_run:
             from chunkflow_tpu.parallel.queues import open_queue
 
-            queue = open_queue(queue_name)
+            queue = open_queue(
+                queue_name,
+                **({"visibility_timeout": visibility_timeout}
+                   if visibility_timeout is not None else {}),
+            )
             queue.send_messages([b.string for b in plan.bboxes])
             print(f"pushed {len(plan.bboxes)} tasks to {queue_name}")
             return
@@ -283,7 +308,7 @@ def setup_env_cmd(
 
 
 @main.command("fetch-task-from-file")
-@click.option("--task-file", "-f", type=str, required=True,
+@click.option("--task-file", "--file-path", "-f", type=str, required=True,
               help=".txt/.npy task list from generate-tasks")
 @click.option("--job-index", type=int, default=None,
               help="index into the task list; defaults to $SLURM_ARRAY_TASK_ID")
@@ -368,9 +393,12 @@ def prefetch_cmd(depth, to_device):
 
 @main.command("fetch-task-from-queue")
 @click.option("--queue-name", "-q", type=str, required=True)
-@click.option("--visibility-timeout", type=int, default=1800)
+@click.option("--visibility-timeout", "-v", type=int, default=1800)
+@click.option("--retry-times", "-r", type=int, default=30,
+              help="empty-queue polls before giving up (reference "
+                   "sqs_queue.py:115-130)")
 @click.option("--num", type=int, default=-1, help="max tasks to process (-1: drain)")
-def fetch_task_cmd(queue_name, visibility_timeout, num):
+def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num):
     """Pull bbox tasks from a queue; ack via delete-task-in-queue."""
 
     @generator
@@ -379,6 +407,7 @@ def fetch_task_cmd(queue_name, visibility_timeout, num):
         from chunkflow_tpu.parallel.queues import open_queue
 
         queue = open_queue(queue_name, visibility_timeout=visibility_timeout)
+        queue.max_empty_retries = retry_times
         count = 0
         for handle, body in queue:
             t = new_task()
@@ -438,19 +467,68 @@ def create_chunk_cmd(op_name, size, dtype, pattern, voxel_offset, voxel_size, ou
 
 @main.command("load-h5")
 @name_option("load-h5")
-@click.option("--file-name", "-f", type=str, required=True)
-@click.option("--dataset-path", type=str, default="main")
+@click.option("--file-name", "-f", type=str, required=True,
+              help=".h5 path, or a prefix completed as <prefix><bbox>.h5")
+@click.option("--dataset-path", "-d", type=str, default="main")
+@click.option("--dtype", "-e", type=str, default=None)
+@click.option("--layer-type", "-l",
+              type=click.Choice(["image", "segmentation"]), default=None)
+@cartesian_option("--voxel-offset", "-v", default=None)
+@cartesian_option("--voxel-size", "-x", default=None)
+@click.option("--channels", "-c", type=str, default=None,
+              help="comma-separated channel indices to keep")
+@cartesian_option("--cutout-start", "-t", default=None)
+@cartesian_option("--cutout-stop", "-p", default=None)
+@cartesian_option("--cutout-size", "-s", default=None)
+@click.option("--set-bbox/--no-set-bbox", default=False,
+              help="publish the loaded chunk's bbox as the task bbox")
+@click.option("--remove-empty/--do-not-remove", default=False,
+              help="delete the file when the loaded chunk is all zero")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-@cartesian_option("--voxel-offset", default=None)
-def load_h5_cmd(op_name, file_name, dataset_path, output_chunk_name, voxel_offset):
+def load_h5_cmd(op_name, file_name, dataset_path, dtype, layer_type,
+                voxel_offset, voxel_size, channels, cutout_start,
+                cutout_stop, cutout_size, set_bbox, remove_empty,
+                output_chunk_name):
+    """Read an HDF5 chunk (reference flow.py:976-1066 surface)."""
+    import os
+
+    if cutout_start is not None:
+        if cutout_stop is not None:
+            cutout = BoundingBox(cutout_start, cutout_stop)
+        elif cutout_size is not None:
+            cutout = BoundingBox.from_delta(cutout_start, cutout_size)
+        else:
+            raise click.UsageError(
+                "--cutout-start needs --cutout-stop or --cutout-size"
+            )
+    else:
+        cutout = None
+
     @operator
     def stage(task):
-        task[output_chunk_name] = Chunk.from_h5(
-            file_name,
+        # an explicit cutout beats the task bbox (reference :1022-1033)
+        bbox = cutout if cutout is not None else task.get("bbox")
+        path = file_name
+        if not path.endswith(".h5") and bbox is not None:
+            path = _h5_task_path(path, bbox)
+        chunk = Chunk.from_h5(
+            path,
             dataset_path=dataset_path,
-            voxel_offset=voxel_offset if voxel_offset and any(v != 0 for v in voxel_offset) else None,
-            bbox=task.get("bbox"),
+            voxel_offset=voxel_offset,
+            voxel_size=voxel_size,
+            bbox=bbox,
+            dtype=np.dtype(dtype) if dtype else None,
+            channels=channels,
         )
+        if layer_type is not None:
+            chunk.layer_type = LayerType(layer_type)
+        if (remove_empty and not state.dry_run
+                and not np.any(np.asarray(chunk.array))):
+            print(f"remove empty {path}")
+            os.remove(path)
+        task[output_chunk_name] = chunk
+        if set_bbox:
+            task["bbox"] = chunk.bbox
         return task
 
     return stage(_name=op_name)
@@ -458,11 +536,24 @@ def load_h5_cmd(op_name, file_name, dataset_path, output_chunk_name, voxel_offse
 
 @main.command("save-h5")
 @name_option("save-h5")
-@click.option("--file-name", "-f", type=str, default=None)
+@click.option("--file-name", "-f", type=str, default=None,
+              help=".h5 path, or a prefix completed as <prefix><bbox>.h5")
 @click.option("--file-name-prefix", type=str, default=None,
               help="write one file per task: <prefix><bbox-string>.h5")
-@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_h5_cmd(op_name, file_name, file_name_prefix, input_chunk_name):
+@cartesian_option("--chunk-size", "-s", default=None,
+                  help="HDF5 dataset chunking (compression block shape)")
+@click.option("--compression", "-c",
+              type=click.Choice(["gzip", "lzf", "szip"]), default="gzip")
+@click.option("--with-offset/--without-offset", default=True,
+              help="write the voxel_offset sidecar dataset")
+@cartesian_option("--voxel-size", "-v", default=None,
+                  help="override the chunk's voxel size on write")
+@click.option("--dtype", "-d", type=str, default=None,
+              help="convert before writing")
+@click.option("--input-chunk-name", "--input-name", "-i", type=str,
+              default=DEFAULT_CHUNK_NAME)
+def save_h5_cmd(op_name, file_name, file_name_prefix, chunk_size, compression,
+                with_offset, voxel_size, dtype, input_chunk_name):
     if (file_name is None) == (file_name_prefix is None):
         raise click.UsageError(
             "save-h5 needs exactly one of --file-name / --file-name-prefix"
@@ -471,12 +562,21 @@ def save_h5_cmd(op_name, file_name, file_name_prefix, input_chunk_name):
     @operator
     def stage(task):
         chunk = task[input_chunk_name]
+        if dtype is not None:
+            chunk = chunk.astype(np.dtype(dtype))
+        if voxel_size is not None:
+            chunk = chunk.with_voxel_size(voxel_size)
         if file_name_prefix is not None:
-            bbox = task.get("bbox") or chunk.bbox
-            path = f"{file_name_prefix}{bbox.string}.h5"
+            path = _h5_task_path(file_name_prefix, task.get("bbox") or chunk.bbox)
+        elif not file_name.endswith(".h5"):
+            # reference behavior: a non-.h5 --file-name is a prefix
+            path = _h5_task_path(file_name, task.get("bbox") or chunk.bbox)
         else:
             path = file_name
-        chunk.to_h5(path)
+        chunk.to_h5(
+            path, compression=compression, chunk_size=chunk_size,
+            with_offset=with_offset,
+        )
         return task
 
     return stage(_name=op_name)
@@ -486,16 +586,24 @@ def save_h5_cmd(op_name, file_name, file_name_prefix, input_chunk_name):
 @name_option("load-tif")
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-@cartesian_option("--voxel-offset", default=(0, 0, 0))
-@click.option("--dtype", type=str, default=None)
-def load_tif_cmd(op_name, file_name, output_chunk_name, voxel_offset, dtype):
+@cartesian_option("--voxel-offset", "-v", default=(0, 0, 0))
+@cartesian_option("--voxel-size", "-s", default=None)
+@click.option("--layer-type", "-l",
+              type=click.Choice(["image", "segmentation"]), default=None)
+@click.option("--dtype", "-d", type=str, default=None)
+def load_tif_cmd(op_name, file_name, output_chunk_name, voxel_offset,
+                 voxel_size, layer_type, dtype):
     @operator
     def stage(task):
-        task[output_chunk_name] = Chunk.from_tif(
+        chunk = Chunk.from_tif(
             file_name,
             voxel_offset=voxel_offset,
+            voxel_size=voxel_size,
             dtype=np.dtype(dtype) if dtype else None,
         )
+        if layer_type is not None:
+            chunk.layer_type = LayerType(layer_type)
+        task[output_chunk_name] = chunk
         return task
 
     return stage(_name=op_name)
@@ -504,11 +612,18 @@ def load_tif_cmd(op_name, file_name, output_chunk_name, voxel_offset, dtype):
 @main.command("save-tif")
 @name_option("save-tif")
 @click.option("--file-name", "-f", type=str, required=True)
+@click.option("--dtype", "-d", type=str, default=None,
+              help="convert before writing")
+@click.option("--compression", type=str, default="zlib",
+              help="tifffile compression codec")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_tif_cmd(op_name, file_name, input_chunk_name):
+def save_tif_cmd(op_name, file_name, dtype, compression, input_chunk_name):
     @operator
     def stage(task):
-        task[input_chunk_name].to_tif(file_name)
+        chunk = task[input_chunk_name]
+        if dtype is not None:
+            chunk = chunk.astype(np.dtype(dtype))
+        chunk.to_tif(file_name, compression=compression)
         return task
 
     return stage(_name=op_name)
@@ -523,14 +638,17 @@ def save_tif_cmd(op_name, file_name, input_chunk_name):
 @cartesian_option("--volume-size", "-s", required=True)
 @cartesian_option("--voxel-size", default=(1, 1, 1))
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
-@click.option("--num-channels", "-c", type=int, default=1)
-@click.option("--dtype", type=str, default="uint8")
+@click.option("--num-channels", "--channel-num", "-c", type=int, default=1)
+@click.option("--dtype", "--data-type", type=str, default="uint8")
+@click.option("--encoding", "-e", type=str, default="raw",
+              help="block encoding written to the info file")
 @click.option("--layer-type", type=click.Choice(["image", "segmentation"]), default="image")
 @cartesian_option("--block-size", default=(64, 64, 64))
 @click.option("--max-mip", type=int, default=0)
 @cartesian_option("--factor", default=(1, 2, 2))
 def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
-                    num_channels, dtype, layer_type, block_size, max_mip, factor):
+                    num_channels, dtype, encoding, layer_type, block_size,
+                    max_mip, factor):
     """Create a precomputed volume info file (with mip pyramid)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
 
@@ -544,6 +662,7 @@ def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
             num_channels=num_channels,
             dtype=dtype,
             layer_type=layer_type,
+            encoding=encoding,
             block_size=block_size,
             num_mips=max_mip + 1,
             downsample_factor=factor,
@@ -782,7 +901,7 @@ def log_summary_cmd(log_dir, output_size):
 # ---------------------------------------------------------------------------
 @main.command("load-synapses")
 @name_option("load-synapses")
-@click.option("--file-name", "-f", type=str, required=True, help=".json or .h5")
+@click.option("--file-name", "--file-path", "-f", type=str, required=True, help=".json or .h5")
 @click.option("--output-name", "-o", type=str, default="synapses")
 def load_synapses_cmd(op_name, file_name, output_name):
     from chunkflow_tpu.annotations.synapses import Synapses
@@ -800,7 +919,7 @@ def load_synapses_cmd(op_name, file_name, output_name):
 
 @main.command("save-synapses")
 @name_option("save-synapses")
-@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--file-name", "--file-path", "-f", type=str, required=True)
 @click.option("--input-name", "-i", type=str, default="synapses")
 def save_synapses_cmd(op_name, file_name, input_name):
     @operator
@@ -813,7 +932,7 @@ def save_synapses_cmd(op_name, file_name, input_name):
 
 @main.command("save-points")
 @name_option("save-points")
-@click.option("--file-name", "-f", type=str, required=True, help=".h5 or .npy")
+@click.option("--file-name", "--file-path", "-f", type=str, required=True, help=".h5 or .npy")
 @click.option("--input-name", "-i", type=str, default="points")
 def save_points_cmd(op_name, file_name, input_name):
     from chunkflow_tpu.annotations.point_cloud import PointCloud
@@ -834,7 +953,7 @@ def save_points_cmd(op_name, file_name, input_name):
 
 @main.command("load-skeleton")
 @name_option("load-skeleton")
-@click.option("--file-name", "-f", type=str, required=True, help=".swc file")
+@click.option("--file-name", "--path", "-f", type=str, required=True, help=".swc file")
 @click.option("--output-name", "-o", type=str, default="skeleton")
 def load_skeleton_cmd(op_name, file_name, output_name):
     from chunkflow_tpu.annotations.skeleton import Skeleton
@@ -849,7 +968,8 @@ def load_skeleton_cmd(op_name, file_name, output_name):
 
 @main.command("save-swc")
 @name_option("save-swc")
-@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--file-name", "--output-prefix", "-f", type=str, required=True,
+              help=".swc path, or a prefix completed per skeleton id")
 @click.option("--input-name", "-i", type=str, default="skeleton")
 def save_swc_cmd(op_name, file_name, input_name):
     @operator
@@ -862,15 +982,18 @@ def save_swc_cmd(op_name, file_name, input_name):
 
 @main.command("load-npy")
 @name_option("load-npy")
-@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--file-name", "--file-path", "-f", type=str, required=True)
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
-@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_npy_cmd(op_name, file_name, voxel_offset, output_chunk_name):
+@cartesian_option("--voxel-size", "--resolution", default=None)
+@click.option("--output-chunk-name", "--output-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+def load_npy_cmd(op_name, file_name, voxel_offset, voxel_size,
+                 output_chunk_name):
     @operator
     def stage(task):
-        task[output_chunk_name] = Chunk.from_npy(
-            file_name, voxel_offset=voxel_offset
-        )
+        chunk = Chunk.from_npy(file_name, voxel_offset=voxel_offset)
+        if voxel_size is not None:
+            chunk = chunk.with_voxel_size(voxel_size)
+        task[output_chunk_name] = chunk
         return task
 
     return stage(_name=op_name)
@@ -891,7 +1014,7 @@ def save_npy_cmd(op_name, file_name, input_chunk_name):
 
 @main.command("load-json")
 @name_option("load-json")
-@click.option("--file-name", "-f", type=str, required=True)
+@click.option("--file-name", "--file-path", "-f", type=str, required=True)
 @click.option("--output-name", "-o", type=str, default="json")
 def load_json_cmd(op_name, file_name, output_name):
     import json as _json
@@ -907,26 +1030,43 @@ def load_json_cmd(op_name, file_name, output_name):
 
 @main.command("load-zarr")
 @name_option("load-zarr")
-@click.option("--store-path", "-p", type=str, required=True)
+@click.option("--store-path", "--store", "--path", "-p", type=str, required=True)
+@click.option("--driver", type=click.Choice(["zarr", "zarr3", "n5"]),
+              default="zarr", help="tensorstore driver")
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
-def load_zarr_cmd(op_name, store_path, output_chunk_name, voxel_offset):
+@cartesian_option("--voxel-size", default=None)
+@cartesian_option("--chunk-start", default=None,
+                  help="explicit cutout start (overrides the task bbox)")
+@cartesian_option("--chunk-size", default=None)
+def load_zarr_cmd(op_name, store_path, driver, output_chunk_name,
+                  voxel_offset, voxel_size, chunk_start, chunk_size):
     """Load a zyx zarr array (tensorstore zarr driver)."""
     import tensorstore as ts
+
+    if (chunk_start is None) != (chunk_size is None):
+        raise click.UsageError(
+            "--chunk-start and --chunk-size must be given together"
+        )
 
     @operator
     def stage(task):
         store = ts.open(
-            {"driver": "zarr", "kvstore": {"driver": "file", "path": store_path}}
+            {"driver": driver, "kvstore": {"driver": "file", "path": store_path}}
         ).result()
-        if task.get("bbox") is not None:
-            bbox = task["bbox"]
+        explicit = (
+            BoundingBox.from_delta(chunk_start, chunk_size)
+            if chunk_start is not None else None
+        )
+        if explicit is not None or task.get("bbox") is not None:
+            bbox = explicit if explicit is not None else task["bbox"]
             arr = store[bbox.slices].read().result()
-            task[output_chunk_name] = Chunk(arr, voxel_offset=bbox.start)
+            chunk = Chunk(arr, voxel_offset=bbox.start)
         else:
-            task[output_chunk_name] = Chunk(
-                store.read().result(), voxel_offset=voxel_offset
-            )
+            chunk = Chunk(store.read().result(), voxel_offset=voxel_offset)
+        if voxel_size is not None:
+            chunk = chunk.with_voxel_size(voxel_size)
+        task[output_chunk_name] = chunk
         return task
 
     return stage(_name=op_name)
@@ -934,16 +1074,30 @@ def load_zarr_cmd(op_name, store_path, output_chunk_name, voxel_offset):
 
 @main.command("save-zarr")
 @name_option("save-zarr")
-@click.option("--store-path", "-p", type=str, required=True)
+@click.option("--store-path", "--store", "-p", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-@cartesian_option("--volume-size", default=None, help="create store of this size first")
-def save_zarr_cmd(op_name, store_path, input_chunk_name, volume_size):
+@cartesian_option("--volume-size", "--shape", default=None, help="create store of this size first")
+@cartesian_option("--chunk-size", default=None,
+                  help="zarr store chunk shape on create")
+@click.option("--dtype", type=str, default=None, help="convert before writing")
+@cartesian_option("--resolution", default=None,
+                  help="voxel size recorded on the chunk before writing")
+@click.option("--mip", type=int, default=None,
+              help="accepted for reference compatibility")
+@click.option("--order", type=str, default=None,
+              help="accepted for reference compatibility (always zyx/C)")
+def save_zarr_cmd(op_name, store_path, input_chunk_name, volume_size,
+                  chunk_size, dtype, resolution, mip, order):
     """Write the chunk into a zyx zarr array at its voxel offset."""
     import tensorstore as ts
 
     @operator
     def stage(task):
         chunk = task[input_chunk_name]
+        if dtype is not None:
+            chunk = chunk.astype(np.dtype(dtype))
+        if resolution is not None:
+            chunk = chunk.with_voxel_size(resolution)
         arr = np.asarray(chunk.array)
         spec = {
             "driver": "zarr",
@@ -963,6 +1117,9 @@ def save_zarr_cmd(op_name, store_path, input_chunk_name, volume_size):
                 else tuple(int(s) for s in chunk.bbox.stop)
             )
             # open=True tolerates a concurrent worker winning the create race
+            if chunk_size is not None:
+                spec = dict(spec)
+                spec["metadata"] = {"chunks": list(chunk_size)}
             store = ts.open(
                 spec,
                 create=True,
@@ -1000,16 +1157,41 @@ def create_bbox_cmd(op_name, start, stop, size):
 @main.command("cleanup")
 @name_option("cleanup")
 @click.option("--dir", "-d", "directory", type=str, required=True)
+@click.option("--mode", "-m",
+              type=click.Choice(["exist", "empty", "not-empty"]),
+              default="exist",
+              help="remove only files meeting this condition "
+                   "(reference flow.py:424-455)")
 @click.option("--suffix", type=str, default=".h5")
-def cleanup_cmd(op_name, directory, suffix):
+def cleanup_cmd(op_name, directory, mode, suffix):
     """Remove per-task intermediate files for the task bbox."""
     import os
 
+    def removable(path):
+        if not os.path.exists(path):
+            return False
+        if mode == "empty":
+            return os.path.getsize(path) == 0
+        if mode == "not-empty":
+            return os.path.getsize(path) > 0
+        return True
+
     @operator
     def stage(task):
-        path = os.path.join(directory, f"{task['bbox'].string}{suffix}")
-        if os.path.exists(path) and not state.dry_run:
-            os.remove(path)
+        if task.get("bbox") is not None:
+            paths = [os.path.join(directory, f"{task['bbox'].string}{suffix}")]
+        else:
+            # bare seed task: sweep the whole directory (reference
+            # flow.py:424-455 iterates every matching file)
+            paths = [
+                os.path.join(directory, f)
+                for f in os.listdir(directory)
+                if (not suffix or f.endswith(suffix))
+                and os.path.isfile(os.path.join(directory, f))
+            ]
+        for path in paths:
+            if removable(path) and not state.dry_run:
+                os.remove(path)
         return task
 
     return stage(_name=op_name)
@@ -1021,12 +1203,31 @@ def cleanup_cmd(op_name, directory, suffix):
 @main.command("skip-all-zero")
 @name_option("skip-all-zero")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def skip_all_zero_cmd(op_name, input_chunk_name):
+@click.option("--prefix", "-p", type=str, default=None,
+              help="touch <prefix><bbox><suffix> as a completion marker "
+                   "when skipping (reference flow.py:294-326)")
+@click.option("--suffix", "-s", type=str, default="")
+@click.option("--adjust-size", "-a", type=int, default=None,
+              help="grow/shrink the marker bbox to match result filenames")
+@click.option("--chunk-bbox/--task-bbox", default=True,
+              help="name the marker after the chunk bbox or the task bbox")
+def skip_all_zero_cmd(op_name, input_chunk_name, prefix, suffix, adjust_size,
+                      chunk_bbox):
     """Drop the task if the chunk is entirely zero."""
+    import os
+    from pathlib import Path
 
     @operator
     def stage(task):
         if task[input_chunk_name].all_zero():
+            if prefix is not None:
+                bbox = (
+                    task[input_chunk_name].bbox if chunk_bbox
+                    else task["bbox"]
+                )
+                if adjust_size is not None:
+                    bbox = bbox.adjust(adjust_size)
+                _touch_marker(prefix, bbox, suffix)
             return None
         return task
 
@@ -1035,11 +1236,20 @@ def skip_all_zero_cmd(op_name, input_chunk_name):
 
 @main.command("skip-none")
 @name_option("skip-none")
-@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def skip_none_cmd(op_name, input_chunk_name):
+@click.option("--input-chunk-name", "--input-name", "-i", type=str,
+              default=DEFAULT_CHUNK_NAME)
+@click.option("--prefix", "-p", type=str, default=None,
+              help="touch <prefix><bbox><suffix> as a marker when skipping")
+@click.option("--suffix", "-s", type=str, default="")
+def skip_none_cmd(op_name, input_chunk_name, prefix, suffix):
+    import os
+    from pathlib import Path
+
     @operator
     def stage(task):
         if task.get(input_chunk_name) is None:
+            if prefix is not None and task.get("bbox") is not None:
+                _touch_marker(prefix, task["bbox"], suffix)
             return None
         return task
 
@@ -1050,16 +1260,30 @@ def skip_none_cmd(op_name, input_chunk_name):
 @name_option("skip-task-by-file")
 @click.option("--prefix", "-p", type=str, required=True, help="marker path prefix")
 @click.option("--suffix", "-s", type=str, default=".h5")
-def skip_task_by_file_cmd(op_name, prefix, suffix):
-    """Skip tasks whose marker/output file already exists (resume)."""
+@click.option("--mode", "-m",
+              type=click.Choice(["missing", "empty", "exist"]),
+              default="exist",
+              help="skip when the file is missing / missing-or-empty / "
+                   "exists (reference flow.py:211-246)")
+@click.option("--adjust-size", "-a", type=int, default=None,
+              help="grow/shrink the bbox used in the file name")
+def skip_task_by_file_cmd(op_name, prefix, suffix, mode, adjust_size):
+    """Skip tasks by the state of their marker/output file (resume)."""
     import os
 
     @operator
     def stage(task):
-        path = f"{prefix}{task['bbox'].string}{suffix}"
-        if os.path.exists(path):
-            return None
-        return task
+        bbox = task["bbox"]
+        if adjust_size is not None:
+            bbox = bbox.adjust(adjust_size)
+        path = f"{prefix}{bbox.string}{suffix}"
+        if mode == "exist":
+            skip = os.path.exists(path)
+        elif mode == "missing":
+            skip = not os.path.exists(path)
+        else:  # empty
+            skip = not os.path.exists(path) or os.path.getsize(path) == 0
+        return None if skip else task
 
     return stage(_name=op_name)
 
@@ -1337,11 +1561,19 @@ def channel_voting_cmd(op_name, input_chunk_name, output_chunk_name):
 
 @main.command("normalize-contrast")
 @name_option("normalize-contrast")
-@click.option("--lower-clip-fraction", type=float, default=0.01)
-@click.option("--upper-clip-fraction", type=float, default=0.01)
+@click.option("--lower-clip-fraction", "-l", type=float, default=0.01)
+@click.option("--upper-clip-fraction", "-u", type=float, default=0.01)
+@click.option("--minval", type=int, default=1,
+              help="minimum intensity of the transformed chunk")
+@click.option("--maxval", type=int, default=255,
+              help="maximum intensity of the transformed chunk")
+@click.option("--per-section/--whole", default=True,
+              help="normalize each z-section independently or the whole chunk")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def normalize_contrast_cmd(op_name, lower_clip_fraction, upper_clip_fraction, input_chunk_name, output_chunk_name):
+def normalize_contrast_cmd(op_name, lower_clip_fraction, upper_clip_fraction,
+                           minval, maxval, per_section, input_chunk_name,
+                           output_chunk_name):
     @operator
     def stage(task):
         img = task[input_chunk_name]
@@ -1350,6 +1582,9 @@ def normalize_contrast_cmd(op_name, lower_clip_fraction, upper_clip_fraction, in
         task[output_chunk_name] = img.normalize_contrast(
             lower_clip_fraction=lower_clip_fraction,
             upper_clip_fraction=upper_clip_fraction,
+            minval=minval,
+            maxval=maxval,
+            per_section=per_section,
         )
         return task
 
@@ -1414,10 +1649,14 @@ def normalize_section_shang_cmd(op_name,
 @click.option("--mip", type=int, default=0, help="scale index within the mask volume")
 @click.option("--inverse/--no-inverse", default=False)
 @click.option("--fill-missing/--no-fill-missing", default=True)
-@click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
+@click.option("--input-chunk-name", "--input-names", "-i", type=str,
+              default=DEFAULT_CHUNK_NAME,
+              help="comma-separated chunk names: one mask cutout is "
+                   "applied to every listed chunk (reference semantics)")
+@click.option("--output-chunk-name", "--output-names", "-o", type=str,
+              default=None, help="defaults to the input names")
 def mask_cmd(op_name, volume_path, mip, inverse, fill_missing, input_chunk_name, output_chunk_name):
-    """Multiply the chunk by a (usually coarser-resolution) mask volume."""
+    """Multiply the chunk(s) by a (usually coarser-resolution) mask volume."""
     import math
 
     from chunkflow_tpu.core.bbox import BoundingBox
@@ -1427,20 +1666,33 @@ def mask_cmd(op_name, volume_path, mip, inverse, fill_missing, input_chunk_name,
 
     vol = PrecomputedVolume(volume_path)
 
+    in_names = [n.strip() for n in input_chunk_name.split(",") if n.strip()]
+    out_names = (
+        [n.strip() for n in output_chunk_name.split(",") if n.strip()]
+        if output_chunk_name else in_names
+    )
+    if len(in_names) != len(out_names):
+        raise click.UsageError("input/output name counts must match")
+
     @operator
     def stage(task):
-        chunk = task[input_chunk_name]
-        factor = vol.voxel_size(mip) / chunk.voxel_size
+        first = task[in_names[0]]
+        factor = vol.voxel_size(mip) / first.voxel_size
         start = Cartesian(
-            *(int(math.floor(s / f)) for s, f in zip(chunk.bbox.start, factor))
+            *(int(math.floor(s / f)) for s, f in zip(first.bbox.start, factor))
         )
         stop = Cartesian(
-            *(int(math.ceil(e / f)) for e, f in zip(chunk.bbox.stop, factor))
+            *(int(math.ceil(e / f)) for e, f in zip(first.bbox.stop, factor))
         )
         mask_chunk = vol.cutout(
             BoundingBox(start, stop), mip=mip, fill_missing=fill_missing
         )
-        task[output_chunk_name] = maskout(chunk, mask_chunk, inverse=inverse)
+        # one mask cutout masks every listed chunk (reference flow
+        # applies MaskOperator to a chunk list)
+        for in_name, out_name in zip(in_names, out_names):
+            task[out_name] = maskout(
+                task[in_name], mask_chunk, inverse=inverse
+            )
         return task
 
     return stage(_name=op_name)
@@ -1448,13 +1700,45 @@ def mask_cmd(op_name, volume_path, mip, inverse, fill_missing, input_chunk_name,
 
 @main.command("multiply")
 @name_option("multiply")
-@click.option("--input-names", "-i", type=str, required=True, help="comma-separated: a,b")
-@click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def multiply_cmd(op_name, input_names, output_chunk_name):
+@click.option("--input-names", "-i", type=str, default=DEFAULT_CHUNK_NAME,
+              help="comma-separated chunk names")
+@click.option("--multiplier-name", "-m", type=str, default=None,
+              help="multiply every input by this chunk (reference "
+                   "semantics); without it, exactly two input names "
+                   "multiply together")
+@click.option("--output-names", "--output-chunk-name", "-o", type=str,
+              default=None, help="defaults to the input names")
+def multiply_cmd(op_name, input_names, multiplier_name, output_names):
+    in_names = [n.strip() for n in input_names.split(",") if n.strip()]
+
     @operator
     def stage(task):
-        a, b = (task[n.strip()] for n in input_names.split(","))
-        task[output_chunk_name] = a * b
+        if multiplier_name is not None:
+            outs = (
+                [n.strip() for n in output_names.split(",")]
+                if output_names else in_names
+            )
+            if len(outs) != len(in_names):
+                raise click.UsageError(
+                    "input/output name counts must match"
+                )
+            for in_name, out_name in zip(in_names, outs):
+                task[out_name] = task[in_name] * task[multiplier_name]
+        else:
+            if len(in_names) != 2:
+                raise click.UsageError(
+                    "without --multiplier-name, give exactly two "
+                    "--input-names to multiply together"
+                )
+            outs = (
+                [n.strip() for n in output_names.split(",") if n.strip()]
+                if output_names else [DEFAULT_CHUNK_NAME]
+            )
+            if len(outs) != 1:
+                raise click.UsageError(
+                    "two-input multiply writes one output name"
+                )
+            task[outs[0]] = task[in_names[0]] * task[in_names[1]]
         return task
 
     return stage(_name=op_name)
@@ -1604,13 +1888,17 @@ def plugin_cmd(name, input_names, output_names, args):
 @main.command("save-pngs")
 @name_option("save-pngs")
 @click.option("--output-path", "-o", type=str, required=True)
+@click.option("--dtype", type=str, default=None, help="convert before export")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
-def save_pngs_cmd(op_name, output_path, input_chunk_name):
+def save_pngs_cmd(op_name, output_path, dtype, input_chunk_name):
     from chunkflow_tpu.volume.io_png import save_pngs
 
     @operator
     def stage(task):
-        save_pngs(task[input_chunk_name], output_path)
+        chunk = task[input_chunk_name]
+        if dtype is not None:
+            chunk = chunk.astype(np.dtype(dtype))
+        save_pngs(chunk, output_path)
         return task
 
     return stage(_name=op_name)
@@ -1619,22 +1907,38 @@ def save_pngs_cmd(op_name, output_path, input_chunk_name):
 @main.command("load-png")
 @name_option("load-png")
 @click.option("--path", "-p", type=str, required=True, help="directory of z-section pngs")
-@cartesian_option("--voxel-offset", default=(0, 0, 0))
+@cartesian_option("--voxel-offset", "-t", default=(0, 0, 0))
+@cartesian_option("--voxel-size", "-x", default=None)
+@cartesian_option("--cutout-offset", "-c", default=(0, 0, 0),
+                  help="with --chunk-size: explicit cutout window start")
+@cartesian_option("--chunk-size", "-s", default=None,
+                  help="explicit cutout window size (overrides task bbox)")
+@click.option("--digit-num", "-d", type=int, default=None,
+              help="accepted for reference compatibility (section index "
+                   "digits are parsed from the filenames)")
 @click.option("--dtype", type=str, default=None)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
-def load_png_cmd(op_name, path, voxel_offset, dtype, output_chunk_name):
+def load_png_cmd(op_name, path, voxel_offset, voxel_size, cutout_offset,
+                 chunk_size, digit_num, dtype, output_chunk_name):
     from chunkflow_tpu.volume.io_png import load_pngs
 
     @operator
     def stage(task):
         import numpy as _np
 
-        task[output_chunk_name] = load_pngs(
+        if chunk_size is not None:
+            bbox = BoundingBox.from_delta(cutout_offset, chunk_size)
+        else:
+            bbox = task.get("bbox")
+        chunk = load_pngs(
             path,
-            bbox=task.get("bbox"),
+            bbox=bbox,
             voxel_offset=voxel_offset,
             dtype=_np.dtype(dtype) if dtype else None,
         )
+        if voxel_size is not None:
+            chunk = chunk.with_voxel_size(voxel_size)
+        task[output_chunk_name] = chunk
         return task
 
     return stage(_name=op_name)
@@ -1647,11 +1951,22 @@ def load_png_cmd(op_name, path, voxel_offset, dtype, output_chunk_name):
 @click.option("--ids", type=str, default=None, help="comma-separated object ids (default: all)")
 @click.option("--skip-ids", type=str, default=None)
 @click.option("--manifest/--no-manifest", default=False)
-@click.option("--simplification-error", type=float, default=0.0,
+@click.option("--simplification-error", "--max-simplification-error",
+              type=float, default=0.0,
               help="max geometric error in nm for vertex-clustering simplification (0 = off)")
+@click.option("--simplification-factor", type=int, default=None,
+              help="accepted for reference compatibility; the error bound "
+                   "above drives vertex-clustering instead of a target "
+                   "face-count factor")
+@click.option("--mip", type=int, default=None,
+              help="accepted for reference compatibility (chunks carry "
+                   "their own voxel size)")
+@cartesian_option("--voxel-size", default=None,
+                  help="override the chunk's voxel size (nm) for meshing")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 def mesh_cmd(op_name, output_path, output_format, ids, skip_ids, manifest,
-             simplification_error, input_chunk_name):
+             simplification_error, simplification_factor, mip, voxel_size,
+             input_chunk_name):
     """Mesh every object of a segmentation chunk (surface nets)."""
     from chunkflow_tpu.flow.mesh import MeshOperator
 
@@ -1666,7 +1981,10 @@ def mesh_cmd(op_name, output_path, output_format, ids, skip_ids, manifest,
 
     @operator
     def stage(task):
-        count = op(task[input_chunk_name])
+        chunk = task[input_chunk_name]
+        if voxel_size is not None:
+            chunk = chunk.with_voxel_size(voxel_size)
+        count = op(chunk)
         if state.verbose:
             print(f"meshed {count} objects")
         return task
@@ -1675,7 +1993,7 @@ def mesh_cmd(op_name, output_path, output_format, ids, skip_ids, manifest,
 
 
 @main.command("mesh-manifest")
-@click.option("--mesh-dir", "-d", type=str, required=True)
+@click.option("--mesh-dir", "--volume-path", "-d", "-v", type=str, required=True)
 def mesh_manifest_cmd(mesh_dir):
     """Aggregate per-chunk mesh fragments into object manifests."""
     from chunkflow_tpu.flow.mesh import write_manifests
@@ -1692,16 +2010,16 @@ def mesh_manifest_cmd(mesh_dir):
 
 @main.command("download-mesh")
 @name_option("download-mesh")
-@click.option("--mesh-dir", "-v", type=str, required=True,
+@click.option("--mesh-dir", "--volume-path", "-v", type=str, required=True,
               help="directory holding mesh fragments + manifests")
 @click.option("--ids", "-i", type=str, default=None,
               help="comma-separated object ids, or a text file of them")
-@click.option("--input-chunk-name", type=str, default=None,
+@click.option("--input-chunk-name", "--input", type=str, default=None,
               help="rank objects by voxel count from this segmentation chunk")
 @click.option("--start-rank", "-s", type=int, default=0)
 @click.option("--stop-rank", "-p", type=int, default=None)
 @click.option("--out-pre", "-o", type=str, default="./")
-@click.option("--output-format", "-f",
+@click.option("--output-format", "--out-format", "-f",
               type=click.Choice(["ply", "obj"]), default="ply")
 def download_mesh_cmd(op_name, mesh_dir, ids, input_chunk_name, start_rank, stop_rank,
                       out_pre, output_format):
@@ -1751,7 +2069,7 @@ def download_mesh_cmd(op_name, mesh_dir, ids, input_chunk_name, start_rank, stop
 
 
 @main.command("aggregate-skeleton-fragments")
-@click.option("--fragments-path", "-f", type=str, required=True)
+@click.option("--fragments-path", "--input-name", "-f", type=str, required=True)
 @click.option("--output-path", "-o", type=str, default=None)
 def aggregate_skeleton_fragments_cmd(fragments_path, output_path):
     """Merge per-chunk skeleton fragments into whole skeletons
@@ -1835,7 +2153,7 @@ def view_cmd(op_name, image_chunk_name, segmentation_chunk_name, screenshot):
 
 @main.command("neuroglancer")
 @name_option("neuroglancer")
-@click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME,
+@click.option("--chunk-names", "--inputs", "-c", type=str, default=DEFAULT_CHUNK_NAME,
               help="comma-separated chunk names to serve as layers")
 @click.option("--port", "-p", type=int, default=0)
 @click.option("--voxel-size", type=int, nargs=3, default=None)
@@ -1870,8 +2188,9 @@ def neuroglancer_cmd(op_name, chunk_names, port, voxel_size):
 
 @main.command("napari")
 @name_option("napari")
-@click.option("--chunk-names", "-c", type=str, default=DEFAULT_CHUNK_NAME)
-def napari_cmd(op_name, chunk_names):
+@click.option("--chunk-names", "--inputs", "-c", type=str, default=DEFAULT_CHUNK_NAME)
+@cartesian_option("--voxel-size", default=None, help="accepted for reference compatibility (chunks carry their own)")
+def napari_cmd(op_name, chunk_names, voxel_size):
     """Open chunks in napari (requires the napari package)."""
 
     @operator
@@ -1903,7 +2222,12 @@ def napari_cmd(op_name, chunk_names):
 @name_option("evaluate-segmentation")
 @click.option("--segmentation-chunk-name", "-s", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--groundtruth-chunk-name", "-g", type=str, required=True)
-def evaluate_segmentation_cmd(op_name, segmentation_chunk_name, groundtruth_chunk_name):
+@click.option("--output", "-o", type=str, default=None,
+              help="append per-task scores to this JSON-lines file")
+def evaluate_segmentation_cmd(op_name, segmentation_chunk_name,
+                              groundtruth_chunk_name, output):
+    import json
+
     @operator
     def stage(task):
         seg = task[segmentation_chunk_name]
@@ -1912,6 +2236,12 @@ def evaluate_segmentation_cmd(op_name, segmentation_chunk_name, groundtruth_chun
         scores = seg.evaluate(task[groundtruth_chunk_name])
         print("segmentation evaluation:", scores)
         task["evaluation"] = scores
+        if output:
+            record = dict(scores)
+            if task.get("bbox") is not None:
+                record["bbox"] = task["bbox"].string
+            with open(output, "a") as f:
+                f.write(json.dumps(record) + "\n")
         return task
 
     return stage(_name=op_name)

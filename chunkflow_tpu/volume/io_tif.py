@@ -9,14 +9,23 @@ import numpy as np
 from PIL import Image as PILImage
 
 
-def write_tif(chunk, path: str) -> str:
+_COMPRESSION = {
+    None: None, "raw": None, "none": None,
+    "zlib": "tiff_deflate", "deflate": "tiff_deflate",
+    "lzw": "tiff_lzw", "packbits": "packbits",
+}
+
+
+def write_tif(chunk, path: str, compression: str = "zlib") -> str:
     arr = np.asarray(chunk.array)
     if arr.ndim == 4:
         if arr.shape[0] != 1:
             raise ValueError("TIFF export supports single-channel chunks only")
         arr = arr[0]
     pages = [PILImage.fromarray(section) for section in arr]
-    pages[0].save(path, save_all=True, append_images=pages[1:])
+    comp = _COMPRESSION.get(compression, compression)
+    kwargs = {"compression": comp} if comp else {}
+    pages[0].save(path, save_all=True, append_images=pages[1:], **kwargs)
     return path
 
 
