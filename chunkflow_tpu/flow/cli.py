@@ -635,32 +635,55 @@ def save_tif_cmd(op_name, file_name, dtype, compression, input_chunk_name):
 @main.command("create-info")
 @name_option("create-info")
 @click.option("--volume-path", "-v", type=str, required=True)
-@cartesian_option("--volume-size", "-s", required=True)
+@cartesian_option("--volume-size", "-s", default=None)
 @cartesian_option("--voxel-size", default=(1, 1, 1))
 @cartesian_option("--voxel-offset", default=(0, 0, 0))
 @click.option("--num-channels", "--channel-num", "-c", type=int, default=1)
 @click.option("--dtype", "--data-type", type=str, default="uint8")
 @click.option("--encoding", "-e", type=str, default="raw",
               help="block encoding written to the info file")
+@click.option("--input-chunk-name", "-i", type=str, default=None,
+              help="derive size/offset/dtype/voxel-size defaults from this "
+                   "chunk in the task (reference flow.py:459-519)")
 @click.option("--layer-type", type=click.Choice(["image", "segmentation"]), default="image")
 @cartesian_option("--block-size", default=(64, 64, 64))
 @click.option("--max-mip", type=int, default=0)
 @cartesian_option("--factor", default=(1, 2, 2))
 def create_info_cmd(op_name, volume_path, volume_size, voxel_size, voxel_offset,
                     num_channels, dtype, encoding, layer_type, block_size,
-                    max_mip, factor):
+                    max_mip, factor, input_chunk_name):
     """Create a precomputed volume info file (with mip pyramid)."""
     from chunkflow_tpu.volume.precomputed import PrecomputedVolume
 
     @operator
     def stage(task):
+        size, vsize, voffset, dt, nchan = (
+            volume_size, voxel_size, voxel_offset, dtype, num_channels
+        )
+        if input_chunk_name is not None:
+            # the chunk supplies DEFAULTS; explicit options always win
+            chunk = task[input_chunk_name]
+            if size is None:
+                size = tuple(chunk.shape[-3:])
+            if tuple(voffset) == (0, 0, 0):
+                voffset = tuple(chunk.voxel_offset)
+            if tuple(vsize) == (1, 1, 1):
+                vsize = tuple(chunk.voxel_size)
+            if dt == "uint8":
+                dt = str(np.dtype(chunk.dtype))
+            if nchan == 1:
+                nchan = chunk.nchannels
+        if size is None:
+            raise click.UsageError(
+                "create-info needs --volume-size or --input-chunk-name"
+            )
         PrecomputedVolume.create(
             volume_path,
-            volume_size=volume_size,
-            voxel_size=voxel_size,
-            voxel_offset=voxel_offset,
-            num_channels=num_channels,
-            dtype=dtype,
+            volume_size=size,
+            voxel_size=vsize,
+            voxel_offset=voffset,
+            num_channels=nchan,
+            dtype=dt,
             layer_type=layer_type,
             encoding=encoding,
             block_size=block_size,
@@ -901,14 +924,30 @@ def log_summary_cmd(log_dir, output_size):
 # ---------------------------------------------------------------------------
 @main.command("load-synapses")
 @name_option("load-synapses")
-@click.option("--file-name", "--file-path", "-f", type=str, required=True, help=".json or .h5")
+@click.option("--file-name", "--file-path", "-f", type=str, required=True,
+              help=".json/.h5 file, or a directory with --suffix")
+@click.option("--suffix", "-s", type=str, default=".h5",
+              help="with a directory --file-path: load <dir>/<bbox><suffix>")
+@cartesian_option("--resolution", default=None,
+                  help="override the synapses' voxel size (nm)")
 @click.option("--output-name", "-o", type=str, default="synapses")
-def load_synapses_cmd(op_name, file_name, output_name):
+def load_synapses_cmd(op_name, file_name, suffix, resolution, output_name):
+    import os
+
     from chunkflow_tpu.annotations.synapses import Synapses
 
     @operator
     def stage(task):
-        synapses = Synapses.from_file(file_name)
+        path = file_name
+        if os.path.isdir(path):
+            if task.get("bbox") is None:
+                raise click.UsageError(
+                    "directory --file-path needs a task bbox"
+                )
+            path = os.path.join(path, f"{task['bbox'].string}{suffix}")
+        synapses = Synapses.from_file(path)
+        if resolution is not None:
+            synapses.resolution = to_cartesian(resolution)
         if task.get("bbox") is not None:
             synapses = synapses.filter_by_bbox(task["bbox"])
         task[output_name] = synapses
@@ -954,13 +993,22 @@ def save_points_cmd(op_name, file_name, input_name):
 @main.command("load-skeleton")
 @name_option("load-skeleton")
 @click.option("--file-name", "--path", "-f", type=str, required=True, help=".swc file")
+@cartesian_option("--voxel-offset", "--offset", default=None,
+                  help="shift node coordinates by this voxel offset")
+@cartesian_option("--voxel-size", default=None,
+                  help="scale voxel-offset shifts into nm (default 1nm)")
 @click.option("--output-name", "-o", type=str, default="skeleton")
-def load_skeleton_cmd(op_name, file_name, output_name):
+def load_skeleton_cmd(op_name, file_name, voxel_offset, voxel_size,
+                      output_name):
     from chunkflow_tpu.annotations.skeleton import Skeleton
 
     @operator
     def stage(task):
-        task[output_name] = Skeleton.from_swc(file_name)
+        skel = Skeleton.from_swc(file_name)
+        if voxel_offset is not None:
+            vs = np.asarray(voxel_size if voxel_size is not None else (1, 1, 1))
+            skel.nodes += np.asarray(voxel_offset) * vs
+        task[output_name] = skel
         return task
 
     return stage(_name=op_name)
@@ -974,7 +1022,23 @@ def load_skeleton_cmd(op_name, file_name, output_name):
 def save_swc_cmd(op_name, file_name, input_name):
     @operator
     def stage(task):
-        task[input_name].to_swc(file_name)
+        value = task[input_name]
+        if isinstance(value, dict):
+            # skeletonize output: {obj_id: Skeleton} -> one file per id
+            if file_name.endswith(".swc") and len(value) > 1:
+                raise click.UsageError(
+                    "multiple skeletons need a prefix (non-.swc "
+                    "--output-prefix), not a single .swc path"
+                )
+            for obj_id, skel in value.items():
+                path = (
+                    file_name if file_name.endswith(".swc")
+                    else f"{file_name}{obj_id}.swc"
+                )
+                skel.to_swc(path)
+        else:
+            path = file_name if file_name.endswith(".swc") else f"{file_name}.swc"
+            value.to_swc(path)
         return task
 
     return stage(_name=op_name)
@@ -1214,8 +1278,6 @@ def cleanup_cmd(op_name, directory, mode, suffix):
 def skip_all_zero_cmd(op_name, input_chunk_name, prefix, suffix, adjust_size,
                       chunk_bbox):
     """Drop the task if the chunk is entirely zero."""
-    import os
-    from pathlib import Path
 
     @operator
     def stage(task):
@@ -1223,11 +1285,12 @@ def skip_all_zero_cmd(op_name, input_chunk_name, prefix, suffix, adjust_size,
             if prefix is not None:
                 bbox = (
                     task[input_chunk_name].bbox if chunk_bbox
-                    else task["bbox"]
+                    else task.get("bbox")
                 )
-                if adjust_size is not None:
-                    bbox = bbox.adjust(adjust_size)
-                _touch_marker(prefix, bbox, suffix)
+                if bbox is not None:
+                    if adjust_size is not None:
+                        bbox = bbox.adjust(adjust_size)
+                    _touch_marker(prefix, bbox, suffix)
             return None
         return task
 
@@ -1242,9 +1305,6 @@ def skip_all_zero_cmd(op_name, input_chunk_name, prefix, suffix, adjust_size,
               help="touch <prefix><bbox><suffix> as a marker when skipping")
 @click.option("--suffix", "-s", type=str, default="")
 def skip_none_cmd(op_name, input_chunk_name, prefix, suffix):
-    import os
-    from pathlib import Path
-
     @operator
     def stage(task):
         if task.get(input_chunk_name) is None:
@@ -1710,34 +1770,31 @@ def mask_cmd(op_name, volume_path, mip, inverse, fill_missing, input_chunk_name,
               default=None, help="defaults to the input names")
 def multiply_cmd(op_name, input_names, multiplier_name, output_names):
     in_names = [n.strip() for n in input_names.split(",") if n.strip()]
+    outs = (
+        [n.strip() for n in output_names.split(",") if n.strip()]
+        if output_names else None
+    )
+    # fail at pipeline assembly, before any task has done real work
+    if multiplier_name is not None:
+        outs = outs if outs is not None else in_names
+        if len(outs) != len(in_names):
+            raise click.UsageError("input/output name counts must match")
+    else:
+        if len(in_names) != 2:
+            raise click.UsageError(
+                "without --multiplier-name, give exactly two "
+                "--input-names to multiply together"
+            )
+        outs = outs if outs is not None else [DEFAULT_CHUNK_NAME]
+        if len(outs) != 1:
+            raise click.UsageError("two-input multiply writes one output name")
 
     @operator
     def stage(task):
         if multiplier_name is not None:
-            outs = (
-                [n.strip() for n in output_names.split(",")]
-                if output_names else in_names
-            )
-            if len(outs) != len(in_names):
-                raise click.UsageError(
-                    "input/output name counts must match"
-                )
             for in_name, out_name in zip(in_names, outs):
                 task[out_name] = task[in_name] * task[multiplier_name]
         else:
-            if len(in_names) != 2:
-                raise click.UsageError(
-                    "without --multiplier-name, give exactly two "
-                    "--input-names to multiply together"
-                )
-            outs = (
-                [n.strip() for n in output_names.split(",") if n.strip()]
-                if output_names else [DEFAULT_CHUNK_NAME]
-            )
-            if len(outs) != 1:
-                raise click.UsageError(
-                    "two-input multiply writes one output name"
-                )
             task[outs[0]] = task[in_names[0]] * task[in_names[1]]
         return task
 
@@ -1994,13 +2051,20 @@ def mesh_cmd(op_name, output_path, output_format, ids, skip_ids, manifest,
 
 @main.command("mesh-manifest")
 @click.option("--mesh-dir", "--volume-path", "-d", "-v", type=str, required=True)
-def mesh_manifest_cmd(mesh_dir):
+@click.option("--prefix", "-p", type=str, default=None,
+              help="only aggregate object ids starting with this prefix "
+                   "(reference mesh_manifest.py prefix sharding: run one "
+                   "job per prefix to parallelize)")
+@click.option("--digits", type=int, default=None,
+              help="accepted for reference compatibility (number of "
+                   "prefix digits used when sharding manifest jobs)")
+def mesh_manifest_cmd(mesh_dir, prefix, digits):
     """Aggregate per-chunk mesh fragments into object manifests."""
     from chunkflow_tpu.flow.mesh import write_manifests
 
     @generator
     def stage(task):
-        count = write_manifests(mesh_dir)
+        count = write_manifests(mesh_dir, id_prefix=prefix)
         print(f"wrote {count} mesh manifests")
         return
         yield  # pragma: no cover
@@ -2070,15 +2134,18 @@ def download_mesh_cmd(op_name, mesh_dir, ids, input_chunk_name, start_rank, stop
 
 @main.command("aggregate-skeleton-fragments")
 @click.option("--fragments-path", "--input-name", "-f", type=str, required=True)
+@click.option("--prefix", "-p", type=str, default=None,
+              help="only aggregate fragment files starting with this id "
+                   "prefix (parallel sharding, as in the reference)")
 @click.option("--output-path", "-o", type=str, default=None)
-def aggregate_skeleton_fragments_cmd(fragments_path, output_path):
+def aggregate_skeleton_fragments_cmd(fragments_path, prefix, output_path):
     """Merge per-chunk skeleton fragments into whole skeletons
     (reference flow/flow.py:623-649)."""
     from chunkflow_tpu.plugins.aggregate_skeleton_fragments import execute
 
     @generator
     def stage(task):
-        execute(fragments_path, output_path)
+        execute(fragments_path, output_path, id_prefix=prefix)
         return
         yield  # pragma: no cover
 

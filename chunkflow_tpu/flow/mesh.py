@@ -194,17 +194,20 @@ class MeshOperator:
         return len(meshes)
 
 
-def write_manifests(mesh_dir: str) -> int:
+def write_manifests(mesh_dir: str, id_prefix: str = None) -> int:
     """Aggregate per-chunk fragments into ``{obj_id}:0`` manifests.
 
     Parity: reference flow/mesh_manifest.py — after all mesh tasks finish,
     list fragment files ``<id>:0:<bbox>`` and write one manifest per id
-    referencing all its fragments.
+    referencing all its fragments. ``id_prefix`` restricts to ids starting
+    with that string (reference prefix sharding: one job per prefix).
     """
     fragments: Dict[str, list] = {}
     for name in os.listdir(mesh_dir):
         parts = name.split(":")
         if len(parts) == 3 and parts[1] == "0":
+            if id_prefix and not parts[0].startswith(id_prefix):
+                continue
             fragments.setdefault(parts[0], []).append(name)
     for obj_id, frags in fragments.items():
         with open(os.path.join(mesh_dir, f"{obj_id}:0"), "w") as f:

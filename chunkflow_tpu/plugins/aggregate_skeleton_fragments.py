@@ -12,13 +12,15 @@ import numpy as np
 from chunkflow_tpu.annotations.skeleton import Skeleton
 
 
-def execute(fragment_dir: str, output_dir: str = None):
+def execute(fragment_dir: str, output_dir: str = None, id_prefix: str = None):
     output_dir = output_dir or fragment_dir
     by_id = {}
     for name in os.listdir(fragment_dir):
         if ":" not in name:
             continue
         obj_id = name.split(":")[0]
+        if id_prefix and not obj_id.startswith(id_prefix):
+            continue
         by_id.setdefault(obj_id, []).append(name)
 
     os.makedirs(output_dir, exist_ok=True)
