@@ -3,7 +3,13 @@ import numpy as np
 from scipy import ndimage
 
 
-def execute(chunk, size: int = 3, mode: str = "reflect"):
+def execute(chunk, size=3, mode: str = "reflect"):
     arr = np.asarray(chunk.array)
-    kernel = (1, size, size) if arr.ndim == 3 else (1, 1, size, size)
+    if isinstance(size, (tuple, list)):
+        kernel = tuple(size)
+        # pad (y,x) or (z,y,x) kernels on the left to the array rank
+        while len(kernel) < arr.ndim:
+            kernel = (1,) + kernel
+    else:
+        kernel = (1, size, size) if arr.ndim == 3 else (1, 1, size, size)
     return ndimage.median_filter(arr, size=kernel, mode=mode)

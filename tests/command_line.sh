@@ -1,0 +1,87 @@
+#!/bin/bash
+# Shell-level CLI smoke: composed pipelines exercised exactly as a user
+# types them (reference test strategy: tests/command_line.sh, ~20
+# pipelines; these are our own compositions over the same surface).
+# Run by tests/test_command_line.py inside a tempdir with JAX on CPU.
+set -euo pipefail
+
+CLI="python -m chunkflow_tpu.flow.cli"
+
+echo "=== 1. task grid round trip (volume-less forms) ==="
+$CLI generate-tasks -b 0-16_0-32_0-32 -c 8 16 16 --task-file tasks.txt
+test "$(wc -l < tasks.txt)" -eq 8
+$CLI generate-tasks -s 0 0 0 -z 16 32 32 -c 8 16 16 --bounded -f tasks.npy
+
+echo "=== 2. h5 round trip with offset + windowed reload ==="
+$CLI create-chunk -s 16 32 32 --pattern sin -t 4 8 8 save-h5 -f a.h5
+$CLI load-h5 -f a.h5 -t 8 8 8 -s 8 16 16 --set-bbox save-h5 -f a_win.h5
+$CLI load-h5 -f a_win.h5 save-tif -f a.tif
+$CLI load-tif -f a.tif -d float32 save-npy -f a.npy
+
+echo "=== 3. png stack round trip ==="
+$CLI create-chunk -s 6 16 16 --pattern random save-pngs -o pngs
+$CLI load-png -p pngs -x 40 4 4 save-h5 -f pngs.h5
+
+echo "=== 4. identity inference oracle through the shell ==="
+$CLI create-chunk -s 16 32 32 --pattern sin -o img \
+     inference -i img -o out -s 8 16 16 -v 2 8 8 -c 1 -f identity -b 2 \
+         --no-crop-output-margin \
+     multiply -i img,img -o sq \
+     save-h5 -i out -f out.h5
+python - <<'PY'
+import h5py, numpy as np
+out = np.asarray(h5py.File("out.h5")["main"])
+assert out.shape[-3:] == (16, 32, 32)
+PY
+
+echo "=== 5. plugin with args mini-language ==="
+$CLI create-chunk -s 8 16 16 --pattern random \
+     plugin -n median_filter -a "size=(1,3,3)" \
+     save-h5 -f filtered.h5
+
+echo "=== 6. skip logic + markers + cleanup ==="
+$CLI generate-tasks -b 0-8_0-16_0-16 -c 8 16 16 \
+     create-chunk -s 8 16 16 --pattern zero \
+     skip-all-zero -p done_ -s .marker
+test -f done_0-8_0-16_0-16.marker
+$CLI generate-tasks -b 0-8_0-16_0-16 -c 8 16 16 \
+     skip-task-by-file -p done_ -s .marker -m exist \
+     create-chunk -s 8 16 16 \
+     save-h5 --file-name-prefix should_not_exist_
+test ! -f should_not_exist_0-8_0-16_0-16.h5
+touch empty_stale.h5
+$CLI cleanup -d . -m empty --suffix .h5
+test ! -f empty_stale.h5
+
+echo "=== 7. segmentation: cc -> renumber -> evaluate -> mesh ==="
+$CLI create-chunk -s 8 24 24 --pattern sin -o img \
+     threshold -i img -o seg -t 0.5 \
+     connected-components -i seg -o cc \
+     evaluate-segmentation -s cc -g cc --output scores.jsonl \
+     mesh -i cc -o meshes --manifest
+test -s scores.jsonl
+test "$(ls meshes | wc -l)" -gt 0
+
+echo "=== 8. normalize + downsample + quantize ==="
+$CLI create-chunk -s 8 32 32 --dtype uint8 --pattern sin \
+     normalize-contrast -l 0.01 -u 0.01 --minval 1 --maxval 255 \
+     downsample --factor 1 2 2 \
+     save-h5 -f down.h5
+python - <<'PY'
+import h5py
+assert h5py.File("down.h5")["main"].shape[-2:] == (16, 16)
+PY
+
+echo "=== 9. setup-env dry run ==="
+$CLI --dry-run setup-env -l file://./planvol --volume-start 0 0 0 \
+     -s 64 256 256 -z 8 16 16 --output-patch-overlap 2 8 8 -r 1
+
+echo "=== 10. queue produce/consume round trip ==="
+$CLI generate-tasks -b 0-16_0-32_0-32 -c 8 16 16 -q file://queue
+$CLI fetch-task-from-queue -q file://queue --retry-times 1 \
+     create-chunk -s 8 16 16 --pattern sin \
+     save-h5 --file-name-prefix result_ \
+     delete-task-in-queue
+test "$(ls result_*.h5 | wc -l)" -eq 8
+
+echo "ALL COMMAND-LINE SMOKE TESTS PASSED"
