@@ -268,6 +268,83 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
     def bbox(self) -> BoundingBox:
         return BoundingBox(self.voxel_offset, self.voxel_stop)
 
+    # reference-API surface (chunk/base.py:517-760): drop-in spellings
+    @property
+    def bounding_box(self) -> BoundingBox:
+        return self.bbox
+
+    @property
+    def start(self) -> Cartesian:
+        return self.voxel_offset
+
+    @property
+    def stop(self) -> Cartesian:
+        return self.voxel_stop
+
+    @property
+    def size(self):
+        return self.array.size
+
+    @property
+    def ndoffset(self) -> tuple:
+        """Offset with the channel dim prepended for 4D chunks."""
+        if self.ndim == 4:
+            return (0,) + tuple(self.voxel_offset)
+        return tuple(self.voxel_offset)
+
+    @property
+    def slices(self) -> tuple:
+        """Global-coordinate slices of this chunk in the big volume."""
+        return tuple(
+            slice(o, o + s) for o, s in zip(self.ndoffset, self.shape)
+        )
+
+    @property
+    def properties(self) -> dict:
+        return {
+            "voxel_offset": self.voxel_offset,
+            "voxel_size": self.voxel_size,
+            "layer_type": self.layer_type,
+        }
+
+    @properties.setter
+    def properties(self, value: dict) -> None:
+        self.set_properties(value)
+
+    def set_properties(self, properties: dict) -> None:
+        # None values (e.g. JSON nulls) leave the attribute unchanged —
+        # nulling voxel_offset would defer a crash to bbox/slices
+        if properties.get("voxel_offset") is not None:
+            self.voxel_offset = to_cartesian(properties["voxel_offset"])
+        if properties.get("voxel_size") is not None:
+            self.voxel_size = to_cartesian(properties["voxel_size"])
+        if properties.get("layer_type") is not None:
+            self.layer_type = LayerType(properties["layer_type"])
+
+    def fill(self, x) -> None:
+        if _is_jax(self.array):
+            import jax.numpy as jnp
+
+            self.array = jnp.full_like(self.array, x)
+        else:
+            self.array.fill(x)
+
+    def where(self, mask) -> tuple:
+        """np.where in GLOBAL coordinates (reference chunk/base.py:739)."""
+        mask = np.asarray(mask)
+        if mask.shape != tuple(self.shape):
+            raise ValueError(
+                f"mask shape {mask.shape} != chunk shape {tuple(self.shape)}"
+            )
+        return tuple(
+            i + o for i, o in zip(np.where(mask), self.ndoffset)
+        )
+
+    def ascontiguousarray(self) -> "Chunk":
+        if not _is_jax(self.array):
+            self.array = np.ascontiguousarray(self.array)
+        return self
+
     def _rel_slices(self, bbox: BoundingBox) -> tuple:
         rel = bbox.translate(-self.voxel_offset)
         spatial = rel.slices

@@ -217,3 +217,31 @@ def test_renumber_base_id_no_wrap():
     out = seg.renumber(base_id=2**32 - 2)
     vals = set(np.unique(np.asarray(out.array)).tolist())
     assert vals == {0, 2**32 - 1, 2**32}
+
+
+def test_reference_api_surface():
+    """Drop-in reference spellings (reference chunk/base.py:517-760):
+    bounding_box/start/stop/size/ndoffset/slices/properties/fill/where."""
+    from chunkflow_tpu.chunk.base import Chunk
+
+    c = Chunk(np.zeros((2, 4, 6, 8), np.float32), voxel_offset=(1, 2, 3),
+              voxel_size=(40, 4, 4))
+    assert c.bounding_box == c.bbox
+    assert tuple(c.start) == (1, 2, 3) and tuple(c.stop) == (5, 8, 11)
+    assert c.size == 2 * 4 * 6 * 8
+    assert c.ndoffset == (0, 1, 2, 3)
+    assert c.slices == (slice(0, 2), slice(1, 5), slice(2, 8), slice(3, 11))
+    props = c.properties
+    assert tuple(props["voxel_size"]) == (40, 4, 4)
+    c2 = Chunk(np.zeros((4, 6, 8), np.uint8))
+    c2.properties = props  # reference setter spelling
+    assert tuple(c2.voxel_offset) == (1, 2, 3)
+    assert c2.layer_type == c.layer_type
+
+    c2.fill(7)
+    assert (np.asarray(c2.array) == 7).all()
+    mask = np.zeros((4, 6, 8), bool)
+    mask[0, 0, 0] = True
+    z, y, x = c2.where(mask)
+    assert (z[0], y[0], x[0]) == (1, 2, 3)
+    assert c2.ascontiguousarray() is c2
