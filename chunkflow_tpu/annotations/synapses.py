@@ -209,8 +209,159 @@ class Synapses:
             resolution=self.resolution,
         )
 
-    # ---- I/O -----------------------------------------------------------
-    def to_json(self, path: str) -> str:
+    # ---- reference-spelling compatibility surface ----------------------
+    # drop-in names from reference synapses.py:461-700 for user code that
+    # migrates verbatim; the mutating editors delegate to vectorized cores
+    @property
+    def pre_bounding_box(self) -> BoundingBox:
+        return self.pre_bbox
+
+    @property
+    def post_bounding_box(self) -> BoundingBox:
+        pos = self.post_positions
+        if pos.shape[0] == 0:
+            return self.pre_bbox
+        start = Cartesian(*pos.min(axis=0).tolist())
+        stop = Cartesian(*(pos.max(axis=0) + 1).tolist())
+        return BoundingBox(start, stop)
+
+    @property
+    def bounding_box(self) -> BoundingBox:
+        return self.pre_bounding_box.union(self.post_bounding_box)
+
+    @property
+    def post_coordinates(self) -> np.ndarray:
+        return self.post_positions
+
+    @property
+    def pre_with_physical_coordinate(self) -> np.ndarray:
+        return self.pre * self.resolution.vec
+
+    @property
+    def post_with_physical_coordinate(self) -> Optional[np.ndarray]:
+        if self.post is None:
+            return None
+        post = self.post.astype(np.float64)
+        post[:, 1:] = post[:, 1:] * self.resolution.vec
+        return post
+
+    @property
+    def pre_point_cloud(self):
+        from chunkflow_tpu.annotations.point_cloud import PointCloud
+
+        return PointCloud(self.pre, voxel_size=self.resolution)
+
+    @property
+    def post_point_cloud(self):
+        from chunkflow_tpu.annotations.point_cloud import PointCloud
+
+        return PointCloud(self.post_positions, voxel_size=self.resolution)
+
+    @property
+    def pre_index2post_indices(self) -> List[List[int]]:
+        if self.post is None:
+            return [[] for _ in range(self.pre_num)]
+        buckets: List[List[int]] = [[] for _ in range(self.pre_num)]
+        for post_idx, pre_idx in enumerate(self.post[:, 0].tolist()):
+            buckets[pre_idx].append(post_idx)
+        return buckets
+
+    @property
+    def post_synapse_num_list(self) -> List[int]:
+        if self.post is None:
+            return [0] * self.pre_num
+        counts = np.bincount(self.post[:, 0], minlength=self.pre_num)
+        return counts.tolist()
+
+    @property
+    def pre_indices_without_post(self) -> List[int]:
+        if self.post is None:
+            return list(range(self.pre_num))
+        has_post = np.zeros(self.pre_num, dtype=bool)
+        has_post[np.unique(self.post[:, 0])] = True
+        return np.nonzero(~has_post)[0].tolist()
+
+    def add_pre(self, pre: np.ndarray, confidence: float = 1.0) -> None:
+        pre = np.asarray(pre, dtype=np.int32).reshape(-1, 3)
+        self.pre = np.vstack([self.pre, pre])
+        if self.pre_confidence is not None:
+            self.pre_confidence = np.concatenate([
+                self.pre_confidence,
+                np.full(pre.shape[0], confidence, dtype=np.float32),
+            ])
+
+    def remove_pre(self, indices) -> None:
+        """Delete T-bars in place, dropping their posts and remapping the
+        surviving posts' pre indices (reference synapses.py:633-658)."""
+        indices = np.asarray(list(indices), dtype=np.int64)
+        keep = np.ones(self.pre_num, dtype=bool)
+        keep[indices] = False
+        new_index = np.full(self.pre_num, -1, dtype=np.int64)
+        new_index[keep] = np.arange(int(keep.sum()))
+        self.pre = self.pre[keep]
+        if self.pre_confidence is not None:
+            self.pre_confidence = self.pre_confidence[keep]
+        if self.post is not None:
+            post_keep = keep[self.post[:, 0]]
+            self.post = self.post[post_keep].copy()
+            self.post[:, 0] = new_index[self.post[:, 0]]
+            if self.post_confidence is not None:
+                self.post_confidence = self.post_confidence[post_keep]
+
+    def remove_pre_duplicates(self) -> None:
+        """Drop T-bars at identical coordinates (keep first occurrence);
+        posts of a dropped duplicate re-attach to the surviving T-bar."""
+        _, first, inverse = np.unique(
+            self.pre, axis=0, return_index=True, return_inverse=True
+        )
+        keep_set = set(first.tolist())
+        dupes = [i for i in range(self.pre_num) if i not in keep_set]
+        if not dupes:
+            return
+        if self.post is not None:
+            # route each post to the first occurrence of its T-bar coords
+            canonical = first[inverse.reshape(-1)]
+            self.post = self.post.copy()
+            self.post[:, 0] = canonical[self.post[:, 0]]
+        self.remove_pre(dupes)
+
+    def remove_synapses_without_post(self) -> None:
+        if self.post is None:
+            # match remove_pre_without_post: pre-only sets are a no-op,
+            # not a wipe
+            return
+        self.remove_pre(self.pre_indices_without_post)
+
+    def remove_synapses_outside_bounding_box(self, bbox: BoundingBox) -> None:
+        outside = ~np.all(
+            (self.pre >= np.asarray(bbox.start))
+            & (self.pre < np.asarray(bbox.stop)),
+            axis=1,
+        )
+        self.remove_pre(np.nonzero(outside)[0])
+
+    def transpose_axis(self) -> None:
+        """Flip zyx <-> xyz in place."""
+        self.pre = np.ascontiguousarray(self.pre[:, ::-1])
+        self.resolution = Cartesian(*reversed(tuple(self.resolution)))
+        if self.post is not None:
+            self.post = self.post.copy()
+            self.post[:, 1:] = self.post[:, 1:][:, ::-1]
+
+    def user_id(self, user: str) -> Optional[int]:
+        if self.users is None:
+            return None
+        for idx, item in enumerate(self.users):
+            if user == item:
+                return idx
+        return None
+
+    def find_redundent_post(self, distance_threshold: float) -> np.ndarray:
+        """Reference spelling of find_redundant_post."""
+        return self.find_redundant_post(distance_threshold)
+
+    @property
+    def json_dict(self) -> dict:
         data = {
             "resolution": list(self.resolution),
             "pre": self.pre.tolist(),
@@ -223,19 +374,15 @@ class Synapses:
             data["post_confidence"] = self.post_confidence.tolist()
         if self.users is not None:
             data["users"] = self.users
-        with open(path, "w") as f:
-            json.dump(data, f)
-        return path
+        return data
 
     @classmethod
-    def from_json(cls, path: str) -> "Synapses":
-        with open(path) as f:
-            data = json.load(f)
+    def from_dict(cls, data: dict) -> "Synapses":
         return cls(
             np.asarray(data["pre"], dtype=np.int32),
             post=(
                 np.asarray(data["post"], dtype=np.int32)
-                if "post" in data
+                if data.get("post") is not None
                 else None
             ),
             pre_confidence=data.get("pre_confidence"),
@@ -243,6 +390,17 @@ class Synapses:
             resolution=tuple(data.get("resolution", (1, 1, 1))),
             users=data.get("users"),
         )
+
+    # ---- I/O -----------------------------------------------------------
+    def to_json(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.json_dict, f)
+        return path
+
+    @classmethod
+    def from_json(cls, path: str) -> "Synapses":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
 
     def to_h5(self, path: str) -> str:
         import h5py

@@ -142,7 +142,11 @@ class BoundingBox:
             p < e for p, e in zip(point, self.stop)
         )
 
-    def contains(self, other: "BoundingBox") -> bool:
+    def contains(self, other) -> bool:
+        """Box containment for a BoundingBox, point containment otherwise
+        (the reference calls contains() with bare zyx points)."""
+        if not isinstance(other, BoundingBox):
+            return self.contains_point(other)
         return self.start <= other.start and other.stop <= self.stop
 
     def clamp(self, outer: "BoundingBox") -> "BoundingBox":

@@ -309,3 +309,69 @@ def test_skeleton_precomputed_radii_roundtrip():
     skel = Skeleton(nodes, [-1, 0, 1], radii=[3.0, 2.0, 1.0])
     back = Skeleton.from_precomputed_bytes(skel.to_precomputed_bytes())
     np.testing.assert_allclose(back.radii, [3.0, 2.0, 1.0])
+
+
+def test_synapses_reference_api_surface():
+    """Reference drop-in spellings (reference synapses.py:461-700):
+    bounding boxes, physical coordinates, point clouds, per-pre post
+    buckets, in-place editors, transpose, json dict round trip."""
+    import numpy as np
+
+    from chunkflow_tpu.annotations.synapses import Synapses
+
+    pre = np.array([[1, 2, 3], [4, 5, 6], [7, 8, 9]], np.int32)
+    post = np.array([[0, 1, 2, 4], [0, 1, 3, 3], [2, 7, 9, 9]], np.int32)
+    s = Synapses(pre, post=post, resolution=(40, 4, 4))
+
+    assert s.pre_bounding_box == s.pre_bbox
+    assert s.bounding_box.contains((7, 9, 9))
+    assert np.array_equal(s.post_coordinates, post[:, 1:])
+    assert np.allclose(s.pre_with_physical_coordinate[0], [40, 8, 12])
+    assert np.allclose(s.post_with_physical_coordinate[0, 1:], [40, 8, 16])
+    assert s.pre_point_cloud.points.shape == (3, 3)
+    assert s.post_point_cloud.points.shape == (3, 3)
+    assert s.pre_index2post_indices == [[0, 1], [], [2]]
+    assert s.post_synapse_num_list == [2, 0, 1]
+    assert s.pre_indices_without_post == [1]
+
+    # json dict round trip
+    s2 = Synapses.from_dict(s.json_dict)
+    assert s2 == s
+
+    # in-place editing: remove pre 0 -> posts remap
+    s3 = Synapses.from_dict(s.json_dict)
+    s3.remove_pre([0])
+    assert s3.pre_num == 2 and s3.post_num == 1
+    assert s3.post[0, 0] == 1  # old pre 2 -> new pre 1
+
+    s4 = Synapses.from_dict(s.json_dict)
+    s4.remove_synapses_without_post()
+    assert s4.pre_num == 2 and s4.post_num == 3
+
+    s5 = Synapses.from_dict(s.json_dict)
+    from chunkflow_tpu.core.bbox import BoundingBox
+
+    s5.remove_synapses_outside_bounding_box(BoundingBox((0, 0, 0), (5, 6, 7)))
+    assert s5.pre_num == 2
+
+    s6 = Synapses.from_dict(s.json_dict)
+    s6.add_pre(np.array([[1, 2, 3]], np.int32))
+    assert s6.pre_num == 4
+    s6.remove_pre_duplicates()
+    assert s6.pre_num == 3
+    assert s6.post_num == 3  # posts survive, re-attached to kept T-bars
+
+    # pre-only sets: remove_synapses_without_post is a no-op, not a wipe
+    s8 = Synapses(np.array([[1, 2, 3]], np.int32))
+    s8.remove_synapses_without_post()
+    assert s8.pre_num == 1
+
+    s7 = Synapses.from_dict(s.json_dict)
+    s7.transpose_axis()
+    assert tuple(s7.pre[0]) == (3, 2, 1)
+    assert tuple(s7.resolution) == (4, 4, 40)
+    assert tuple(s7.post[0, 1:]) == (4, 2, 1)
+
+    # reference typo spelling works; posts 0 and 1 of pre 0 are ~5.7nm
+    # apart -> exactly one redundant index (the later one)
+    assert s.find_redundent_post(10.0).tolist() == [1]
