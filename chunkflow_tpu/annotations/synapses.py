@@ -216,8 +216,8 @@ class Synapses:
     def pre_bounding_box(self) -> BoundingBox:
         return self.pre_bbox
 
-    @property
     def post_bounding_box(self) -> BoundingBox:
+        # plain method, matching the reference's calling convention (:536)
         pos = self.post_positions
         if pos.shape[0] == 0:
             return self.pre_bbox
@@ -227,7 +227,7 @@ class Synapses:
 
     @property
     def bounding_box(self) -> BoundingBox:
-        return self.pre_bounding_box.union(self.post_bounding_box)
+        return self.pre_bounding_box.union(self.post_bounding_box())
 
     @property
     def post_coordinates(self) -> np.ndarray:
@@ -241,8 +241,12 @@ class Synapses:
     def post_with_physical_coordinate(self) -> Optional[np.ndarray]:
         if self.post is None:
             return None
-        post = self.post.astype(np.float64)
-        post[:, 1:] = post[:, 1:] * self.resolution.vec
+        # multiply in the post dtype (reference behavior) so column 0
+        # stays an integer pre-index usable for fancy indexing
+        post = self.post.copy()
+        post[:, 1:] = post[:, 1:] * np.asarray(
+            self.resolution.vec, dtype=post.dtype
+        )
         return post
 
     @property
@@ -281,7 +285,7 @@ class Synapses:
         has_post[np.unique(self.post[:, 0])] = True
         return np.nonzero(~has_post)[0].tolist()
 
-    def add_pre(self, pre: np.ndarray, confidence: float = 1.0) -> None:
+    def add_pre(self, pre: np.ndarray, confidence: float = 1.0) -> "Synapses":
         pre = np.asarray(pre, dtype=np.int32).reshape(-1, 3)
         self.pre = np.vstack([self.pre, pre])
         if self.pre_confidence is not None:
@@ -289,6 +293,7 @@ class Synapses:
                 self.pre_confidence,
                 np.full(pre.shape[0], confidence, dtype=np.float32),
             ])
+        return self
 
     def remove_pre(self, indices) -> None:
         """Delete T-bars in place, dropping their posts and remapping the
@@ -356,9 +361,30 @@ class Synapses:
                 return idx
         return None
 
-    def find_redundent_post(self, distance_threshold: float) -> np.ndarray:
-        """Reference spelling of find_redundant_post."""
-        return self.find_redundant_post(distance_threshold)
+    def find_redundent_post(self, num_threshold: int = 15,
+                            distance_threshold: float = 50.0) -> set:
+        """Reference signature and semantics (synapses.py:686-736): posts
+        farther than distance_threshold VOXELS from their T-bar, plus the
+        worst posts beyond num_threshold per T-bar (by distance, or
+        distance/confidence when confidences exist). Returns a set of post
+        indices to remove. (find_redundant_post is this framework's
+        physical-distance near-duplicate finder — different question.)"""
+        if self.post is None or self.post_num == 0:
+            return set()
+        dv = np.linalg.norm(
+            (self.post[:, 1:] - self.pre[self.post[:, 0]]).astype(np.float64),
+            axis=1,
+        )
+        to_remove = set(np.nonzero(dv > distance_threshold)[0].tolist())
+        for post_indices in self.pre_index2post_indices:
+            if len(post_indices) > num_threshold:
+                idx = np.asarray(post_indices, dtype=np.int64)
+                costs = dv[idx]
+                if self.post_confidence is not None:
+                    costs = costs / self.post_confidence[idx]
+                order = np.argsort(costs)
+                to_remove |= set(idx[order[num_threshold:]].tolist())
+        return to_remove
 
     @property
     def json_dict(self) -> dict:

@@ -144,9 +144,12 @@ class BoundingBox:
 
     def contains(self, other) -> bool:
         """Box containment for a BoundingBox, point containment otherwise
-        (the reference calls contains() with bare zyx points)."""
+        (the reference calls contains() with bare zyx points, INCLUSIVE at
+        the stop corner — cartesian_coordinate.py:448-452 — unlike the
+        half-open contains_point)."""
         if not isinstance(other, BoundingBox):
-            return self.contains_point(other)
+            point = to_cartesian(other)
+            return self.start <= point and point <= self.stop
         return self.start <= other.start and other.stop <= self.stop
 
     def clamp(self, outer: "BoundingBox") -> "BoundingBox":
@@ -182,6 +185,105 @@ class BoundingBox:
             start = rel_start.ceildiv(block_size) * block_size
             stop = rel_stop // block_size * block_size
         return BoundingBox(start + offset, stop + offset)
+
+    # ---- reference-spelling compatibility surface ----------------------
+    @property
+    def minpt(self) -> Cartesian:
+        return self.start
+
+    @property
+    def maxpt(self) -> Cartesian:
+        return self.stop
+
+    @classmethod
+    def from_list(cls, lst) -> "BoundingBox":
+        """[z0, y0, x0, ..., z1, y1, x1] (reference :236-239)."""
+        return cls(
+            Cartesian.from_collection(lst[:3]),
+            Cartesian.from_collection(lst[-3:]),
+        )
+
+    @classmethod
+    def from_points(cls, points) -> "BoundingBox":
+        """Tight box around an [N, 3] point array (stop is exclusive)."""
+        points = np.asarray(points)
+        return cls(
+            Cartesian.from_collection(points.min(axis=0).astype(int)),
+            Cartesian.from_collection(points.max(axis=0).astype(int) + 1),
+        )
+
+    @property
+    def random_coordinate(self) -> Cartesian:
+        # property, matching the reference's attribute access (:300-301)
+        import random
+
+        return Cartesian(
+            *(random.randrange(s, e) for s, e in zip(self.start, self.stop))
+        )
+
+    def inverse_order(self) -> "BoundingBox":
+        """zyx <-> xyz flipped corners (plain method like reference :376)."""
+        return BoundingBox(self.start.inverse, self.stop.inverse)
+
+    def adjust_corner(self, corner_offset) -> "BoundingBox":
+        """Six-element (start_z, start_y, start_x, stop_z, stop_y, stop_x)
+        additive adjustment (reference :419-426)."""
+        if corner_offset is None or len(corner_offset) != 6:
+            raise ValueError("corner_offset must have 6 elements")
+        return BoundingBox(
+            self.start + Cartesian.from_collection(corner_offset[:3]),
+            self.stop + Cartesian.from_collection(corner_offset[3:]),
+        )
+
+    @property
+    def left_neighbors(self):
+        """The three same-sized boxes adjacent on the -z, -y, -x faces
+        (attribute access like the reference's cached_property :491)."""
+        size = self.shape
+        return tuple(
+            BoundingBox.from_delta(
+                self.start - Cartesian(*(size[i] if j == i else 0
+                                         for j in range(3))),
+                size,
+            )
+            for i in range(3)
+        )
+
+    def decompose_to_aligned_block_bounding_boxes(
+        self, block_size, bounded: bool = True
+    ) -> List["BoundingBox"]:
+        """Grid of full-size blocks anchored at start; with bounded=False
+        the grid extends to cover the stop corner (reference :316-331)."""
+        block_size = to_cartesian(block_size)
+        stops = (
+            self.stop if bounded
+            else self.stop + block_size - Cartesian(1, 1, 1)
+        )
+        boxes = []
+        for z in range(self.start.z, stops.z, block_size.z):
+            for y in range(self.start.y, stops.y, block_size.y):
+                for x in range(self.start.x, stops.x, block_size.x):
+                    boxes.append(
+                        BoundingBox.from_delta(Cartesian(z, y, x), block_size)
+                    )
+        return boxes
+
+    def decompose_to_unaligned_block_bounding_boxes(
+        self, block_size
+    ) -> List["BoundingBox"]:
+        """Like the aligned decomposition but trailing blocks are clipped
+        at this box's stop (reference :333-347)."""
+        block_size = to_cartesian(block_size)
+        boxes = []
+        for z in range(self.start.z, self.stop.z, block_size.z):
+            for y in range(self.start.y, self.stop.y, block_size.y):
+                for x in range(self.start.x, self.stop.x, block_size.x):
+                    start = Cartesian(z, y, x)
+                    stop = Cartesian.from_collection(
+                        np.minimum((start + block_size).vec, self.stop.vec)
+                    )
+                    boxes.append(BoundingBox(start, stop))
+        return boxes
 
     def decompose(self, block_size) -> List["BoundingBox"]:
         """Tile this box exactly into non-overlapping blocks."""
@@ -375,3 +477,11 @@ class PhysicalBoundingBox(BoundingBox):
         start = (self.start / factor).floor()
         stop = (self.stop / factor).ceil()
         return PhysicalBoundingBox(start, stop, voxel_size)
+
+    # reference spellings (cartesian_coordinate.py:709-724)
+    def to_other_voxel_size(self, voxel_size) -> "PhysicalBoundingBox":
+        return self.to_voxel_size(voxel_size)
+
+    @property
+    def voxel_bounding_box(self) -> BoundingBox:
+        return BoundingBox(self.start, self.stop)

@@ -130,6 +130,11 @@ class Cartesian(NamedTuple):
 
     # ---- conversions ---------------------------------------------------
     @property
+    def inverse(self) -> "Cartesian":
+        """Reversed order (zyx <-> xyz), reference spelling."""
+        return Cartesian(self.x, self.y, self.z)
+
+    @property
     def vec(self) -> np.ndarray:
         return np.asarray(self)
 

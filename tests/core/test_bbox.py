@@ -157,3 +157,47 @@ def test_physical_bbox_rescale():
     assert other.start == Cartesian(0, 0, 0)
     assert other.stop == Cartesian(8, 4, 4)
     assert pb.physical_stop == Cartesian(320, 32, 32)
+
+
+def test_reference_geometry_surface():
+    """Drop-in reference spellings (cartesian_coordinate.py:236-724)."""
+    import numpy as np
+
+    from chunkflow_tpu.core.bbox import BoundingBox, PhysicalBoundingBox
+    from chunkflow_tpu.core.cartesian import Cartesian
+
+    b = BoundingBox(Cartesian(0, 4, 8), Cartesian(8, 12, 24))
+    assert b.minpt == b.start and b.maxpt == b.stop
+    assert BoundingBox.from_list([0, 4, 8, 8, 12, 24]) == b
+    pts = np.array([[0, 4, 8], [7, 11, 23]])
+    assert BoundingBox.from_points(pts) == b
+    c = b.random_coordinate
+    assert b.contains(c)
+    assert b.inverse_order() == BoundingBox(Cartesian(8, 4, 0), Cartesian(24, 12, 8))
+    assert b.adjust_corner((1, 1, 1, -1, -1, -1)) == BoundingBox(
+        Cartesian(1, 5, 9), Cartesian(7, 11, 23)
+    )
+    nz, ny, nx = b.left_neighbors
+    assert nz == BoundingBox(Cartesian(-8, 4, 8), Cartesian(0, 12, 24))
+    assert nx.shape == b.shape
+
+    blocks = b.decompose_to_aligned_block_bounding_boxes((8, 8, 8))
+    assert len(blocks) == 1 * 1 * 2 and all(
+        tuple(bb.shape) == (8, 8, 8) for bb in blocks
+    )
+    # unbounded: grid extends past stop when not divisible (the
+    # reference formula ranges to stop+block-1 per axis, over-generating
+    # exactly like this)
+    b2 = BoundingBox(Cartesian(0, 0, 0), Cartesian(8, 8, 20))
+    over = b2.decompose_to_aligned_block_bounding_boxes((8, 8, 8), bounded=False)
+    assert len(over) == 2 * 2 * 4
+    assert max(bb.stop.x for bb in over) >= 20  # covers the stop corner
+    clipped = b2.decompose_to_unaligned_block_bounding_boxes((8, 8, 8))
+    assert clipped[-1].stop.x == 20  # trailing block clipped
+
+    p = PhysicalBoundingBox(Cartesian(0, 0, 0), Cartesian(8, 16, 16),
+                            voxel_size=(40, 4, 4))
+    assert p.to_other_voxel_size((40, 8, 8)).stop == Cartesian(8, 8, 8)
+    assert p.voxel_bounding_box == BoundingBox(Cartesian(0, 0, 0),
+                                               Cartesian(8, 16, 16))
+    assert Cartesian(1, 2, 3).inverse == Cartesian(3, 2, 1)

@@ -372,6 +372,10 @@ def test_synapses_reference_api_surface():
     assert tuple(s7.resolution) == (4, 4, 40)
     assert tuple(s7.post[0, 1:]) == (4, 2, 1)
 
-    # reference typo spelling works; posts 0 and 1 of pre 0 are ~5.7nm
-    # apart -> exactly one redundant index (the later one)
-    assert s.find_redundent_post(10.0).tolist() == [1]
+    # reference signature: posts farther than distance_threshold VOXELS
+    # from their pre are flagged (every post here is exactly 1 voxel from
+    # its T-bar)
+    assert s.find_redundent_post(distance_threshold=0.5) == {0, 1, 2}
+    assert s.find_redundent_post(distance_threshold=1.0) == set()
+    assert s.find_redundent_post(num_threshold=1,
+                                 distance_threshold=100.0) == {1}
