@@ -110,8 +110,10 @@ class Synapses:
         return np.linalg.norm(post_pos - pre_pos, axis=1)
 
     def find_redundant_post(self, distance_threshold: float) -> np.ndarray:
-        """Indices of posts closer than threshold to an earlier post of the
-        SAME T-bar (duplicate annotations; reference find_redundent_post)."""
+        """Indices of posts closer than the PHYSICAL threshold to an
+        earlier post of the same T-bar (near-duplicate annotations). For
+        the reference method of that (similar) name, use
+        ``find_redundent_post`` — different signature and semantics."""
         from scipy.spatial import KDTree
 
         if self.post is None or self.post_num == 0:
@@ -221,9 +223,7 @@ class Synapses:
         pos = self.post_positions
         if pos.shape[0] == 0:
             return self.pre_bbox
-        start = Cartesian(*pos.min(axis=0).tolist())
-        stop = Cartesian(*(pos.max(axis=0) + 1).tolist())
-        return BoundingBox(start, stop)
+        return BoundingBox.from_points(pos)
 
     @property
     def bounding_box(self) -> BoundingBox:

@@ -480,7 +480,16 @@ class PhysicalBoundingBox(BoundingBox):
 
     # reference spellings (cartesian_coordinate.py:709-724)
     def to_other_voxel_size(self, voxel_size) -> "PhysicalBoundingBox":
-        return self.to_voxel_size(voxel_size)
+        """Reference rounding: floor-divide BOTH corners when coarsening
+        (:712-724) — unlike to_voxel_size, which ceils the stop so the box
+        always covers the original extent."""
+        voxel_size = to_cartesian(voxel_size)
+        factor = voxel_size / self.voxel_size
+        return PhysicalBoundingBox(
+            (self.start / factor).floor(),
+            (self.stop / factor).floor(),
+            voxel_size,
+        )
 
     @property
     def voxel_bounding_box(self) -> BoundingBox:

@@ -290,6 +290,49 @@ class PrecomputedVolume:
         vol._info = info
         return vol
 
+    # ---- reference-spelling compatibility surface ----------------------
+    @property
+    def bounding_box(self) -> BoundingBox:
+        """Reference spelling of bounds() at the default mip."""
+        return self.bounds(0)
+
+    @property
+    def bbox(self) -> BoundingBox:
+        return self.bounding_box
+
+    @property
+    def start(self) -> Cartesian:
+        return self.voxel_offset(0)
+
+    @property
+    def stop(self) -> Cartesian:
+        return self.bounds(0).stop
+
+    @property
+    def shape(self) -> tuple:
+        # reference volume.py:137 includes the channel dim: (c, z, y, x)
+        return (self.num_channels,) + tuple(self.volume_size(0))
+
+    @property
+    def block_bounding_boxes(self):
+        """Non-overlapping storage-block boxes tiling the volume."""
+        return self.bounds(0).decompose_to_unaligned_block_bounding_boxes(
+            self.block_size(0)
+        )
+
+    @property
+    def physical_bounding_box(self):
+        from chunkflow_tpu.core.bbox import PhysicalBoundingBox
+
+        b = self.bounds(0)
+        return PhysicalBoundingBox(b.start, b.stop, self.voxel_size(0))
+
+    @classmethod
+    def from_numpy(cls, arr, vol_path: str, **kwargs) -> "PrecomputedVolume":
+        """Reference CloudVolume.from_numpy analog (zyx array in, volume
+        out)."""
+        return cls.from_chunk(Chunk(arr), vol_path, **kwargs)
+
     @classmethod
     def from_chunk(cls, chunk: Chunk, path: str, **kwargs) -> "PrecomputedVolume":
         """Create a volume sized/typed like ``chunk`` and write it (test

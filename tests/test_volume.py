@@ -100,3 +100,28 @@ def test_load_chunk_or_volume(tmp_path, vol):
     assert isinstance(loaded, Chunk)
     v = load_chunk_or_volume(vol.path)
     assert isinstance(v, PrecomputedVolume)
+
+
+def test_volume_reference_api_surface(tmp_path):
+    """Reference drop-in spellings (reference volume.py:74-121):
+    from_numpy, bounding_box/bbox/start/stop/shape, block boxes,
+    physical box."""
+    pytest.importorskip("tensorstore")
+    import numpy as np
+
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    arr = np.arange(8 * 16 * 16, dtype=np.uint32).reshape(8, 16, 16)
+    vol = PrecomputedVolume.from_numpy(
+        arr, str(tmp_path / "v"), block_size=(8, 8, 8)
+    )
+    # reference shape includes the channel dim (volume.py:137)
+    assert tuple(vol.shape) == (1, 8, 16, 16)
+    assert vol.bounding_box == vol.bbox
+    assert tuple(vol.start) == (0, 0, 0) and tuple(vol.stop) == (8, 16, 16)
+    blocks = vol.block_bounding_boxes
+    assert len(blocks) == 4
+    assert all(vol.bounding_box.contains(b) for b in blocks)
+    assert tuple(vol.physical_bounding_box.voxel_size) == tuple(vol.voxel_size(0))
+    back = np.asarray(vol.cutout(vol.bounding_box).array)
+    assert (back == arr).all()
