@@ -20,9 +20,23 @@ class PointCloud:
 
     @property
     def bbox(self) -> BoundingBox:
-        start = Cartesian(*self.points.min(axis=0).tolist())
-        stop = Cartesian(*(self.points.max(axis=0) + 1).tolist())
-        return BoundingBox(start, stop)
+        return BoundingBox.from_points(self.points)
+
+    # reference spellings (point_cloud.py:8-47)
+    @property
+    def bounding_box(self) -> BoundingBox:
+        return self.bbox
+
+    @property
+    def point_num(self) -> int:
+        return self.points.shape[0]
+
+    @classmethod
+    def from_swc(cls, path: str, voxel_size=(1, 1, 1)) -> "PointCloud":
+        from chunkflow_tpu.annotations.skeleton import Skeleton
+
+        skel = Skeleton.from_swc(path)
+        return cls(skel.nodes, voxel_size=voxel_size)
 
     @property
     def physical(self) -> np.ndarray:

@@ -7,6 +7,14 @@ from chunkflow_tpu.chunk.base import Chunk, LayerType
 
 
 class AffinityMap(Chunk):
+    @classmethod
+    def from_chunk(cls, chunk: Chunk) -> "AffinityMap":
+        return cls(
+            chunk.array,
+            voxel_offset=chunk.voxel_offset,
+            voxel_size=chunk.voxel_size,
+        )
+
     """3-channel float 4D chunk of zyx boundary affinities."""
 
     def __init__(self, array, **kwargs):

@@ -96,3 +96,18 @@ def print_summary(log_dir: str, output_size=None) -> None:
                 f"{voxels / mean_time / 1e6:.2f} Mvoxel/s "
                 f"({len(group)} tasks)"
             )
+
+
+# reference spellings (flow/log_summary.py:16,57)
+def load_log(log_dir: str):
+    """Reference name: returns the per-task records as a pandas frame."""
+    import pandas as pd
+
+    return pd.DataFrame(load_log_dir(log_dir))
+
+
+def print_log_statistics(df, output_size=None) -> None:
+    """Reference name: per-device mean/max/min/sum (+ Mvoxel/s when
+    output_size is given) from an already-loaded frame."""
+    records = df.to_dict("records")
+    print(summarize(records, output_size=output_size))
