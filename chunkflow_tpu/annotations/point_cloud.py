@@ -36,7 +36,10 @@ class PointCloud:
         from chunkflow_tpu.annotations.skeleton import Skeleton
 
         skel = Skeleton.from_swc(path)
-        return cls(skel.nodes, voxel_size=voxel_size)
+        # Skeleton nodes are physical nm; PointCloud points are voxel
+        # coordinates (physical = points * voxel_size)
+        vs = np.asarray(to_cartesian(voxel_size).vec, dtype=np.float64)
+        return cls(skel.nodes / vs, voxel_size=voxel_size)
 
     @property
     def physical(self) -> np.ndarray:

@@ -7,15 +7,13 @@ from chunkflow_tpu.chunk.base import Chunk, LayerType
 
 
 class AffinityMap(Chunk):
-    @classmethod
-    def from_chunk(cls, chunk: Chunk) -> "AffinityMap":
-        return cls(
-            chunk.array,
-            voxel_offset=chunk.voxel_offset,
-            voxel_size=chunk.voxel_size,
-        )
 
     """3-channel float 4D chunk of zyx boundary affinities."""
+
+    @classmethod
+    def from_chunk(cls, chunk: Chunk) -> "AffinityMap":
+        # Chunk.__init__ copies all metadata when given a Chunk
+        return cls(chunk)
 
     def __init__(self, array, **kwargs):
         kwargs.setdefault("layer_type", LayerType.AFFINITY_MAP)

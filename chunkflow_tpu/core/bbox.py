@@ -205,11 +205,14 @@ class BoundingBox:
 
     @classmethod
     def from_points(cls, points) -> "BoundingBox":
-        """Tight box around an [N, 3] point array (stop is exclusive)."""
+        """Tight integer box around an [N, 3] point array (stop is
+        exclusive); float points floor toward -inf so negatives stay
+        inside."""
         points = np.asarray(points)
+        lo = np.floor(points.min(axis=0)).astype(np.int64)
+        hi = np.floor(points.max(axis=0)).astype(np.int64) + 1
         return cls(
-            Cartesian.from_collection(points.min(axis=0).astype(int)),
-            Cartesian.from_collection(points.max(axis=0).astype(int) + 1),
+            Cartesian.from_collection(lo), Cartesian.from_collection(hi)
         )
 
     @property

@@ -109,5 +109,14 @@ def load_log(log_dir: str):
 def print_log_statistics(df, output_size=None) -> None:
     """Reference name: per-device mean/max/min/sum (+ Mvoxel/s when
     output_size is given) from an already-loaded frame."""
-    records = df.to_dict("records")
+    if len(df) == 0:
+        print("no log records")
+        return
+    # DataFrame round trips turn missing keys into NaN; drop them so
+    # summarize's .get() defaults apply to mixed-schema logs
+    records = [
+        {k: v for k, v in rec.items()
+         if not (isinstance(v, float) and v != v)}
+        for rec in df.to_dict("records")
+    ]
     print(summarize(records, output_size=output_size))
