@@ -1480,6 +1480,12 @@ def copy_var_cmd(op_name, from_name, to_name):
     help="multi-chip execution over all local devices: patch-parallel "
          "(psum merge) or spatially-sharded chunk (ring halo exchange)",
 )
+@cartesian_option(
+    "--shape-bucket", default=None,
+    help="pad chunk shapes up to multiples of this zyx quantum so ragged "
+         "edge chunks reuse one compiled program (trade-off: the net sees "
+         "zero padding past the true edge)",
+)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def inference_cmd(op_name, input_patch_size, output_patch_size,
@@ -1487,7 +1493,7 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                   num_output_channels, num_input_channels, framework,
                   model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
-                  model_variant, sharding, input_chunk_name,
+                  model_variant, sharding, shape_bucket, input_chunk_name,
                   output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
@@ -1526,6 +1532,7 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
         dtype=dtype,
         model_variant=model_variant,
         sharding=sharding,
+        shape_bucket=shape_bucket,
         dry_run=state.dry_run,
     )
 

@@ -59,6 +59,7 @@ class Inferencer:
         model_variant: str = "parity",
         engine=None,
         sharding: str = "none",
+        shape_bucket=None,
         dry_run: bool = False,
     ):
         self.input_patch_size = Cartesian.from_collection(input_patch_size)
@@ -80,6 +81,23 @@ class Inferencer:
         if sharding not in ("none", "patch", "spatial"):
             raise ValueError(f"unknown sharding mode {sharding!r}")
         self.sharding = sharding
+        # Optional shape bucketing (SURVEY §7 hard parts): pad every chunk
+        # up to multiples of this zyx quantum so ragged edge chunks reuse
+        # the same compiled program instead of recompiling per shape.
+        # Trade-off: the convnet sees zero padding past the true edge
+        # instead of the reference's edge-snapped real context, so
+        # predictions within one patch of a padded face can differ — hence
+        # opt-in.
+        self.shape_bucket = (
+            Cartesian.from_collection(shape_bucket)
+            if shape_bucket is not None and any(shape_bucket)
+            else None
+        )
+        if self.shape_bucket is not None and not self.shape_bucket.all_positive():
+            raise ValueError(
+                f"shape_bucket must be all-positive (or all-zero to "
+                f"disable), got {tuple(self.shape_bucket)}"
+            )
         self._mesh = None
         self._sharded_program = None
         self._spatial_programs = {}
@@ -109,13 +127,25 @@ class Inferencer:
         self._device_params = None
 
     # ------------------------------------------------------------------
+    def _bucketed_shape(self, zyx) -> Cartesian:
+        """Round a zyx shape up to the bucket quantum (and at least one
+        input patch)."""
+        return (
+            Cartesian.from_collection(zyx).ceildiv(self.shape_bucket)
+            * self.shape_bucket
+        ).maximum(self.input_patch_size)
+
     def patch_grid_shape(self, chunk_shape) -> Tuple[int, int, int]:
         """Patches per axis for a chunk shape (reference --patch-num
         contract: the caller may assert the grid it planned for). Derived
-        from the same enumerate_patches call the engine runs, so the
-        asserted grid can never drift from the executed one."""
+        from the same enumerate_patches call the engine runs — including
+        shape bucketing — so the asserted grid can never drift from the
+        executed one."""
+        shape = tuple(chunk_shape)[-3:]
+        if self.shape_bucket is not None:
+            shape = tuple(self._bucketed_shape(shape))
         grid = enumerate_patches(
-            tuple(chunk_shape)[-3:],
+            shape,
             self.input_patch_size,
             self.output_patch_size,
             self.output_patch_overlap,
@@ -319,8 +349,13 @@ class Inferencer:
                 out = out.crop_margin(self.crop_margin)
             return out
 
+        orig_zyx = tuple(chunk.shape[-3:])
+        run_zyx = orig_zyx
+        if self.shape_bucket is not None:
+            run_zyx = tuple(self._bucketed_shape(orig_zyx))
+
         grid = enumerate_patches(
-            chunk.shape,
+            run_zyx,
             self.input_patch_size,
             self.output_patch_size,
             self.output_patch_overlap,
@@ -337,6 +372,11 @@ class Inferencer:
             arr = jnp.asarray(arr, dtype=jnp.float32)
         if arr.ndim == 3:
             arr = arr[None]
+        if run_zyx != orig_zyx:
+            pad = [(0, 0)] + [
+                (0, r - s) for r, s in zip(run_zyx, orig_zyx)
+            ]
+            arr = jnp.pad(arr, pad)
 
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)
@@ -355,6 +395,10 @@ class Inferencer:
         else:
             result = self._run_sharded(arr, grid)
         result.block_until_ready()
+        if run_zyx != orig_zyx:
+            result = result[
+                :, : orig_zyx[0], : orig_zyx[1], : orig_zyx[2]
+            ]
 
         out = Chunk(
             result,

@@ -229,3 +229,41 @@ def test_inferencer_sharded_modes_match_single_device(sharding):
     result = run(sharding)
     np.testing.assert_allclose(result, run("none"), atol=1e-5)
     np.testing.assert_allclose(result[0], np.asarray(chunk.array), atol=1e-5)
+
+
+def test_shape_bucketing_identity_oracle_and_program_reuse():
+    """With --shape-bucket, ragged chunks pad up to the bucket quantum and
+    reuse ONE compiled program; the identity oracle still holds exactly
+    (identity forward copies voxels, so zero padding cannot leak in)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+        shape_bucket=(8, 16, 16),
+    )
+    # the asserted grid follows the bucketed shape, not the ragged one
+    assert inferencer.patch_grid_shape((5, 17, 18)) == \
+        inferencer.patch_grid_shape((8, 32, 32))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        Inferencer(
+            input_patch_size=(4, 16, 16), framework="identity",
+            shape_bucket=(0, 16, 16),
+        )
+    rng = np.random.default_rng(7)
+    shapes = [(5, 17, 18), (7, 30, 20), (8, 32, 32)]
+    for shape in shapes:
+        chunk = rng.random(shape).astype(np.float32)
+        out = np.asarray(inferencer(Chunk(chunk)).array)
+        assert out.shape[-3:] == shape
+        np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+    # (5,17,18) and (7,30,20) both bucket to (8,32,32): one program for all
+    sizes = inferencer._program._cache_size()
+    assert sizes == 1, f"expected one compiled program, got {sizes}"
