@@ -3,9 +3,10 @@
 The reference's only cross-host runtime is an SQS queue; this framework
 additionally supports one jax program spanning hosts (SURVEY §5.8). Round-1
 verdict: multihost was "helpers-only, tested in a single process". This
-test runs a REAL two-process jax.distributed runtime on the CPU backend —
-coordinator bring-up, global device view, and a cross-process allgather —
-the same code path a v5e-16 pod slice uses (minus ICI).
+test runs a REAL two-process jax.distributed runtime on the CPU backend:
+coordinator bring-up, a cross-process allgather, and a jit'ed collective
+over an 8-device global mesh layered exactly like a pod slice — 2
+processes (DCN axis) x 4 local virtual devices each (ICI axis).
 """
 import socket
 import time
@@ -15,6 +16,13 @@ import sys
 WORKER = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+# four virtual chips per host: the global mesh spans DCN (processes) x
+# ICI (local devices), the layering a real multi-host pod slice has
+import re as _re
+_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                 os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=4"
+
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, {repo!r})
 
@@ -26,22 +34,37 @@ multihost.initialize(
     process_id={pid},
 )
 import jax
+import jax.numpy as jnp
 from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec
 import numpy as np
 
 assert jax.process_count() == 2, jax.process_count()
 assert jax.process_index() == {pid}
 assert multihost.is_coordinator() == ({pid} == 0)
-# one device per process locally, two globally
-assert jax.device_count() == 2 * jax.local_device_count()
+assert jax.local_device_count() == 4
+assert jax.device_count() == 8
 
 gathered = multihost_utils.process_allgather(
     np.asarray([{pid} + 1], np.int32)
 )
 assert gathered.reshape(-1).tolist() == [1, 2], gathered
 
+# a collective over the full 8-device global mesh: each process feeds its
+# local 4-row shard; the jit'ed sum reduces across processes + devices
 mesh = multihost.global_mesh()
-assert mesh.devices.size == jax.device_count()
+assert mesh.devices.size == 8
+sharding = NamedSharding(mesh, PartitionSpec("data"))
+local_rows = np.arange(4 * 3, dtype=np.float32).reshape(4, 3) + 100 * {pid}
+garr = jax.make_array_from_process_local_data(sharding, local_rows, (8, 3))
+total = jax.jit(
+    lambda x: jnp.sum(x),
+    out_shardings=NamedSharding(mesh, PartitionSpec()),
+)(garr)
+expected = float(sum(
+    (np.arange(12, dtype=np.float32) + 100 * p).sum() for p in (0, 1)
+))
+np.testing.assert_allclose(float(total), expected)
 print("WORKER_OK", {pid})
 """
 
