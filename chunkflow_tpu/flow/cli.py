@@ -1475,10 +1475,12 @@ def copy_var_cmd(op_name, from_name, to_name):
     help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
 )
 @click.option(
-    "--sharding", type=click.Choice(["none", "patch", "spatial"]),
+    "--sharding",
+    type=click.Choice(["none", "patch", "spatial", "spatial2d"]),
     default="none",
     help="multi-chip execution over all local devices: patch-parallel "
-         "(psum merge) or spatially-sharded chunk (ring halo exchange)",
+         "(psum merge), spatially-sharded chunk along y (ring halo "
+         "exchange), or a 2D (y, x) device mesh with two-phase halos",
 )
 @cartesian_option(
     "--shape-bucket", default=None,

@@ -78,7 +78,7 @@ class Inferencer:
         self.mask_myelin_threshold = mask_myelin_threshold
         self.dry_run = dry_run
         self.framework = framework
-        if sharding not in ("none", "patch", "spatial"):
+        if sharding not in ("none", "patch", "spatial", "spatial2d"):
             raise ValueError(f"unknown sharding mode {sharding!r}")
         self.sharding = sharding
         # Optional shape bucketing (SURVEY §7 hard parts): pad every chunk
@@ -101,6 +101,8 @@ class Inferencer:
         self._mesh = None
         self._sharded_program = None
         self._spatial_programs = {}
+        self._spatial2d_programs = {}
+        self._mesh2d = None
         if bump != "wu":
             raise ValueError(f"only the 'wu' bump is implemented, got {bump!r}")
         if augment and (
@@ -279,6 +281,54 @@ class Inferencer:
                 jnp.asarray(valid),
                 self._device_params,
             )
+
+        if self.sharding == "spatial2d":
+            from chunkflow_tpu.parallel.spatial2d import (
+                build_spatial2d_program,
+                make_mesh_2d,
+                pad_chunk_yx,
+                partition_patches_2d,
+                spatial2d_geometry,
+            )
+
+            if self._mesh2d is None:
+                self._mesh2d = make_mesh_2d(devices=mesh.devices.reshape(-1))
+            mesh2d = self._mesh2d
+            pin2 = tuple(self.input_patch_size)
+            pout2 = tuple(self.output_patch_size)
+            y, x = arr.shape[-2], arr.shape[-1]
+            geometry = spatial2d_geometry(y, x, mesh2d, pin2, pout2)
+            (yslab, hl_y, _, _, padded_y), (xslab, hl_x, _, _, padded_x) = (
+                geometry
+            )
+            key = (yslab, xslab)
+            if key not in self._spatial2d_programs:
+                # routed through self._forward so TTA applies like every
+                # other sharding mode; cached per slab geometry so
+                # same-shaped chunks reuse one compiled program
+                self._spatial2d_programs[key] = build_spatial2d_program(
+                    self._forward,
+                    self.num_input_channels,
+                    self.num_output_channels,
+                    pin2,
+                    pout2,
+                    self.batch_size,
+                    mesh2d,
+                    bump_map(pout2),
+                    geometry,
+                )
+            dev_in, dev_out, dev_valid = partition_patches_2d(
+                grid, mesh2d, yslab, xslab, self.batch_size, hl_y, hl_x
+            )
+            padded = pad_chunk_yx(arr, padded_y, padded_x)
+            result = self._spatial2d_programs[key](
+                padded,
+                jnp.asarray(dev_in),
+                jnp.asarray(dev_out),
+                jnp.asarray(dev_valid),
+                self._device_params,
+            )
+            return result[:, :, :y, :x]
 
         # spatial sharding: static geometry depends on the slab height
         from chunkflow_tpu.parallel.spatial import (
