@@ -51,6 +51,17 @@ CONFIGS = [
      "pallas": "0"},
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "1"},
+    # steady-state pipelined throughput (Inferencer.stream): chunk i+1's
+    # program runs while chunk i's result rides D2H — the production
+    # configuration (the reference's 1.66 number likewise amortizes fixed
+    # costs over a 108x2048x2048 task)
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0", "stream": 5},
+    # + bfloat16 results off the device: halves D2H bytes; production
+    # storage is uint8-quantized (reference save_precomputed.py:84-102),
+    # so bf16 transport loses nothing the pipeline keeps
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0", "stream": 5, "output_dtype": "bfloat16"},
 ]
 
 
@@ -84,8 +95,19 @@ def _record(results: dict, name: str, payload: dict):
         print(f"cannot write {RESULTS_PATH}: {e}", file=sys.stderr)
 
 
+# external override preserved across configs: a cfg's stack_gb applies to
+# that config only, then the user's environment value is restored
+_ORIG_STACK_GB = os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB")
+
+
 def run_config(cfg: dict) -> dict:
     os.environ["CHUNKFLOW_PALLAS"] = cfg.get("pallas", "0")
+    if "stack_gb" in cfg:  # 0 forces the per-batch scan accumulate path
+        os.environ["CHUNKFLOW_BLEND_STACK_MAX_GB"] = str(cfg["stack_gb"])
+    elif _ORIG_STACK_GB is not None:
+        os.environ["CHUNKFLOW_BLEND_STACK_MAX_GB"] = _ORIG_STACK_GB
+    else:
+        os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference import Inferencer
     from chunkflow_tpu.ops.pallas_blend import pallas_mode
@@ -110,6 +132,7 @@ def run_config(cfg: dict) -> dict:
         framework="flax",
         batch_size=cfg["batch_size"],
         dtype=cfg["dtype"],
+        output_dtype=cfg.get("output_dtype", "float32"),
         model_variant=cfg["model_variant"],
         crop_output_margin=False,
     )
@@ -121,6 +144,21 @@ def run_config(cfg: dict) -> dict:
     arr = np.asarray(out.array)
     assert np.isfinite(arr).all(), "non-finite benchmark output"
     assert arr.std() > 0, "degenerate benchmark output"
+
+    n_stream = int(cfg.get("stream", 0))
+    if n_stream:
+        chunks = [
+            Chunk(rng.random(CHUNK_SIZE, dtype=np.float32))
+            for _ in range(n_stream)
+        ]
+        start = time.perf_counter()
+        outs = list(inferencer.stream(iter(chunks)))
+        total = time.perf_counter() - start
+        assert len(outs) == n_stream
+        mvox_s = n_stream * float(np.prod(CHUNK_SIZE)) / total / 1e6
+        return {"mvox_s": mvox_s, "warmup_s": round(warmup_s, 1),
+                "steady_s": round(total / n_stream, 3),
+                "pipelined_chunks": n_stream}
 
     times = []
     for _ in range(3):
@@ -215,10 +253,17 @@ def _cached_hardware_result():
 
 
 def _cfg_name(cfg: dict) -> str:
-    return (
+    name = (
         f"{cfg['model_variant']}-{cfg['dtype']}-"
         f"bs{cfg['batch_size']}-pallas{cfg.get('pallas', '0')}"
     )
+    if cfg.get("stream"):
+        name += f"-stream{cfg['stream']}"
+    if cfg.get("output_dtype", "float32") != "float32":
+        name += f"-out{cfg['output_dtype']}"
+    if "stack_gb" in cfg:
+        name += f"-stack{cfg['stack_gb']}"
+    return name
 
 
 def main():

@@ -37,6 +37,15 @@ def _is_jax(array) -> bool:
     return type(array).__module__.startswith("jax")
 
 
+def as_native_dtype(arr: np.ndarray) -> np.ndarray:
+    """Widen non-native dtypes (ml_dtypes bfloat16 and friends, numpy
+    kind 'V') to float32 for host file formats that cannot store them
+    (HDF5/TIFF/PNG/NRRD writers share this rule)."""
+    if arr.dtype.kind not in "biufc":
+        return arr.astype(np.float32)
+    return arr
+
+
 class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
     """An ndarray located in a global voxel coordinate system."""
 
@@ -467,7 +476,14 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
 
     # ---- analytics / transforms -----------------------------------------
     def all_zero(self) -> bool:
-        return not bool(np.any(np.asarray(self.array)))
+        if _is_jax(self.array):
+            # reduce on device: only the scalar crosses D2H (np.asarray
+            # here would pull the whole chunk over the link — on the
+            # tunneled chip that transfer dwarfs the reduction)
+            import jax.numpy as jnp
+
+            return not bool(jnp.any(self.array))
+        return not bool(np.any(self.array))
 
     def min(self):
         return self.array.min()
@@ -532,7 +548,8 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         if not path.endswith(".h5"):
             path = os.path.join(path, f"{self.bbox.string}.h5")
         with h5py.File(path, "w") as f:
-            arr = np.asarray(self.array)
+            # HDF5 has no bfloat16: h5py would store opaque |V2 bytes
+            arr = as_native_dtype(np.asarray(self.array))
             chunks = None
             if chunk_size is not None:
                 chunks = tuple(chunk_size)

@@ -1469,6 +1469,10 @@ def copy_var_cmd(op_name, from_name, to_name):
               default="float32",
               help="compute dtype; float16 is accepted for reference "
                    "compatibility and mapped to bfloat16 (the TPU half type)")
+@click.option("--output-dtype", type=click.Choice(["float32", "bfloat16"]),
+              default="float32",
+              help="result dtype leaving the device; bfloat16 halves D2H "
+                   "bytes (blend accumulation stays float32 either way)")
 @click.option(
     "--model-variant", type=click.Choice(["parity", "rsunet", "tpu"]),
     default="parity",
@@ -1488,6 +1492,14 @@ def copy_var_cmd(op_name, from_name, to_name):
          "edge chunks reuse one compiled program (trade-off: the net sees "
          "zero padding past the true edge)",
 )
+@click.option(
+    "--async-depth", type=int, default=1,
+    help="pipeline up to N tasks through the device: task i+1's fused "
+         "program runs while task i's result rides D2H (jax dispatch is "
+         "async). 1 = synchronous (reference behavior). Per-op timers "
+         "then measure dispatch-to-materialize wall time, which overlaps "
+         "across tasks",
+)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
 def inference_cmd(op_name, input_patch_size, output_patch_size,
@@ -1495,8 +1507,8 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                   num_output_channels, num_input_channels, framework,
                   model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
-                  model_variant, sharding, shape_bucket, input_chunk_name,
-                  output_chunk_name):
+                  output_dtype, model_variant, sharding, shape_bucket,
+                  async_depth, input_chunk_name, output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -1532,15 +1544,14 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
         crop_output_margin=crop_output_margin and explicit_crop is None,
         mask_myelin_threshold=mask_myelin_threshold,
         dtype=dtype,
+        output_dtype=output_dtype,
         model_variant=model_variant,
         sharding=sharding,
         shape_bucket=shape_bucket,
         dry_run=state.dry_run,
     )
 
-    @operator
-    def stage(task):
-        chunk = task[input_chunk_name]
+    def check_grid(chunk):
         if expected_patch_num is not None:
             got = inferencer.patch_grid_shape(chunk.shape)
             if got != expected_patch_num:
@@ -1548,14 +1559,74 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                     f"--patch-num {expected_patch_num} but chunk "
                     f"{tuple(chunk.shape)} decomposes into {got} patches"
                 )
-        out = inferencer(chunk)
-        if explicit_crop is not None:
-            out = out.crop_margin(explicit_crop)
-        task[output_chunk_name] = out
-        task["log"]["compute_device"] = inferencer.compute_device
-        return task
 
-    return stage(_name=op_name)
+    if async_depth <= 1:
+        @operator
+        def stage(task):
+            chunk = task[input_chunk_name]
+            check_grid(chunk)
+            out = inferencer(chunk)
+            if explicit_crop is not None:
+                out = out.crop_margin(explicit_crop)
+            task[output_chunk_name] = out
+            task["log"]["compute_device"] = inferencer.compute_device
+            return task
+
+        return stage(_name=op_name)
+
+    # pipelined: hold up to async_depth dispatched tasks in flight so
+    # task i+1's fused program runs while task i's result rides D2H
+    # (Inferencer.stream's trick, threaded through the task dicts)
+    def pipelined_stage(stream):
+        import collections
+        import time
+
+        pending = collections.deque()  # (task, device_out, t_dispatch)
+
+        def finalize(entry):
+            task, out, t0 = entry
+            out = out.host()  # crop already applied on device
+            task[output_chunk_name] = out
+            # dispatch-to-materialize wall time; overlapping tasks share
+            # wall clock, so these timers sum to more than elapsed time
+            task["log"]["timer"][op_name] = time.time() - t0
+            task["log"]["compute_device"] = inferencer.compute_device
+            return task
+
+        try:
+            for task in stream:
+                if task is None:
+                    # preserve order: flush in-flight work before passing
+                    # the skip marker downstream
+                    while pending:
+                        yield finalize(pending.popleft())
+                    yield task
+                    continue
+                chunk = task[input_chunk_name]
+                check_grid(chunk)
+                # drain BEFORE dispatching so at most async_depth tasks
+                # are ever device-resident (the documented memory bound)
+                while len(pending) >= async_depth:
+                    yield finalize(pending.popleft())
+                t0 = time.time()
+                pending.append((
+                    task,
+                    inferencer.infer_async(chunk, crop=explicit_crop),
+                    t0,
+                ))
+        except Exception:
+            # a mid-stream failure (bad grid, upstream error) must not
+            # drop already-dispatched tasks the synchronous path would
+            # have saved; push what completed downstream, then re-raise.
+            # (except, not finally: a yield in finally would break
+            # generator close(), which raises GeneratorExit here.)
+            while pending:
+                yield finalize(pending.popleft())
+            raise
+        while pending:
+            yield finalize(pending.popleft())
+
+    return pipelined_stage
 
 
 @main.command("crop-margin")

@@ -56,6 +56,7 @@ class Inferencer:
         crop_output_margin: bool = True,
         mask_myelin_threshold: Optional[float] = None,
         dtype: str = "float32",
+        output_dtype: str = "float32",
         model_variant: str = "parity",
         engine=None,
         sharding: str = "none",
@@ -78,6 +79,18 @@ class Inferencer:
         self.mask_myelin_threshold = mask_myelin_threshold
         self.dry_run = dry_run
         self.framework = framework
+        # Accumulation/normalization stay float32 (blend exactness); this
+        # only narrows the RESULT before it leaves the device. bfloat16
+        # halves D2H bytes — on this environment's tunneled chip the
+        # device->host link, not compute, bounds end-to-end throughput —
+        # and downstream production stages quantize to uint8 anyway
+        # (reference save_precomputed.py:84-102).
+        if output_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"output_dtype must be float32 or bfloat16, got "
+                f"{output_dtype!r}"
+            )
+        self.output_dtype = output_dtype
         if sharding not in ("none", "patch", "spatial", "spatial2d"):
             raise ValueError(f"unknown sharding mode {sharding!r}")
         self.sharding = sharding
@@ -226,9 +239,11 @@ class Inferencer:
             bump_map(tuple(self.output_patch_size)),
         )
 
+        out_dtype = self.output_dtype
+
         def program(chunk, in_starts, out_starts, valid, params):
             out, weight = local_blend(chunk, in_starts, out_starts, valid, params)
-            return normalize_blend(out, weight)
+            return normalize_blend(out, weight, out_dtype)
 
         return jax.jit(program)
 
@@ -270,6 +285,7 @@ class Inferencer:
                     self.batch_size,
                     mesh,
                     bump_map(tuple(self.output_patch_size)),
+                    out_dtype=self.output_dtype,
                 )
             in_starts, out_starts, valid = pad_to_batch(
                 grid, self.batch_size * n_dev
@@ -316,6 +332,7 @@ class Inferencer:
                     mesh2d,
                     bump_map(pout2),
                     geometry,
+                    out_dtype=self.output_dtype,
                 )
             dev_in, dev_out, dev_valid = partition_patches_2d(
                 grid, mesh2d, yslab, xslab, self.batch_size, hl_y, hl_x
@@ -357,6 +374,7 @@ class Inferencer:
                 halo_left,
                 halo_right,
                 spill,
+                out_dtype=self.output_dtype,
             )
         dev_in, dev_out, dev_valid = partition_patches(
             grid, n_dev, slab, self.batch_size, halo_left
@@ -373,6 +391,44 @@ class Inferencer:
 
     # ------------------------------------------------------------------
     def __call__(self, chunk: Chunk) -> Chunk:
+        return self._infer(chunk, block=True)
+
+    def stream(self, chunks):
+        """Pipelined inference over an iterable of chunks (2-deep).
+
+        The reference's production loop is strictly sequential per task —
+        load, forward, blend, save, repeat (SURVEY §3.2). On TPU the
+        dispatch model is asynchronous, so this generator keeps the chip
+        busy across chunk boundaries: chunk i+1's fused program is
+        enqueued while chunk i's result rides the device→host DMA
+        (``copy_to_host_async``), hiding transfer latency behind compute.
+        Yields host-resident output chunks in input order. Same-shape
+        chunks reuse one compiled program.
+        """
+        pending = None
+        for chunk in chunks:
+            out = self.infer_async(chunk)
+            if pending is not None:
+                yield pending.host()
+            pending = out
+        if pending is not None:
+            yield pending.host()
+
+    def infer_async(self, chunk: Chunk, crop=None) -> Chunk:
+        """Dispatch the fused program and start the result's D2H copy
+        without blocking; materialize later with ``.host()``. Building
+        block for pipelined drivers (``stream``, CLI --async-depth).
+        ``crop`` applies an explicit margin crop ON DEVICE before the
+        copy starts, so discarded margin voxels never ride D2H."""
+        out = self._infer(chunk, block=False)
+        if crop is not None:
+            out = out.crop_margin(crop)
+        arr = out.array
+        if hasattr(arr, "copy_to_host_async"):
+            arr.copy_to_host_async()
+        return out
+
+    def _infer(self, chunk: Chunk, block: bool) -> Chunk:
         import jax
         import jax.numpy as jnp
 
@@ -388,9 +444,14 @@ class Inferencer:
             nchan = self.num_output_channels
             if self.mask_myelin_threshold is not None:
                 nchan -= 1
+            import ml_dtypes
+
             out = Chunk.from_bbox(
                 chunk.bbox,
-                dtype=np.float32,
+                # match the real path's result dtype so a volume mixing
+                # blank and real chunks stays dtype-consistent
+                dtype=(np.float32 if self.output_dtype == "float32"
+                       else ml_dtypes.bfloat16),
                 nchannels=nchan,
                 voxel_size=chunk.voxel_size,
             )
@@ -444,7 +505,8 @@ class Inferencer:
             )
         else:
             result = self._run_sharded(arr, grid)
-        result.block_until_ready()
+        if block:
+            result.block_until_ready()
         if run_zyx != orig_zyx:
             result = result[
                 :, : orig_zyx[0], : orig_zyx[1], : orig_zyx[2]

@@ -143,10 +143,13 @@ def build_local_blend(
     return local_blend
 
 
-def normalize_blend(out, weight):
-    """Reciprocal weight normalization; zero where nothing was predicted."""
+def normalize_blend(out, weight, dtype="float32"):
+    """Reciprocal weight normalization; zero where nothing was predicted.
+    ``dtype`` narrows the result inside the program (accumulation inputs
+    stay float32) — the single place result dtype is decided for every
+    program builder."""
     import jax.numpy as jnp
 
     return jnp.where(
         weight[None] > 0, out / jnp.maximum(weight[None], 1e-20), 0.0
-    )
+    ).astype(jnp.dtype(dtype))

@@ -38,12 +38,15 @@ def build_sharded_program(
     batch_size: int,
     mesh,
     bump_array: np.ndarray,
+    out_dtype="float32",
 ):
     """jit-compiled multi-chip fused inference: chunk + patch coords -> output.
 
     Patch arrays must be padded so N is divisible by (n_devices * batch_size)
     (use patching.pad_to_batch with that product). The chunk is replicated;
     each device scans its N/n_devices patches and psums partial buffers.
+    The result is cast to ``out_dtype`` inside the program (accumulation
+    stays float32).
     """
     import jax
     from jax import lax
@@ -80,7 +83,7 @@ def build_sharded_program(
     @jax.jit
     def program(chunk, in_starts, out_starts, valid, params):
         out, weight = sharded(chunk, in_starts, out_starts, valid, params)
-        return normalize_blend(out, weight)
+        return normalize_blend(out, weight, out_dtype)
 
     return program
 

@@ -120,6 +120,7 @@ def build_spatial_program(
     halo_left: int,
     halo_right: int,
     spill: int,
+    out_dtype="float32",
 ):
     """jit-compiled y-sharded fused inference over ``mesh`` axis 'data'.
 
@@ -202,7 +203,7 @@ def build_spatial_program(
     @jax.jit
     def program(chunk, dev_in, dev_out, dev_valid, params):
         out, weight = sharded(chunk, dev_in, dev_out, dev_valid, params)
-        return normalize_blend(out, weight)
+        return normalize_blend(out, weight, out_dtype)
 
     return program
 

@@ -123,6 +123,7 @@ def build_spatial2d_program(
     mesh,
     bump_array: np.ndarray,
     geometry,
+    out_dtype="float32",
 ):
     """jit-compiled (y, x)-sharded fused inference over mesh ('dy', 'dx')."""
     import jax
@@ -206,7 +207,7 @@ def build_spatial2d_program(
     @jax.jit
     def program(chunk, dev_in, dev_out, dev_valid, params):
         out, weight = sharded(chunk, dev_in, dev_out, dev_valid, params)
-        return normalize_blend(out, weight)
+        return normalize_blend(out, weight, out_dtype)
 
     return program
 

@@ -22,11 +22,44 @@ from chunkflow_tpu.core.bbox import BoundingBox
 
 def save_pngs(chunk, output_path: str, name_prefix: str = "") -> None:
     os.makedirs(output_path, exist_ok=True)
-    arr = np.asarray(chunk.array)
+    from chunkflow_tpu.chunk.base import as_native_dtype
+
+    arr = as_native_dtype(np.asarray(chunk.array))
     if arr.ndim == 4:
-        if arr.shape[0] != 1:
+        if getattr(chunk, "is_affinity_map", False) and arr.shape[0] == 3:
+            # reference semantics (save_pngs.py:33-38): yx-affinity mean as
+            # uint8 greyscale. Float affinities are [0,1] and scale by 255;
+            # already-quantized integer affinities average in a wide type
+            # (uint8 a+b would wrap) without rescaling.
+            if arr.dtype.kind == "f":
+                mean = (arr[1] + arr[2]) / 2.0
+                arr = (np.clip(mean, 0.0, 1.0) * 255.0).astype(np.uint8)
+            elif arr.dtype == np.uint8:
+                arr = (
+                    (arr[1].astype(np.uint16) + arr[2]) // 2
+                ).astype(np.uint8)
+            else:
+                raise ValueError(
+                    f"affinity PNG export supports float or uint8 "
+                    f"channels, got {arr.dtype}"
+                )
+        elif arr.shape[0] != 1:
             raise ValueError("PNG export needs a single-channel chunk")
-        arr = arr[0]
+        else:
+            arr = arr[0]
+    if arr.dtype.kind == "f":
+        # PNG has no float mode; [0,1] float sections (probability /
+        # affinity convention) export as greyscale. Out-of-range floats
+        # stay fail-loud: silently clipping z-scored or 0-255 data would
+        # write saturated images.
+        lo, hi = float(arr.min()), float(arr.max())
+        if lo < -1e-3 or hi > 1.0 + 1e-3:
+            raise ValueError(
+                f"float PNG export expects [0,1] data, got [{lo:.3g}, "
+                f"{hi:.3g}]; rescale (e.g. normalize-intensity) or cast "
+                "to uint8 first"
+            )
+        arr = (np.clip(arr, 0.0, 1.0) * 255.0).astype(np.uint8)
     z0 = chunk.voxel_offset.z
     for i, section in enumerate(arr):
         PILImage.fromarray(section).save(

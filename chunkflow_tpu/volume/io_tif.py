@@ -17,7 +17,9 @@ _COMPRESSION = {
 
 
 def write_tif(chunk, path: str, compression: str = "zlib") -> str:
-    arr = np.asarray(chunk.array)
+    from chunkflow_tpu.chunk.base import as_native_dtype
+
+    arr = as_native_dtype(np.asarray(chunk.array))
     if arr.ndim == 4:
         if arr.shape[0] != 1:
             raise ValueError("TIFF export supports single-channel chunks only")

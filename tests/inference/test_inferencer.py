@@ -267,3 +267,86 @@ def test_shape_bucketing_identity_oracle_and_program_reuse():
     # (5,17,18) and (7,30,20) both bucket to (8,32,32): one program for all
     sizes = inferencer._program._cache_size()
     assert sizes == 1, f"expected one compiled program, got {sizes}"
+
+
+def test_stream_pipelined_matches_sequential_calls():
+    """stream() yields the same outputs as one __call__ per chunk, in
+    order, with host-resident payloads (the D2H overlap must not reorder
+    or corrupt results)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(11)
+    chunks = [
+        Chunk(rng.random((8, 32, 32)).astype(np.float32),
+              voxel_offset=(i * 8, 0, 0))
+        for i in range(3)
+    ]
+    streamed = list(inferencer.stream(iter(chunks)))
+    assert len(streamed) == 3
+    for src, out in zip(chunks, streamed):
+        assert not out.is_on_device
+        assert tuple(out.voxel_offset) == tuple(src.voxel_offset)
+        ref = np.asarray(inferencer(src).array)
+        np.testing.assert_allclose(np.asarray(out.array), ref, atol=1e-6)
+
+
+def test_stream_empty_and_single():
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    assert list(inferencer.stream(iter([]))) == []
+    rng = np.random.default_rng(3)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    (out,) = list(inferencer.stream(iter([chunk])))
+    np.testing.assert_allclose(
+        np.asarray(out.array)[0], np.asarray(chunk.array), atol=1e-6)
+
+
+@pytest.mark.parametrize("sharding", ["none", "patch", "spatial", "spatial2d"])
+def test_output_dtype_bfloat16_all_sharding_modes(sharding):
+    """output_dtype=bfloat16 is fused into every program (single-device
+    and sharded): result dtype is bf16 and the identity oracle holds at
+    bf16 tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    if sharding != "none" and len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 128, 32)).astype(np.float32))
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        sharding=sharding,
+        output_dtype="bfloat16",
+        crop_output_margin=False,
+    )
+    out = inferencer(chunk.clone())
+    assert out.array.dtype == jnp.bfloat16, out.array.dtype
+    np.testing.assert_allclose(
+        np.asarray(out.array, dtype=np.float32)[0],
+        np.asarray(chunk.array), atol=0.01,
+    )

@@ -8,22 +8,14 @@ coordinator bring-up, a cross-process allgather, and a jit'ed collective
 over an 8-device global mesh layered exactly like a pod slice — 2
 processes (DCN axis) x 4 local virtual devices each (ICI axis).
 """
+import os
 import socket
 import time
 import subprocess
 import sys
 
 WORKER = r"""
-import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-# four virtual chips per host: the global mesh spans DCN (processes) x
-# ICI (local devices), the layering a real multi-host pod slice has
-import re as _re
-_flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                 os.environ.get("XLA_FLAGS", ""))
-os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=4"
-
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import sys
 sys.path.insert(0, {repo!r})
 
 from chunkflow_tpu.parallel import multihost
@@ -77,6 +69,27 @@ def _free_port() -> int:
     return port
 
 
+def _worker_env() -> dict:
+    """CPU-pinned env for the spawned workers, scrubbed BEFORE interpreter
+    start: this image's sitecustomize registers the tunneled TPU plugin at
+    startup whenever PALLAS_AXON*/AXON* vars are present, which leaves the
+    process in a state where jax.distributed.initialize silently fails to
+    apply (process_count stays 1) — and in-worker os.environ surgery runs
+    too late to stop it. Four virtual chips per host: the global mesh
+    spans DCN (processes) x ICI (local devices) like a real pod slice."""
+    import re
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("PALLAS_AXON", "AXON"))
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=4"
+    return env
+
+
 def test_two_process_distributed_bringup(tmp_path):
     import chunkflow_tpu
 
@@ -92,7 +105,7 @@ def test_two_process_distributed_bringup(tmp_path):
             procs.append(subprocess.Popen(
                 [sys.executable, "-c",
                  WORKER.format(repo=repo, coord=coord, pid=pid)],
-                stdout=log, stderr=subprocess.STDOUT,
+                stdout=log, stderr=subprocess.STDOUT, env=_worker_env(),
             ))
     try:
         # poll both: a worker that dies before the coordinator barrier

@@ -83,3 +83,40 @@ def test_load_tensorstore_plugin(tmp_path):
     chunk = plugin.execute(bbox, driver="zarr", kvstore=f"file://{store_path}")
     np.testing.assert_array_equal(np.asarray(chunk.array), data[2:6, 2:6, 2:6])
     assert tuple(chunk.voxel_offset) == (2, 2, 2)
+
+
+def test_save_pngs_affinity_and_float_and_bf16(tmp_path):
+    """PNG export: float [0,1] scales to uint8; 3-channel affinity maps
+    export the yx mean (reference save_pngs.py:33-38) without uint8
+    overflow; bfloat16 payloads export instead of crashing."""
+    import ml_dtypes
+    import numpy as np
+    from PIL import Image
+
+    from chunkflow_tpu.chunk.base import Chunk, LayerType
+    from chunkflow_tpu.volume.io_png import save_pngs
+
+    rng = np.random.default_rng(0)
+    # float affinity
+    aff = Chunk(rng.random((3, 2, 8, 8)).astype(np.float32),
+                layer_type=LayerType.AFFINITY_MAP)
+    d = tmp_path / "aff_f32"
+    save_pngs(aff, str(d))
+    sections = sorted(d.iterdir())
+    assert len(sections) == 2
+    got = np.asarray(Image.open(sections[0]))
+    arr = np.asarray(aff.array)
+    want = np.clip((arr[1, 0] + arr[2, 0]) / 2.0, 0, 1) * 255.0
+    np.testing.assert_allclose(got, want.astype(np.uint8), atol=1)
+    # uint8 affinity: no wraparound in the channel mean
+    u8 = Chunk(np.full((3, 2, 8, 8), 200, np.uint8),
+               layer_type=LayerType.AFFINITY_MAP)
+    d2 = tmp_path / "aff_u8"
+    save_pngs(u8, str(d2))
+    got = np.asarray(Image.open(sorted(d2.iterdir())[0]))
+    assert (got == 200).all(), got.max()
+    # bfloat16 single channel
+    bf = Chunk(rng.random((2, 8, 8)).astype(ml_dtypes.bfloat16))
+    d3 = tmp_path / "bf16"
+    save_pngs(bf, str(d3))
+    assert len(list(d3.iterdir())) == 2

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Cooldown then retry loop for the TPU validation battery (resumable:
+# completed steps skip; a tunnel drop only costs the failed step).
+sleep "${BATTERY_COOLDOWN:-600}"
+rc=1
+for i in $(seq 12); do
+    echo "=== battery attempt $i $(date -u +%H:%M:%S) ===" >> tools/tpu_validation.log
+    python tools/tpu_validation.py >> tools/tpu_validation.log 2>&1
+    rc=$?
+    [ "$rc" -eq 0 ] && break
+    sleep 300
+done
+echo "=== battery loop done rc=$rc $(date -u +%H:%M:%S) ===" >> tools/tpu_validation.log

@@ -160,15 +160,14 @@ def fwd_parity():
             "mvox_s": round(2 * 20 * 256 * 256 / dt / 1e6, 2)}
 
 
-def _bench(pallas: str, variant: str, dtype: str, batch: int):
+def _bench(pallas: str, variant: str, dtype: str, batch: int, **extra):
     import bench
 
     os.environ["CHUNKFLOW_PALLAS"] = pallas
+    cfg = {"model_variant": variant, "dtype": dtype,
+           "batch_size": batch, "pallas": pallas, **extra}
     return {k: (round(v, 2) if isinstance(v, float) else v)
-            for k, v in bench.run_config({
-                "model_variant": variant, "dtype": dtype,
-                "batch_size": batch, "pallas": pallas,
-            }).items()}
+            for k, v in bench.run_config(cfg).items()}
 
 
 @step("bench_parity_f32")
@@ -195,11 +194,28 @@ def bench_flagship_xla():
     return _bench("0", "tpu", "bfloat16", 4)
 
 
+@step("bench_parity_f32_scan")
+def bench_parity_scan():
+    """A/B: per-batch scatter_add inside the scan (stacked path off).
+    The stacked single-accumulate redesign shipped unmeasured (tunnel was
+    down); the per-batch design measured 1.48 Mvox/s in round 1."""
+    return _bench("0", "parity", "float32", 2, stack_gb=0)
+
+
+@step("bench_tpu_bf16_scan")
+def bench_flagship_scan():
+    return _bench("0", "tpu", "bfloat16", 4, stack_gb=0)
+
+
 @step("pallas_oracle")
 def check_pallas_oracle():
     import numpy as np
 
     os.environ["CHUNKFLOW_PALLAS"] = "1"
+    # the *_scan steps set a 0 stack budget via bench.run_config; clear it
+    # so the oracle vets the same (stacked) path bench_tpu_bf16_pallas
+    # measures
+    os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference.inferencer import Inferencer
 
@@ -224,6 +240,69 @@ def bench_flagship_pallas():
     return _bench("1", "tpu", "bfloat16", 4)
 
 
+@step("e2e_split")
+def e2e_split():
+    """Where does the flagship config's wall time go? Separate H2D,
+    on-device program, and D2H so the pipelining upside is quantified."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import bench
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    os.environ["CHUNKFLOW_PALLAS"] = "0"
+    # resumed batteries can arrive here with the *_scan steps' 0 budget
+    # still in the env; this step's split is attributed to the stacked
+    # flagship config, so pin the default path
+    os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
+    inferencer = Inferencer(
+        input_patch_size=bench.INPUT_PATCH,
+        output_patch_overlap=bench.OUTPUT_OVERLAP,
+        num_output_channels=bench.NUM_OUT,
+        framework="flax",
+        batch_size=4,
+        dtype="bfloat16",
+        model_variant="tpu",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    host = rng.random(bench.CHUNK_SIZE, dtype=np.float32)
+    # warmup (compile)
+    out = inferencer(Chunk(host))
+    np.asarray(out.array)
+
+    t0 = time.perf_counter()
+    dev = jnp.asarray(host)
+    dev.block_until_ready()
+    h2d_s = time.perf_counter() - t0
+
+    dchunk = Chunk(dev)
+    t0 = time.perf_counter()
+    out = inferencer(dchunk)  # blocks on compute; input already resident
+    compute_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    np.asarray(out.array)
+    d2h_s = time.perf_counter() - t0
+    return {"h2d_s": round(h2d_s, 3), "compute_s": round(compute_s, 3),
+            "d2h_s": round(d2h_s, 3)}
+
+
+@step("bench_tpu_bf16_stream")
+def bench_flagship_stream():
+    """Steady-state pipelined throughput (Inferencer.stream)."""
+    return _bench("0", "tpu", "bfloat16", 4, stream=5)
+
+
+@step("bench_tpu_bf16_stream_bf16out")
+def bench_flagship_stream_bf16out():
+    """Pipelined + bfloat16 results off the device (half the D2H bytes)."""
+    return _bench("0", "tpu", "bfloat16", 4, stream=5,
+                  output_dtype="bfloat16")
+
+
 @step("entry_compile")
 def entry_compile():
     # pin the blend-kernel selection to auto (platform default) so the
@@ -243,8 +322,10 @@ def entry_compile():
 
 def main():
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
-             fwd_tpu_variant, bench_flagship_xla, check_pallas_oracle,
-             bench_flagship_pallas, entry_compile]
+             fwd_tpu_variant, bench_flagship_xla, bench_parity_scan,
+             bench_flagship_scan, check_pallas_oracle,
+             bench_flagship_pallas, e2e_split, bench_flagship_stream,
+             bench_flagship_stream_bf16out, entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
     # a cool-down, e.g.:
@@ -270,10 +351,14 @@ def main():
 def _tunnel_lost(step_name: str) -> bool:
     """Did THIS step's failure look like a dead tunnel? (Checking the
     named entry, not the last dict entry: RESULTS also carries stale
-    errors loaded from a prior run's JSON.)"""
+    errors loaded from a prior run's JSON.) Matches bench.py's mark list:
+    a mid-battery drop surfaces as UNAVAILABLE backend/compile errors,
+    not only connection refusals."""
     entry = RESULTS.get(step_name)
     err = entry.get("error", "") if isinstance(entry, dict) else ""
-    return "Connection refused" in err or "Connection Failed" in err
+    marks = ("Connection refused", "Connection Failed", "UNAVAILABLE",
+             "Unable to initialize backend")
+    return any(m in err for m in marks)
 
 
 if __name__ == "__main__":
