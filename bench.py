@@ -62,6 +62,10 @@ CONFIGS = [
     # so bf16 transport loses nothing the pipeline keeps
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "0", "stream": 5, "output_dtype": "bfloat16"},
+    # + scatter-free fold blend (static parity-class dense overlap-add)
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0", "stream": 5, "output_dtype": "bfloat16",
+     "blend": "fold"},
 ]
 
 
@@ -134,6 +138,7 @@ def run_config(cfg: dict) -> dict:
         dtype=cfg["dtype"],
         output_dtype=cfg.get("output_dtype", "float32"),
         model_variant=cfg["model_variant"],
+        blend=cfg.get("blend", "auto"),
         crop_output_margin=False,
     )
 
@@ -263,6 +268,8 @@ def _cfg_name(cfg: dict) -> str:
         name += f"-out{cfg['output_dtype']}"
     if "stack_gb" in cfg:
         name += f"-stack{cfg['stack_gb']}"
+    if cfg.get("blend", "auto") != "auto":
+        name += f"-{cfg['blend']}"
     return name
 
 

@@ -1493,6 +1493,14 @@ def copy_var_cmd(op_name, from_name, to_name):
          "zero padding past the true edge)",
 )
 @click.option(
+    "--blend", type=click.Choice(["auto", "scatter", "fold"]),
+    default="auto",
+    help="overlap-add strategy: scatter (runtime-coordinate scatter-add "
+         "or pallas kernel), fold (static parity-class dense adds; pads "
+         "the chunk to a uniform patch grid — scatter-free, "
+         "XLA-friendliest), auto (CHUNKFLOW_BLEND env or scatter)",
+)
+@click.option(
     "--async-depth", type=int, default=1,
     help="pipeline up to N tasks through the device: task i+1's fused "
          "program runs while task i's result rides D2H (jax dispatch is "
@@ -1508,7 +1516,7 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                   model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
                   output_dtype, model_variant, sharding, shape_bucket,
-                  async_depth, input_chunk_name, output_chunk_name):
+                  blend, async_depth, input_chunk_name, output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -1548,6 +1556,7 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
         model_variant=model_variant,
         sharding=sharding,
         shape_bucket=shape_bucket,
+        blend=blend,
         dry_run=state.dry_run,
     )
 

@@ -61,6 +61,7 @@ class Inferencer:
         engine=None,
         sharding: str = "none",
         shape_bucket=None,
+        blend: str = "auto",
         dry_run: bool = False,
     ):
         self.input_patch_size = Cartesian.from_collection(input_patch_size)
@@ -111,10 +112,31 @@ class Inferencer:
                 f"shape_bucket must be all-positive (or all-zero to "
                 f"disable), got {tuple(self.shape_bucket)}"
             )
+        # Blend strategy: "scatter" (runtime-coordinate scatter-add /
+        # pallas, ops/blend.py), "fold" (static parity-class dense
+        # overlap-add, ops/fold_blend.py; pads the chunk to a uniform
+        # grid), "auto" (env CHUNKFLOW_BLEND or scatter). Fold applies to
+        # the single-device path; sharded paths keep scatter.
+        import os as _os
+
+        if blend == "auto":
+            blend = _os.environ.get("CHUNKFLOW_BLEND", "scatter").lower()
+        if blend not in ("scatter", "fold"):
+            raise ValueError(f"unknown blend mode {blend!r}")
+        if blend == "fold" and sharding != "none":
+            # loud, not silent: sharded programs use the scatter blend;
+            # quietly running scatter would misattribute numbers to fold
+            raise ValueError(
+                f"blend='fold' applies to the single-device path only "
+                f"(got sharding={sharding!r}); use blend='scatter' or "
+                f"sharding='none'"
+            )
+        self.blend_mode = blend
         self._mesh = None
         self._sharded_program = None
         self._spatial_programs = {}
         self._spatial2d_programs = {}
+        self._fold_programs = {}
         self._mesh2d = None
         if bump != "wu":
             raise ValueError(f"only the 'wu' bump is implemented, got {bump!r}")
@@ -159,6 +181,9 @@ class Inferencer:
         shape = tuple(chunk_shape)[-3:]
         if self.shape_bucket is not None:
             shape = tuple(self._bucketed_shape(shape))
+        if self._use_fold(shape):
+            _, grid_shape = self._fold_geometry(shape)
+            return grid_shape
         grid = enumerate_patches(
             shape,
             self.input_patch_size,
@@ -246,6 +271,76 @@ class Inferencer:
             return normalize_blend(out, weight, out_dtype)
 
         return jax.jit(program)
+
+    # ------------------------------------------------------------------
+    def _fold_geometry(self, zyx):
+        """(padded_shape, grid_shape) for the fold path — the ONE place
+        fold geometry is derived, shared by patch_grid_shape, the fit
+        check, and execution so the asserted grid never drifts from the
+        executed one."""
+        from chunkflow_tpu.ops.fold_blend import fold_grid, fold_pad_shape
+
+        pin = tuple(self.input_patch_size)
+        stride = tuple(self.output_patch_size - self.output_patch_overlap)
+        padded = fold_pad_shape(tuple(zyx), pin, stride)
+        return padded, fold_grid(padded, pin, stride)
+
+    def _use_fold(self, zyx) -> bool:
+        """Fold applies when selected AND the patch stacks fit the same
+        byte budget that gates the stacked scatter path — jumbo chunks
+        (e.g. 108x2048x2048 production tasks) fall back to the scan
+        accumulate instead of OOMing HBM."""
+        if self.blend_mode != "fold" or self.sharding != "none":
+            return False
+        import os
+
+        budget = int(
+            float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2"))
+            * 2 ** 30
+        )
+        _, grid = self._fold_geometry(zyx)
+        n = int(np.prod(grid))
+        pin = tuple(self.input_patch_size)
+        pout = tuple(self.output_patch_size)
+        per_patch = 4 * (
+            self.num_input_channels * int(np.prod(pin))     # patch stack
+            + (self.num_output_channels + 1) * int(np.prod(pout))  # preds+w
+        )
+        return n * per_patch <= budget
+
+    def _run_fold(self, arr):
+        """Static-geometry scatter-free path (ops/fold_blend.py): pad to
+        a uniform patch grid, run the cached per-shape fold program, crop
+        back. Edge predictions within one patch of a padded face see zero
+        padding instead of edge-snapped context (the shape-bucketing
+        trade-off), which is why fold is opt-in."""
+        import jax.numpy as jnp
+
+        from chunkflow_tpu.ops.fold_blend import build_fold_program
+
+        pin = tuple(self.input_patch_size)
+        pout = tuple(self.output_patch_size)
+        stride = tuple(self.output_patch_size - self.output_patch_overlap)
+        zyx = tuple(arr.shape[-3:])
+        padded, _ = self._fold_geometry(zyx)
+        if padded != zyx:
+            pad = [(0, 0)] + [(0, p - s) for p, s in zip(padded, zyx)]
+            arr = jnp.pad(arr, pad)
+        if padded not in self._fold_programs:
+            self._fold_programs[padded] = build_fold_program(
+                self._forward,
+                self.num_input_channels,
+                self.num_output_channels,
+                pin,
+                pout,
+                stride,
+                self.batch_size,
+                bump_map(pout),
+                padded,
+                out_dtype=self.output_dtype,
+            )
+        result = self._fold_programs[padded](arr, self._device_params)
+        return result[:, : zyx[0], : zyx[1], : zyx[2]]
 
     # ------------------------------------------------------------------
     def _mesh_or_build(self):
@@ -492,7 +587,9 @@ class Inferencer:
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)
 
-        if self.sharding == "none":
+        if self._use_fold(run_zyx):
+            result = self._run_fold(arr)
+        elif self.sharding == "none":
             in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
             if self._program is None:
                 self._program = self._build_program()

@@ -1,0 +1,195 @@
+"""Fold (parity-class dense) overlap-add: equivalence with the scatter
+path and with a direct numpy overlap-add."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.ops.fold_blend import (
+    fold_accumulate,
+    fold_grid,
+    fold_pad_shape,
+)
+
+
+def _numpy_overlap_add(stack, grid, stride, pout, offset, out_zyx):
+    co = stack.shape[1]
+    out = np.zeros((co,) + tuple(out_zyx), np.float32)
+    idx = 0
+    for iz in range(grid[0]):
+        for iy in range(grid[1]):
+            for ix in range(grid[2]):
+                z0 = offset[0] + iz * stride[0]
+                y0 = offset[1] + iy * stride[1]
+                x0 = offset[2] + ix * stride[2]
+                out[:, z0:z0 + pout[0], y0:y0 + pout[1],
+                    x0:x0 + pout[2]] += np.asarray(stack[idx])
+                idx += 1
+    return out
+
+
+@pytest.mark.parametrize(
+    "grid,stride,pout",
+    [
+        ((3, 2, 2), (4, 12, 12), (8, 16, 16)),   # k=2 per axis
+        ((2, 4, 3), (8, 6, 8), (8, 16, 16)),     # kz=1, ky=3, kx=2
+        ((1, 1, 5), (4, 16, 5), (4, 16, 12)),    # heavy x overlap, kx=3
+    ],
+)
+def test_fold_accumulate_matches_numpy(grid, stride, pout):
+    rng = np.random.default_rng(0)
+    n = int(np.prod(grid))
+    co = 2
+    stack = rng.random((n, co) + pout).astype(np.float32)
+    offset = (1, 2, 3)
+    out_zyx = tuple(
+        offset[i] + (grid[i] - 1) * stride[i] + pout[i] for i in range(3)
+    )
+    got = np.asarray(
+        fold_accumulate(stack, grid, stride, pout, offset, out_zyx)
+    )
+    want = _numpy_overlap_add(stack, grid, stride, pout, offset, out_zyx)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_fold_pad_and_grid():
+    assert fold_pad_shape((64, 512, 512), (20, 256, 256), (16, 192, 192)) \
+        == (68, 640, 640)
+    assert fold_grid((68, 640, 640), (20, 256, 256), (16, 192, 192)) \
+        == (4, 3, 3)
+    # already uniform: unchanged
+    assert fold_pad_shape((36, 448, 448), (20, 256, 256), (16, 192, 192)) \
+        == (36, 448, 448)
+    with pytest.raises(ValueError):
+        fold_grid((65, 640, 640), (20, 256, 256), (16, 192, 192))
+
+
+@pytest.mark.parametrize("shape", [(8, 32, 32), (8, 33, 37), (5, 17, 18)])
+def test_fold_identity_oracle(shape):
+    """blend='fold' reproduces the input through the full engine on
+    uniform AND ragged shapes (padding + crop)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(1)
+    chunk = rng.random(shape).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    assert out.shape == (3,) + shape
+    np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+
+
+def test_fold_matches_scatter_with_margin():
+    """With a crop margin (pin != pout), fold and scatter agree on the
+    mutually-covered interior."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    def build(blend):
+        return Inferencer(
+            input_patch_size=(8, 24, 24),
+            output_patch_size=(4, 16, 16),
+            output_patch_overlap=(2, 8, 8),
+            num_output_channels=1,
+            framework="identity",
+            batch_size=2,
+            blend=blend,
+            crop_output_margin=True,
+        )
+
+    rng = np.random.default_rng(2)
+    chunk = Chunk(rng.random((16, 48, 48)).astype(np.float32))
+    fold = np.asarray(build("fold")(chunk.clone()).array)
+    scatter = np.asarray(build("scatter")(chunk.clone()).array)
+    assert fold.shape == scatter.shape
+    # cropped interior: both must equal the input there (identity engine)
+    np.testing.assert_allclose(fold, scatter, atol=1e-5)
+
+
+def test_fold_with_tta_and_bf16_output():
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        augment=True,
+        output_dtype="bfloat16",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(3)
+    chunk = rng.random((8, 32, 32)).astype(np.float32)
+    out = inferencer(Chunk(chunk))
+    import jax.numpy as jnp
+
+    assert out.array.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out.array, np.float32)[0], chunk, atol=0.01)
+
+
+def test_fold_program_reuse_and_patch_grid():
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    # both ragged shapes pad to the same uniform grid -> one program
+    rng = np.random.default_rng(4)
+    for shape in ((8, 30, 30), (7, 27, 32), (8, 32, 32)):
+        chunk = rng.random(shape).astype(np.float32)
+        out = np.asarray(inferencer(Chunk(chunk)).array)
+        np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+    assert len(inferencer._fold_programs) == 1
+    assert inferencer.patch_grid_shape((8, 32, 32)) == (3, 3, 3)
+
+
+def test_fold_budget_fallback_and_sharding_conflict(monkeypatch):
+    """Over-budget stacks fall back to the scatter path (no OOM), and
+    fold+sharding is rejected loudly at construction."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.000001")
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    assert not inferencer._use_fold((8, 32, 32))
+    rng = np.random.default_rng(6)
+    chunk = rng.random((8, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+    assert not inferencer._fold_programs  # scatter path ran instead
+    # the --patch-num assertion follows the EXECUTED (scatter) grid
+    assert inferencer.patch_grid_shape((8, 32, 32)) == (3, 3, 3)
+
+    monkeypatch.delenv("CHUNKFLOW_BLEND_STACK_MAX_GB")
+    with pytest.raises(ValueError, match="single-device"):
+        Inferencer(
+            input_patch_size=(4, 16, 16),
+            framework="identity",
+            blend="fold",
+            sharding="spatial",
+        )

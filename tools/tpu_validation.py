@@ -207,6 +207,24 @@ def bench_flagship_scan():
     return _bench("0", "tpu", "bfloat16", 4, stack_gb=0)
 
 
+@step("bench_parity_f32_fold")
+def bench_parity_fold():
+    """Scatter-free parity-class fold blend (ops/fold_blend.py)."""
+    return _bench("0", "parity", "float32", 2, blend="fold")
+
+
+@step("bench_tpu_bf16_fold")
+def bench_flagship_fold():
+    return _bench("0", "tpu", "bfloat16", 4, blend="fold")
+
+
+@step("bench_tpu_bf16_fold_stream_bf16out")
+def bench_flagship_fold_stream():
+    """Everything on: fold blend + pipelined D2H + bf16 results."""
+    return _bench("0", "tpu", "bfloat16", 4, blend="fold", stream=5,
+                  output_dtype="bfloat16")
+
+
 @step("pallas_oracle")
 def check_pallas_oracle():
     import numpy as np
@@ -323,9 +341,10 @@ def entry_compile():
 def main():
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
              fwd_tpu_variant, bench_flagship_xla, bench_parity_scan,
-             bench_flagship_scan, check_pallas_oracle,
-             bench_flagship_pallas, e2e_split, bench_flagship_stream,
-             bench_flagship_stream_bf16out, entry_compile]
+             bench_flagship_scan, bench_parity_fold, bench_flagship_fold,
+             check_pallas_oracle, bench_flagship_pallas, e2e_split,
+             bench_flagship_stream, bench_flagship_stream_bf16out,
+             bench_flagship_fold_stream, entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
     # a cool-down, e.g.:
