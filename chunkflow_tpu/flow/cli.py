@@ -1469,10 +1469,13 @@ def copy_var_cmd(op_name, from_name, to_name):
               default="float32",
               help="compute dtype; float16 is accepted for reference "
                    "compatibility and mapped to bfloat16 (the TPU half type)")
-@click.option("--output-dtype", type=click.Choice(["float32", "bfloat16"]),
+@click.option("--output-dtype",
+              type=click.Choice(["float32", "bfloat16", "uint8"]),
               default="float32",
               help="result dtype leaving the device; bfloat16 halves D2H "
-                   "bytes (blend accumulation stays float32 either way)")
+                   "bytes, uint8 quantizes on device exactly like the "
+                   "reference's save-time conversion (blend accumulation "
+                   "stays float32 either way)")
 @click.option(
     "--model-variant", type=click.Choice(["parity", "rsunet", "tpu"]),
     default="parity",

@@ -84,12 +84,18 @@ class Inferencer:
         # only narrows the RESULT before it leaves the device. bfloat16
         # halves D2H bytes — on this environment's tunneled chip the
         # device->host link, not compute, bounds end-to-end throughput —
-        # and downstream production stages quantize to uint8 anyway
-        # (reference save_precomputed.py:84-102).
-        if output_dtype not in ("float32", "bfloat16"):
+        # and uint8 quantizes on device exactly like the reference's
+        # save-time float->uint8 conversion (save_precomputed.py:90-92),
+        # quartering the bytes.
+        if output_dtype not in ("float32", "bfloat16", "uint8"):
             raise ValueError(
-                f"output_dtype must be float32 or bfloat16, got "
+                f"output_dtype must be float32, bfloat16 or uint8, got "
                 f"{output_dtype!r}"
+            )
+        if output_dtype == "uint8" and mask_myelin_threshold is not None:
+            raise ValueError(
+                "mask_myelin_threshold compares [0,1] probabilities; "
+                "combine it with float output_dtype, not uint8"
             )
         self.output_dtype = output_dtype
         if sharding not in ("none", "patch", "spatial", "spatial2d"):
@@ -292,12 +298,9 @@ class Inferencer:
         accumulate instead of OOMing HBM."""
         if self.blend_mode != "fold" or self.sharding != "none":
             return False
-        import os
+        from chunkflow_tpu.ops.blend import stack_budget_bytes
 
-        budget = int(
-            float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2"))
-            * 2 ** 30
-        )
+        budget = stack_budget_bytes()
         _, grid = self._fold_geometry(zyx)
         n = int(np.prod(grid))
         pin = tuple(self.input_patch_size)
@@ -541,12 +544,16 @@ class Inferencer:
                 nchan -= 1
             import ml_dtypes
 
+            blank_dtype = {
+                "float32": np.float32,
+                "bfloat16": ml_dtypes.bfloat16,
+                "uint8": np.uint8,
+            }[self.output_dtype]
             out = Chunk.from_bbox(
                 chunk.bbox,
                 # match the real path's result dtype so a volume mixing
                 # blank and real chunks stays dtype-consistent
-                dtype=(np.float32 if self.output_dtype == "float32"
-                       else ml_dtypes.bfloat16),
+                dtype=blank_dtype,
                 nchannels=nchan,
                 voxel_size=chunk.voxel_size,
             )
@@ -560,12 +567,18 @@ class Inferencer:
         if self.shape_bucket is not None:
             run_zyx = tuple(self._bucketed_shape(orig_zyx))
 
-        grid = enumerate_patches(
-            run_zyx,
-            self.input_patch_size,
-            self.output_patch_size,
-            self.output_patch_overlap,
-        )
+        use_fold = self._use_fold(run_zyx)
+        grid = None
+        if not use_fold:
+            # the scatter grid; fold derives its own (and supports chunks
+            # thinner than the input patch via padding, which
+            # enumerate_patches rejects)
+            grid = enumerate_patches(
+                run_zyx,
+                self.input_patch_size,
+                self.output_patch_size,
+                self.output_patch_overlap,
+            )
 
         arr = chunk.array
         if not chunk.is_on_device:
@@ -587,7 +600,7 @@ class Inferencer:
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)
 
-        if self._use_fold(run_zyx):
+        if use_fold:
             result = self._run_fold(arr)
         elif self.sharding == "none":
             in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)

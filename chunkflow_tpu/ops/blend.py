@@ -13,6 +13,17 @@ from __future__ import annotations
 from typing import Callable, Tuple
 
 
+def stack_budget_bytes() -> int:
+    """Byte budget for patch stacks kept alive at once — shared by the
+    stacked scatter path and the fold path so the two gates never
+    diverge. Override with CHUNKFLOW_BLEND_STACK_MAX_GB."""
+    import os
+
+    return int(
+        float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2")) * 2**30
+    )
+
+
 def build_local_blend(
     forward: Callable,
     num_input_channels: int,
@@ -52,11 +63,7 @@ def build_local_blend(
     # Gated by predicted stack size so jumbo chunks (e.g. 108x2048x2048
     # production tasks, where the stack would be GBs next to a 5 GB output
     # buffer) fall back to per-batch accumulation inside the scan.
-    import os
-
-    stack_max_bytes = int(
-        float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2")) * 2**30
-    )
+    stack_max_bytes = stack_budget_bytes()
 
     _DNUMS4 = lax.ScatterDimensionNumbers(
         update_window_dims=(1, 2, 3, 4),
@@ -147,9 +154,14 @@ def normalize_blend(out, weight, dtype="float32"):
     """Reciprocal weight normalization; zero where nothing was predicted.
     ``dtype`` narrows the result inside the program (accumulation inputs
     stay float32) — the single place result dtype is decided for every
-    program builder."""
+    program builder. ``uint8`` quantizes [0,1] maps exactly like the
+    reference's save-time conversion (save_precomputed.py:90-92:
+    ``chunk *= 255`` then truncating astype)."""
     import jax.numpy as jnp
 
-    return jnp.where(
+    result = jnp.where(
         weight[None] > 0, out / jnp.maximum(weight[None], 1e-20), 0.0
-    ).astype(jnp.dtype(dtype))
+    )
+    if jnp.dtype(dtype) == jnp.uint8:
+        return (jnp.clip(result, 0.0, 1.0) * 255.0).astype(jnp.uint8)
+    return result.astype(jnp.dtype(dtype))

@@ -350,3 +350,39 @@ def test_output_dtype_bfloat16_all_sharding_modes(sharding):
         np.asarray(out.array, dtype=np.float32)[0],
         np.asarray(chunk.array), atol=0.01,
     )
+
+
+@pytest.mark.parametrize("blend", ["scatter", "fold"])
+def test_output_dtype_uint8_reference_quantization(blend):
+    """output_dtype=uint8 quantizes on device exactly like the
+    reference's save-time conversion: truncating (x*255).astype(uint8)
+    (reference save_precomputed.py:90-92)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        blend=blend,
+        output_dtype="uint8",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(9)
+    chunk = rng.random((8, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    assert out.dtype == np.uint8
+    want = (np.clip(chunk, 0, 1) * 255.0).astype(np.uint8)
+    # blend round-trip can move a value across a truncation boundary;
+    # allow 1 count of slack
+    assert np.abs(out[0].astype(np.int16) - want.astype(np.int16)).max() <= 1
+
+    with pytest.raises(ValueError, match="myelin"):
+        Inferencer(
+            input_patch_size=(4, 16, 16),
+            framework="identity",
+            output_dtype="uint8",
+            mask_myelin_threshold=0.3,
+        )

@@ -193,3 +193,26 @@ def test_fold_budget_fallback_and_sharding_conflict(monkeypatch):
             blend="fold",
             sharding="spatial",
         )
+
+
+def test_fold_thinner_than_patch():
+    """Chunks thinner than the input patch pad up and work under fold
+    (the scatter enumerate_patches path would reject them)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    assert inferencer.patch_grid_shape((3, 32, 32)) == (1, 3, 3)
+    rng = np.random.default_rng(8)
+    chunk = rng.random((3, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    assert out.shape == (1, 3, 32, 32)
+    np.testing.assert_allclose(out[0], chunk, atol=1e-5)

@@ -321,6 +321,24 @@ def bench_flagship_stream_bf16out():
                   output_dtype="bfloat16")
 
 
+@step("bench_tpu_fold_stream_u8")
+def bench_flagship_fold_stream_u8():
+    """Fold + pipeline + on-device uint8 quantization (quarter the D2H
+    bytes; exactly the reference's save-time conversion)."""
+    return _bench("0", "tpu", "bfloat16", 4, blend="fold", stream=5,
+                  output_dtype="uint8")
+
+
+@step("bench_jumbo_bf16")
+def bench_jumbo():
+    """Apples-to-apples with the reference's own headline task: its
+    1.66 Mvoxel/s TITAN X number is a 108x2048x2048 affinity cutout
+    (tests/data/log/*.json). Per-batch scan accumulate (the stack budget
+    gates the stacked/fold paths off at this size), bf16 results."""
+    return _bench("0", "tpu", "bfloat16", 4,
+                  chunk_size=(108, 2048, 2048), output_dtype="bfloat16")
+
+
 @step("entry_compile")
 def entry_compile():
     # pin the blend-kernel selection to auto (platform default) so the
@@ -344,7 +362,8 @@ def main():
              bench_flagship_scan, bench_parity_fold, bench_flagship_fold,
              check_pallas_oracle, bench_flagship_pallas, e2e_split,
              bench_flagship_stream, bench_flagship_stream_bf16out,
-             bench_flagship_fold_stream, entry_compile]
+             bench_flagship_fold_stream, bench_flagship_fold_stream_u8,
+             bench_jumbo, entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
     # a cool-down, e.g.:
