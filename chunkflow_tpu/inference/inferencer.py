@@ -566,6 +566,15 @@ class Inferencer:
         run_zyx = orig_zyx
         if self.shape_bucket is not None:
             run_zyx = tuple(self._bucketed_shape(orig_zyx))
+        if self.blend_mode == "fold":
+            # fold mode accepts chunks thinner than the input patch by
+            # padding; apply the min-patch pad BEFORE the budget gate so
+            # the scatter fallback keeps that property instead of
+            # crashing in enumerate_patches
+            run_zyx = tuple(
+                max(length, p)
+                for length, p in zip(run_zyx, tuple(self.input_patch_size))
+            )
 
         use_fold = self._use_fold(run_zyx)
         grid = None

@@ -216,3 +216,27 @@ def test_fold_thinner_than_patch():
     out = np.asarray(inferencer(Chunk(chunk)).array)
     assert out.shape == (1, 3, 32, 32)
     np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+
+
+def test_fold_thin_chunk_survives_budget_fallback(monkeypatch):
+    """Thin-chunk padding holds even when the stack budget forces the
+    scatter fallback (regression: enumerate_patches used to crash)."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.000001")
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    assert not inferencer._use_fold((4, 32, 32))
+    rng = np.random.default_rng(10)
+    chunk = rng.random((3, 32, 32)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(chunk)).array)
+    assert out.shape == (1, 3, 32, 32)
+    np.testing.assert_allclose(out[0], chunk, atol=1e-5)

@@ -207,6 +207,12 @@ def bench_flagship_scan():
     return _bench("0", "tpu", "bfloat16", 4, stack_gb=0)
 
 
+@step("bench_tpu_bf16_b8")
+def bench_flagship_b8():
+    """Batch-size A/B: deeper batches may fill the MXU better."""
+    return _bench("0", "tpu", "bfloat16", 8)
+
+
 @step("bench_parity_f32_fold")
 def bench_parity_fold():
     """Scatter-free parity-class fold blend (ops/fold_blend.py)."""
@@ -360,6 +366,7 @@ def main():
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
              fwd_tpu_variant, bench_flagship_xla, bench_parity_scan,
              bench_flagship_scan, bench_parity_fold, bench_flagship_fold,
+             bench_flagship_b8,
              check_pallas_oracle, bench_flagship_pallas, e2e_split,
              bench_flagship_stream, bench_flagship_stream_bf16out,
              bench_flagship_fold_stream, bench_flagship_fold_stream_u8,
