@@ -49,8 +49,6 @@ CONFIGS = [
      "pallas": "0"},
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "0"},
-    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
-     "pallas": "1"},
     # steady-state pipelined throughput (Inferencer.stream): chunk i+1's
     # program runs while chunk i's result rides D2H — the production
     # configuration (the reference's 1.66 number likewise amortizes fixed
@@ -72,6 +70,11 @@ CONFIGS = [
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "0", "stream": 5, "output_dtype": "uint8",
      "blend": "fold"},
+    # riskiest last: the pallas scatter-accumulate kernel (Mosaic
+    # constraints are hardware-only failures a timeout must not let
+    # shadow the configs above)
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "1"},
 ]
 
 

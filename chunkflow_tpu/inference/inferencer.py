@@ -178,15 +178,29 @@ class Inferencer:
             * self.shape_bucket
         ).maximum(self.input_patch_size)
 
+    def _run_shape(self, zyx) -> tuple:
+        """The shape actually executed for an incoming chunk shape:
+        bucketing, then (fold mode) a min-pad to one input patch so thin
+        chunks work in BOTH the fold path and its scatter budget
+        fallback. Shared by _infer and patch_grid_shape so the asserted
+        grid can never drift from the executed one."""
+        run = tuple(zyx)[-3:]
+        if self.shape_bucket is not None:
+            run = tuple(self._bucketed_shape(run))
+        if self.blend_mode == "fold":
+            run = tuple(
+                max(length, p)
+                for length, p in zip(run, tuple(self.input_patch_size))
+            )
+        return run
+
     def patch_grid_shape(self, chunk_shape) -> Tuple[int, int, int]:
         """Patches per axis for a chunk shape (reference --patch-num
         contract: the caller may assert the grid it planned for). Derived
         from the same enumerate_patches call the engine runs — including
         shape bucketing — so the asserted grid can never drift from the
         executed one."""
-        shape = tuple(chunk_shape)[-3:]
-        if self.shape_bucket is not None:
-            shape = tuple(self._bucketed_shape(shape))
+        shape = self._run_shape(chunk_shape)
         if self._use_fold(shape):
             _, grid_shape = self._fold_geometry(shape)
             return grid_shape
@@ -563,18 +577,7 @@ class Inferencer:
             return out
 
         orig_zyx = tuple(chunk.shape[-3:])
-        run_zyx = orig_zyx
-        if self.shape_bucket is not None:
-            run_zyx = tuple(self._bucketed_shape(orig_zyx))
-        if self.blend_mode == "fold":
-            # fold mode accepts chunks thinner than the input patch by
-            # padding; apply the min-patch pad BEFORE the budget gate so
-            # the scatter fallback keeps that property instead of
-            # crashing in enumerate_patches
-            run_zyx = tuple(
-                max(length, p)
-                for length, p in zip(run_zyx, tuple(self.input_patch_size))
-            )
+        run_zyx = self._run_shape(orig_zyx)
 
         use_fold = self._use_fold(run_zyx)
         grid = None

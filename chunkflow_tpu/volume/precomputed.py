@@ -180,11 +180,25 @@ class PrecomputedVolume:
         )
 
     def save(self, chunk: Chunk, mip: int = 0) -> None:
-        """Write a chunk at its global offset (czyx -> xyzc)."""
+        """Write a chunk at its global offset (czyx -> xyzc).
+
+        Dtype auto-conversion follows the reference
+        (save_precomputed.py:84-102): uint8 chunk -> float volume divides
+        by 255; float chunk -> uint8 volume multiplies by 255 (truncating
+        astype), so [0,1] probability/affinity maps land as full-range
+        greyscale instead of silently collapsing to {0, 1}.
+        """
         store = self._store(mip)
-        arr = np.asarray(chunk.array)
+        from chunkflow_tpu.chunk.base import as_native_dtype
+
+        arr = as_native_dtype(np.asarray(chunk.array))
         if arr.ndim == 3:
             arr = arr[None]
+        vol_dtype = np.dtype(self.dtype)
+        if np.issubdtype(vol_dtype, np.floating) and arr.dtype == np.uint8:
+            arr = arr.astype(vol_dtype) / np.array(255, vol_dtype)
+        elif vol_dtype == np.uint8 and arr.dtype.kind == "f":
+            arr = arr * 255.0
         arr = arr.astype(self.dtype, copy=False)
         arr_xyzc = np.transpose(arr, (3, 2, 1, 0))
         sl_xyz = tuple(reversed(chunk.bbox.slices))

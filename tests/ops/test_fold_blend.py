@@ -240,3 +240,21 @@ def test_fold_thin_chunk_survives_budget_fallback(monkeypatch):
     out = np.asarray(inferencer(Chunk(chunk)).array)
     assert out.shape == (1, 3, 32, 32)
     np.testing.assert_allclose(out[0], chunk, atol=1e-5)
+
+
+def test_patch_grid_shape_thin_chunk_budget_fallback(monkeypatch):
+    """patch_grid_shape must not crash (and must match execution) for
+    thin chunks when the budget forces the scatter fallback."""
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.000001")
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    assert inferencer.patch_grid_shape((3, 32, 32)) == (1, 3, 3)

@@ -125,3 +125,39 @@ def test_volume_reference_api_surface(tmp_path):
     assert tuple(vol.physical_bounding_box.voxel_size) == tuple(vol.voxel_size(0))
     back = np.asarray(vol.cutout(vol.bounding_box).array)
     assert (back == arr).all()
+
+
+def test_save_dtype_auto_convert(tmp_path):
+    """Reference _auto_convert_dtype semantics (save_precomputed.py:84-102):
+    float [0,1] chunks scale to full-range uint8 volumes (x255, truncating)
+    and uint8 chunks scale down into float volumes (/255)."""
+    pytest.importorskip("tensorstore")
+    import numpy as np
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "u8vol"
+    vol = PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    rng = np.random.default_rng(0)
+    data = rng.random((8, 16, 16)).astype(np.float32)
+    from chunkflow_tpu.core.bbox import BoundingBox
+
+    vol.save(Chunk(data))
+    back = vol.cutout(BoundingBox.from_delta((0, 0, 0), (8, 16, 16)))
+    want = (data * 255.0).astype(np.uint8)
+    np.testing.assert_array_equal(np.asarray(back.array), want)
+
+    froot = tmp_path / "f32vol"
+    fvol = PrecomputedVolume.create(
+        str(froot), volume_size=(8, 16, 16), dtype="float32",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    u8 = (data * 255).astype(np.uint8)
+    fvol.save(Chunk(u8))
+    fback = fvol.cutout(BoundingBox.from_delta((0, 0, 0), (8, 16, 16)))
+    np.testing.assert_allclose(
+        np.asarray(fback.array), u8.astype(np.float32) / 255.0, atol=1e-6)
