@@ -176,7 +176,7 @@ def run_config(cfg: dict) -> dict:
                 "pipelined_chunks": n_stream}
 
     times = []
-    for _ in range(3):
+    for _ in range(int(cfg.get("iters", 3))):
         start = time.perf_counter()
         out = inferencer(chunk)
         np.asarray(out.array)  # force host sync

@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from chunkflow_tpu.chunk.base import Chunk, LayerType
+from chunkflow_tpu.chunk.base import Chunk, LayerType, as_native_dtype
 from chunkflow_tpu.core.bbox import BoundingBox
 from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
 
@@ -189,8 +189,6 @@ class PrecomputedVolume:
         greyscale instead of silently collapsing to {0, 1}.
         """
         store = self._store(mip)
-        from chunkflow_tpu.chunk.base import as_native_dtype
-
         arr = as_native_dtype(np.asarray(chunk.array))
         if arr.ndim == 3:
             arr = arr[None]

@@ -1,0 +1,66 @@
+"""bench.py config-matrix smoke: every CONFIGS entry must run end-to-end
+(tiny shapes, CPU mesh) so breakage surfaces in CI, not in a scarce
+hardware window. The pallas config must fail loudly on a non-TPU backend
+rather than silently measuring the XLA path."""
+import numpy as np
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def tiny_bench(monkeypatch):
+    monkeypatch.setattr(bench, "INPUT_PATCH", (8, 32, 32))
+    monkeypatch.setattr(bench, "OUTPUT_OVERLAP", (2, 8, 8))
+    # keep env mutations from leaking into other tests
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    monkeypatch.delenv("CHUNKFLOW_BLEND_STACK_MAX_GB", raising=False)
+    return bench
+
+
+def test_all_nonpallas_configs_run(tiny_bench):
+    ran = 0
+    for cfg in tiny_bench.CONFIGS:
+        if cfg.get("pallas", "0") not in ("0", "off", "false"):
+            continue
+        cfg = dict(cfg, chunk_size=(16, 64, 64), batch_size=2, iters=1)
+        if cfg.get("stream"):
+            cfg["stream"] = 2
+        stats = tiny_bench.run_config(cfg)
+        assert stats["mvox_s"] > 0, cfg
+        ran += 1
+    assert ran >= 5
+
+
+def test_pallas_config_fails_loudly_on_cpu(tiny_bench):
+    cfg = dict(
+        next(
+            c for c in tiny_bench.CONFIGS
+            if c.get("pallas", "0") == "1"
+        ),
+        chunk_size=(16, 64, 64),
+        batch_size=2,
+    )
+    # CHUNKFLOW_PALLAS=1 force-enables the kernel even off-TPU (the real
+    # chip reports platform 'axon', so auto-detection can't be trusted);
+    # on CPU the kernel itself then fails in the pre-measurement oracle —
+    # either way the config errors instead of silently measuring XLA
+    with pytest.raises((RuntimeError, ValueError)):
+        tiny_bench.run_config(cfg)
+
+
+def test_cfg_names_unique():
+    names = [bench._cfg_name(c) for c in bench.CONFIGS]
+    assert len(names) == len(set(names)), names
+
+
+def test_cached_hardware_result_shape():
+    cached = bench._cached_hardware_result()
+    if cached is None:
+        pytest.skip("no committed hardware snapshots")
+    assert cached["unit"] == "Mvoxel/s/chip"
+    assert cached["cached"] is True
+    assert cached["value"] > 0
+    assert np.isclose(
+        cached["vs_baseline"], round(cached["value"] / 1.66, 2), atol=0.01
+    )
