@@ -3,7 +3,8 @@
 The TPU tunnel in this environment serves a single client at a time, takes
 minutes to acquire a device, and wedges if probed concurrently or killed
 mid-compile, so every hardware question is answered in ONE process, in
-priority order (cheapest first), with results appended to
+importance order (headline-class bench steps first, A/B diagnostics
+after — tunnel windows run ~25 min), with results appended to
 ``tools/tpu_validation.json`` as they arrive (a crash keeps earlier
 answers).  The persistent XLA compilation cache is enabled, so a completed
 run also warms the cache for the driver's later ``bench.py`` invocation.
@@ -363,13 +364,18 @@ def entry_compile():
 
 
 def main():
+    # Headline-class steps (the ones bench.py's CONFIGS measure, whose
+    # compiled programs the persistent cache must hold for the driver's
+    # bench run) come first: tunnel windows have been ~25 min, so a
+    # single window should bank the numbers that matter before the
+    # A/B diagnostics.
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
-             fwd_tpu_variant, bench_flagship_xla, bench_parity_scan,
-             bench_flagship_scan, bench_parity_fold, bench_flagship_fold,
-             bench_flagship_b8,
-             check_pallas_oracle, bench_flagship_pallas, e2e_split,
+             fwd_tpu_variant, bench_flagship_xla,
              bench_flagship_stream, bench_flagship_stream_bf16out,
              bench_flagship_fold_stream, bench_flagship_fold_stream_u8,
+             e2e_split, bench_parity_scan, bench_flagship_scan,
+             bench_parity_fold, bench_flagship_fold, bench_flagship_b8,
+             check_pallas_oracle, bench_flagship_pallas,
              bench_jumbo, entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
