@@ -2,8 +2,12 @@
 # Cooldown then retry loop for the TPU validation battery (resumable:
 # completed steps skip; a tunnel drop only costs the failed step).
 sleep "${BATTERY_COOLDOWN:-600}"
+attempts="${BATTERY_ATTEMPTS:-12}"
+case "$attempts" in
+    ''|*[!0-9]*|0) echo "invalid BATTERY_ATTEMPTS='$attempts'" >&2; exit 2;;
+esac
 rc=1
-for i in $(seq 12); do
+for i in $(seq "$attempts"); do
     echo "=== battery attempt $i $(date -u +%H:%M:%S) ===" >> tools/tpu_validation.log
     python tools/tpu_validation.py >> tools/tpu_validation.log 2>&1
     rc=$?

@@ -1,0 +1,48 @@
+"""Render tools/tpu_validation*.json into a markdown table (docs aid).
+
+Usage: python tools/summarize_validation.py [path ...]
+Defaults to tools/tpu_validation.json.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import BASELINE_MVOX_S as BASELINE  # noqa: E402
+
+
+def summarize(path: str) -> None:
+    with open(path) as f:
+        data = json.load(f)
+    print(f"### {os.path.basename(path)}\n")
+    print("| step | result |")
+    print("|---|---|")
+    for step, payload in data.items():
+        if not isinstance(payload, dict):
+            continue
+        if not payload.get("ok"):
+            err = (payload.get("error") or "").strip().splitlines()
+            tail = err[-1][:80] if err else "?"
+            print(f"| {step} | FAILED ({tail}) |")
+            continue
+        value = payload.get("value")
+        if isinstance(value, dict) and "mvox_s" in value:
+            mv = value["mvox_s"]
+            extra = ", ".join(
+                f"{k}={v}" for k, v in value.items() if k != "mvox_s"
+            )
+            print(
+                f"| {step} | **{mv} Mvox/s** ({mv / BASELINE:.2f}x baseline"
+                f"{'; ' + extra if extra else ''}) |"
+            )
+        else:
+            print(f"| {step} | {json.dumps(value)[:100]} |")
+    print()
+
+
+if __name__ == "__main__":
+    paths = sys.argv[1:] or [
+        os.path.join(os.path.dirname(__file__), "tpu_validation.json")
+    ]
+    for p in paths:
+        summarize(p)

@@ -278,9 +278,8 @@ def e2e_split():
     from chunkflow_tpu.inference import Inferencer
 
     os.environ["CHUNKFLOW_PALLAS"] = "0"
-    # resumed batteries can arrive here with the *_scan steps' 0 budget
-    # still in the env; this step's split is attributed to the stacked
-    # flagship config, so pin the default path
+    # defensive: this split is attributed to the stacked flagship config,
+    # so pin the default budget regardless of what ran before
     os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
     inferencer = Inferencer(
         input_patch_size=bench.INPUT_PATCH,
@@ -364,11 +363,11 @@ def entry_compile():
 
 
 def main():
-    # Headline-class steps (the ones bench.py's CONFIGS measure, whose
-    # compiled programs the persistent cache must hold for the driver's
-    # bench run) come first: tunnel windows have been ~25 min, so a
-    # single window should bank the numbers that matter before the
-    # A/B diagnostics.
+    # Headline-class steps (bench.py's XLA-blend CONFIGS, whose compiled
+    # programs the persistent cache must hold for the driver's bench run)
+    # come first: tunnel windows have been ~25 min, so a single window
+    # should bank the numbers that matter before the A/B diagnostics.
+    # The pallas config stays riskiest-last on purpose.
     steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
              fwd_tpu_variant, bench_flagship_xla,
              bench_flagship_stream, bench_flagship_stream_bf16out,
