@@ -861,7 +861,9 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
         if state.dry_run:
             return task
         if (intensity_threshold is not None
-                and float(np.asarray(chunk.array).max()) < intensity_threshold):
+                # reduce on device when HBM-resident: only the scalar
+                # crosses D2H (np.asarray would pull the whole chunk)
+                and float(chunk.array.max()) < intensity_threshold):
             print(f"skip save: max intensity below {intensity_threshold}")
             return task
         vol.save(chunk, mip=mip if mip is not None else state.mip)

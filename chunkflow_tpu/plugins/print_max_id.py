@@ -3,4 +3,4 @@ import numpy as np
 
 
 def execute(chunk):
-    print(f"max id: {int(np.asarray(chunk.array).max())}")
+    print(f"max id: {int(chunk.array.max())}")
