@@ -429,6 +429,11 @@ def delete_task_cmd(op_name, ):
 
     @operator
     def stage(task):
+        from chunkflow_tpu.flow.runtime import drain_pending_writes
+
+        # the ack commits the task: every async write must be durable
+        # first (--async-write saves attach futures to the task)
+        drain_pending_writes(task)
         queue = task.get("queue")
         if queue is not None and not state.dry_run:
             queue.delete(task["task_handle"])
@@ -843,9 +848,15 @@ def _validate_cutout(vol, chunk, mip, validate_mip, tolerance=0.01):
 @click.option("--parallel", type=int, default=1,
               help="accepted for reference compatibility; tensorstore "
                    "already writes blocks concurrently")
+@click.option("--async-write/--sync-write", default=False,
+              help="don't block on the storage commit: the write future "
+                   "rides the task and is drained before the task ack "
+                   "(delete-task-in-queue / mark-complete / pipeline "
+                   "end), so ack-after-durable-write still holds while "
+                   "the next task's compute overlaps this task's upload")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail,
-                         intensity_threshold, parallel,
+                         intensity_threshold, parallel, async_write,
                          input_chunk_name):
     """Write the chunk to a precomputed volume (+ timing log sidecar)."""
     import json
@@ -866,7 +877,13 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
                 and float(chunk.array.max()) < intensity_threshold):
             print(f"skip save: max intensity below {intensity_threshold}")
             return task
-        vol.save(chunk, mip=mip if mip is not None else state.mip)
+        future = vol.save(
+            chunk,
+            mip=mip if mip is not None else state.mip,
+            wait=not async_write,
+        )
+        if future is not None:
+            task.setdefault("pending_writes", []).append(future)
         if create_thumbnail:
             from chunkflow_tpu.ops.downsample import pyramid
 
@@ -1381,6 +1398,10 @@ def mark_complete_cmd(op_name, prefix, suffix):
 
     @operator
     def stage(task):
+        from chunkflow_tpu.flow.runtime import drain_pending_writes
+
+        # the marker claims completion: async writes must be durable first
+        drain_pending_writes(task)
         if not state.dry_run:
             os.makedirs(os.path.dirname(prefix) or ".", exist_ok=True)
             with open(f"{prefix}{task['bbox'].string}{suffix}", "w"):

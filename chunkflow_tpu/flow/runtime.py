@@ -32,6 +32,18 @@ class PipelineState:
         self.operators: Dict[str, object] = {}
 
 
+def drain_pending_writes(task: Optional[dict]) -> None:
+    """Block until every async storage write attached to the task is
+    durable. Barrier points: task ack (delete-task-in-queue,
+    mark-complete) and end-of-pipeline — the ack-after-durable-write
+    commit protocol must hold even with ``save-precomputed
+    --async-write``."""
+    if not task:
+        return
+    for future in task.pop("pending_writes", []):
+        future.result()
+
+
 def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
     """Wire stage callables into one generator chain and drain it.
 
@@ -44,6 +56,7 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
     count = 0
     for task in stream:
         count += 1
+        drain_pending_writes(task)
         if verbose and task is not None and task.get("log"):
             timers = task["log"]["timer"]
             total = sum(timers.values())
@@ -67,9 +80,15 @@ def operator(func: Callable) -> Callable:
             for task in stream:
                 if task is not None:
                     start = time.time()
+                    original = task
                     task = func(task, **kwargs)
                     if task is not None:
                         task["log"]["timer"][name] = time.time() - start
+                    else:
+                        # skip ops return None and downstream barriers
+                        # never see the task — async write futures must
+                        # not be abandoned un-durable
+                        drain_pending_writes(original)
                 yield task
 
         return stage

@@ -179,7 +179,7 @@ class PrecomputedVolume:
             layer_type=self.layer_type,
         )
 
-    def save(self, chunk: Chunk, mip: int = 0) -> None:
+    def save(self, chunk: Chunk, mip: int = 0, wait: bool = True):
         """Write a chunk at its global offset (czyx -> xyzc).
 
         Dtype auto-conversion follows the reference
@@ -187,6 +187,12 @@ class PrecomputedVolume:
         by 255; float chunk -> uint8 volume multiplies by 255 (truncating
         astype), so [0,1] probability/affinity maps land as full-range
         greyscale instead of silently collapsing to {0, 1}.
+
+        With ``wait=False`` the blocking commit is skipped and the
+        tensorstore write future is returned — the caller OWNS the
+        barrier (the CLI drains futures before the task ack so the
+        ack-after-durable-write protocol holds; see
+        runtime.drain_pending_writes).
         """
         store = self._store(mip)
         arr = as_native_dtype(np.asarray(chunk.array))
@@ -200,7 +206,16 @@ class PrecomputedVolume:
         arr = arr.astype(self.dtype, copy=False)
         arr_xyzc = np.transpose(arr, (3, 2, 1, 0))
         sl_xyz = tuple(reversed(chunk.bbox.slices))
-        store[sl_xyz + (slice(None),)] = arr_xyzc
+        future = store[sl_xyz + (slice(None),)].write(arr_xyzc)
+        if wait:
+            future.result()
+            return None
+        # await the COPY leg (tensorstore reading the source buffer,
+        # which may alias chunk.array when no conversion was needed) so
+        # callers may freely reuse/mutate the chunk; only the storage
+        # COMMIT stays asynchronous until the drain barrier
+        future.copy.result()
+        return future
 
     # ------------------------------------------------------------------
     def block_names(self, bbox: BoundingBox, mip: int = 0) -> List[str]:

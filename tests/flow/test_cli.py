@@ -755,3 +755,93 @@ def test_inference_async_depth_with_explicit_crop(runner, tmp_path):
         # cropped offset must be preserved through the async path
         np.testing.assert_array_equal(
             a["voxel_offset"][:], b["voxel_offset"][:])
+
+
+def test_save_precomputed_async_write_pipeline(runner, tmp_path):
+    """--async-write: futures drain at the pipeline-end barrier, and the
+    stored bytes match a sync run."""
+    pytest.importorskip("tensorstore")
+    import numpy as np
+
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    roots = []
+    for mode in ("--sync-write", "--async-write"):
+        root = tmp_path / f"vol{mode}"
+        PrecomputedVolume.create(
+            str(root), volume_size=(8, 16, 16), dtype="uint8",
+            voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+        )
+        result = runner.invoke(main, [
+            "generate-tasks", "-c", "8", "16", "16",
+            "--roi-stop", "8", "16", "16",
+            "create-chunk", "--size", "8", "16", "16", "--pattern", "sin",
+            "save-precomputed", "-v", str(root), mode,
+        ])
+        assert result.exit_code == 0, result.output
+        roots.append(root)
+    from chunkflow_tpu.core.bbox import BoundingBox as BB
+
+    a = PrecomputedVolume(str(roots[0])).cutout(
+        BB.from_delta((0, 0, 0), (8, 16, 16)))
+    b = PrecomputedVolume(str(roots[1])).cutout(
+        BB.from_delta((0, 0, 0), (8, 16, 16)))
+    np.testing.assert_array_equal(np.asarray(a.array), np.asarray(b.array))
+    assert np.asarray(b.array).any()
+
+
+def test_async_write_drained_before_queue_ack(runner, tmp_path):
+    pytest.importorskip("tensorstore")
+    import numpy as np
+
+    from chunkflow_tpu.parallel.queues import open_queue
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "qvol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    qdir = str(tmp_path / "queue")
+    run_ok(runner, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16", "--queue-name", qdir,
+    ])
+    run_ok(runner, [
+        "fetch-task-from-queue", "-q", qdir,
+        "create-chunk", "--size", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--async-write",
+        "delete-task-in-queue",
+    ])
+    assert len(open_queue(qdir)) == 0  # acked
+    from chunkflow_tpu.core.bbox import BoundingBox as BB
+
+    out = PrecomputedVolume(str(root)).cutout(
+        BB.from_delta((0, 0, 0), (8, 16, 16)))
+    assert np.asarray(out.array).any()  # durable before/at ack
+
+
+def test_async_write_drained_when_task_skipped(runner, tmp_path):
+    """A downstream skip (task -> None) must not abandon async write
+    futures: the operator wrapper drains them."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.core.bbox import BoundingBox as BB
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "skipvol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    # save async, then delete the chunk and skip-none nulls the task
+    run_ok(runner, [
+        "generate-tasks", "-c", "8", "16", "16",
+        "--roi-stop", "8", "16", "16",
+        "create-chunk", "--size", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--async-write",
+        "delete-var", "-v", "chunk",
+        "skip-none",
+    ])
+    out = PrecomputedVolume(str(root)).cutout(
+        BB.from_delta((0, 0, 0), (8, 16, 16)))
+    assert np.asarray(out.array).any()

@@ -161,3 +161,25 @@ def test_save_dtype_auto_convert(tmp_path):
     fback = fvol.cutout(BoundingBox.from_delta((0, 0, 0), (8, 16, 16)))
     np.testing.assert_allclose(
         np.asarray(fback.array), u8.astype(np.float32) / 255.0, atol=1e-6)
+
+
+def test_save_async_future_and_barrier(tmp_path):
+    """wait=False returns a write future; data is durable after
+    .result() and matches the sync path."""
+    import numpy as np
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "avol"
+    vol = PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="float32",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    rng = np.random.default_rng(1)
+    data = rng.random((8, 16, 16)).astype(np.float32)
+    future = vol.save(Chunk(data), wait=False)
+    assert future is not None
+    future.result()
+    back = vol.cutout(BoundingBox.from_delta((0, 0, 0), (8, 16, 16)))
+    np.testing.assert_allclose(np.asarray(back.array), data, atol=1e-6)
