@@ -27,6 +27,7 @@ Design deltas from the reference, on purpose:
 from __future__ import annotations
 
 import itertools
+import sys
 import time
 from typing import Optional, Tuple
 
@@ -315,15 +316,23 @@ class Inferencer:
         from chunkflow_tpu.ops.blend import stack_budget_bytes
 
         budget = stack_budget_bytes()
-        _, grid = self._fold_geometry(zyx)
+        padded, grid = self._fold_geometry(zyx)
         n = int(np.prod(grid))
         pin = tuple(self.input_patch_size)
         pout = tuple(self.output_patch_size)
+        co = self.num_output_channels
+        # per patch: the input-patch stack, the prediction stack, its
+        # bump-weighted float32 copy (fold materializes both), and the
+        # weight-patch stack
         per_patch = 4 * (
-            self.num_input_channels * int(np.prod(pin))     # patch stack
-            + (self.num_output_channels + 1) * int(np.prod(pout))  # preds+w
+            self.num_input_channels * int(np.prod(pin))
+            + (2 * co + 1) * int(np.prod(pout))
         )
-        return n * per_patch <= budget
+        # fixed: the parity-class accumulation buffers — two (co+1)-channel
+        # float32 volumes at the padded shape (out+weight, double-buffered
+        # across the dense adds)
+        fixed = 8 * (co + 1) * int(np.prod(padded))
+        return n * per_patch + fixed <= budget
 
     def _run_fold(self, arr):
         """Static-geometry scatter-free path (ops/fold_blend.py): pad to
@@ -580,6 +589,16 @@ class Inferencer:
         run_zyx = self._run_shape(orig_zyx)
 
         use_fold = self._use_fold(run_zyx)
+        if self.blend_mode == "fold" and not use_fold:
+            # loud, not silent: numbers measured under this config belong
+            # to the scatter fallback, not fold (same misattribution
+            # guard as the pallas/fold selection errors)
+            print(
+                f"fold blend gated off for shape {run_zyx}: patch stacks "
+                f"exceed CHUNKFLOW_BLEND_STACK_MAX_GB; using per-batch "
+                f"scatter fallback",
+                file=sys.stderr,
+            )
         grid = None
         if not use_fold:
             # the scatter grid; fold derives its own (and supports chunks

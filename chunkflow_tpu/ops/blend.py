@@ -3,8 +3,8 @@
 This is the pure function version of the hot loop (reference inferencer.py
 :404-455 + chunk/base.py:792-807, redesigned as one XLA program): scan over
 patch batches, vmap(dynamic_slice) gather, engine forward, bump multiply,
-then one ``lax.scatter_add`` per buffer per batch (or the pallas DMA kernel
-on TPU backends) to accumulate into the output + weight buffers.
+then one ``lax.scatter_add`` per buffer per batch (or, opt-in, the pallas
+DMA kernel) to accumulate into the output + weight buffers.
 ``Inferencer`` runs it per chip; ``parallel.distributed`` wraps it in
 shard_map and psums the buffers over the mesh.
 """
@@ -14,13 +14,31 @@ from typing import Callable, Tuple
 
 
 def stack_budget_bytes() -> int:
-    """Byte budget for patch stacks kept alive at once — shared by the
-    stacked scatter path and the fold path so the two gates never
-    diverge. Override with CHUNKFLOW_BLEND_STACK_MAX_GB."""
+    """Byte budget for patch stacks kept alive at once — a memory-fit
+    gate shared by the (opt-in) stacked scatter path and the fold path so
+    the two never diverge. Override with CHUNKFLOW_BLEND_STACK_MAX_GB.
+    Default 4 GiB: ~1/4 of a v5e chip's 16 GB HBM, sized so the
+    production-style 64x512x512 fold program (~2.4 GiB with its
+    accumulation buffers) fits while jumbo 108x2048x2048 tasks (tens of
+    GiB of stacks) fall back to per-batch scan accumulation."""
     import os
 
     return int(
-        float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "2")) * 2**30
+        float(os.environ.get("CHUNKFLOW_BLEND_STACK_MAX_GB", "4")) * 2**30
+    )
+
+
+def stacked_scatter_enabled() -> bool:
+    """Whether the stacked single-trailing-scatter accumulation may be
+    selected. Default OFF: on the real chip the stacked path measured
+    0.66 Mvox/s vs 1.48 for the per-batch scatter it replaced (the 36
+    overlapping runtime-coordinate scatter windows serialize on TPU —
+    docs/performance.md table), so the measured winner is the default and
+    the stack is opt-in via CHUNKFLOW_BLEND_STACKED=1 for re-measurement."""
+    import os
+
+    return os.environ.get("CHUNKFLOW_BLEND_STACKED", "0").lower() not in (
+        "0", "", "off", "false"
     )
 
 
@@ -57,13 +75,14 @@ def build_local_blend(
     )
 
     # Stacking every weighted prediction and accumulating ONCE (vs once per
-    # scan batch) removes the last per-batch full-buffer traffic: the scan
-    # then carries nothing, its stacked output is written in place, and the
-    # single trailing scatter/pallas-call touches each output window once.
-    # Gated by predicted stack size so jumbo chunks (e.g. 108x2048x2048
-    # production tasks, where the stack would be GBs next to a 5 GB output
-    # buffer) fall back to per-batch accumulation inside the scan.
+    # scan batch) removes the per-batch full-buffer traffic on paper — but
+    # on the real chip it measured 0.66 Mvox/s vs 1.48 for the per-batch
+    # scatter (overlapping runtime-coordinate scatter windows serialize),
+    # so it is OPT-IN (CHUNKFLOW_BLEND_STACKED=1) and additionally gated by
+    # predicted stack size so jumbo chunks (e.g. 108x2048x2048 production
+    # tasks) cannot OOM HBM even when opted in.
     stack_max_bytes = stack_budget_bytes()
+    use_stacked = stacked_scatter_enabled()
 
     _DNUMS4 = lax.ScatterDimensionNumbers(
         update_window_dims=(1, 2, 3, 4),
@@ -115,7 +134,7 @@ def build_local_blend(
             preds = forward(params, patches)
             return preds * bump[None, None] * v[:, None, None, None, None]
 
-        if n * patch_bytes <= stack_max_bytes:
+        if use_stacked and n * patch_bytes <= stack_max_bytes:
             _, all_w = lax.scan(
                 lambda c, b: (c, forward_batch(b)),
                 None,

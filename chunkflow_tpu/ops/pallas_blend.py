@@ -18,8 +18,8 @@ plus the same for the weight buffer. The XLA path expresses it as one
   (chunk/base.py:792-807) and the XLA path gets from scatter-add's
   defined duplicate-index semantics.
 
-Selection: ``blend.build_local_blend`` uses this kernel on TPU backends
-(opt out with CHUNKFLOW_PALLAS=0); tests run it in interpret mode on CPU
+Selection: opt-in via CHUNKFLOW_PALLAS=1 (unmeasured paths don't get to be
+defaults — see pallas_mode); tests run it in interpret mode on CPU
 (CHUNKFLOW_PALLAS=interpret).
 """
 from __future__ import annotations
@@ -31,35 +31,26 @@ Triple = Tuple[int, int, int]
 
 
 def pallas_mode() -> str:
-    """'on' | 'off' | 'interpret' — resolved from env + backend.
+    """'on' | 'off' | 'interpret' — resolved from env.
 
     An explicit truthy CHUNKFLOW_PALLAS ('1'/'on'/'force') force-enables the
     kernel regardless of platform string: the real chip in this environment
     reports platform 'axon' (a tunneled TPU PJRT plugin), not 'tpu', so a
     literal backend-name check would leave the kernel permanently inert on
-    the actual target hardware.  Auto mode (unset env) enables on any
-    TPU-like platform.
+    the actual target hardware.  Auto mode (unset env) resolves to OFF even
+    on TPU: the kernel compiles and passes its oracle on the chip but has
+    no steady-state throughput number yet, and the measured-winner rule
+    (docs/performance.md — never ship an unmeasured blend path as default)
+    applies until bench_tpu_bf16_pallas beats the XLA scatter on hardware.
     """
     env = os.environ.get("CHUNKFLOW_PALLAS", "").lower()
-    if env in ("0", "off", "false"):
-        return "off"
     if env == "interpret":
         return "interpret"
     if env in ("1", "on", "true", "force"):
         return "on"
-    return "on" if _tpu_like_backend() else "off"
-
-
-def _tpu_like_backend() -> bool:
-    import jax
-
-    try:
-        dev = jax.devices()[0]
-    except Exception:
-        return False
-    platform = getattr(dev, "platform", "")
-    kind = getattr(dev, "device_kind", "").lower()
-    return platform in ("tpu", "axon") or "tpu" in kind
+    # everything else — unset, explicit off, or a typo — is off: a typo
+    # must not force-select the compiled Mosaic kernel on a CPU box
+    return "off"
 
 
 # Mosaic tiling of the two minor dims: DMA slice offsets into a tiled HBM

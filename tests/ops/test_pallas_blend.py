@@ -45,9 +45,20 @@ def test_pallas_identity_oracle(monkeypatch, shape):
 
 
 @pytest.mark.parametrize("mode", ["0", "interpret"])
-def test_blend_per_batch_fallback_matches_stacked(monkeypatch, mode):
-    """Jumbo-chunk fallback (per-batch accumulation inside the scan) must
-    agree with the default stacked single-accumulation path."""
+def test_blend_stacked_optin_matches_per_batch_default(monkeypatch, mode):
+    """The opt-in stacked single-accumulation (CHUNKFLOW_BLEND_STACKED=1,
+    kept for hardware A/B) must agree with the per-batch default."""
+    _, ref = _run_identity(monkeypatch, mode, (9, 35, 33))
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACKED", "1")
+    _, got = _run_identity(monkeypatch, mode, (9, 35, 33))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["0", "interpret"])
+def test_blend_stacked_budget_fallback(monkeypatch, mode):
+    """Even when opted in, an over-budget stack falls back to per-batch
+    accumulation (the jumbo-chunk OOM guard) with identical results."""
+    monkeypatch.setenv("CHUNKFLOW_BLEND_STACKED", "1")
     _, ref = _run_identity(monkeypatch, mode, (9, 35, 33))
     monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.0000001")
     _, got = _run_identity(monkeypatch, mode, (9, 35, 33))
