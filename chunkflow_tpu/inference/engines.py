@@ -78,7 +78,9 @@ def create_flax_engine(
     (converted) or an orbax/msgpack flax checkpoint. ``model_variant``:
     'parity' is the reference-class UNet; 'rsunet' the production RSUNet
     mirror (models/rsunet.py); 'tpu' the space-to-depth flagship
-    (unet3d.create_tpu_optimized_model).
+    (unet3d.create_tpu_optimized_model); 'tpu_mxu' the same flagship with
+    every conv lowered as z-decomposed 2D convs / GEMM upsampling
+    (identical parameters, different XLA lowering).
     """
     from chunkflow_tpu.models import rsunet, unet3d
 
@@ -89,11 +91,14 @@ def create_flax_engine(
 
     if module is not None and hasattr(module, "create_model"):
         model = module.create_model(num_input_channels, num_output_channels)
-    elif model_variant == "tpu":
+    elif model_variant in ("tpu", "tpu_mxu"):
         model = unet3d.create_tpu_optimized_model(
             in_channels=num_input_channels,
             out_channels=num_output_channels,
             dtype=compute_dtype,
+            # same parameters, different XLA lowering (z-decomposed 2D
+            # convs + GEMM upsampling) — see unet3d.MxuConv
+            conv_impl="mxu" if model_variant == "tpu_mxu" else "native",
         )
     elif model_variant == "rsunet":
         model = rsunet.RSUNet(

@@ -25,25 +25,36 @@ import numpy as np
 Triple = Tuple[int, int, int]
 
 
+def _make_conv(conv_impl: str, features: int, kernel_size: Triple,
+               dtype, name: str):
+    """nn.Conv or its MXU-lowered twin — identical parameter trees, so
+    ``conv_impl`` is a pure lowering choice (checkpoints interchange)."""
+    if conv_impl == "mxu":
+        return MxuConv(features, kernel_size, dtype=dtype, name=name)
+    return nn.Conv(features, kernel_size, padding="SAME", dtype=dtype,
+                   name=name)
+
+
 class ConvBlock(nn.Module):
     """Two 3x3x3 convs with instance norm + elu, residual add."""
 
     features: int
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "native"
 
     @nn.compact
     def __call__(self, x):
         # submodule names mirror the torch conventions (conv1/norm1/...)
         # so checkpoint conversion can pair parameters by name
         residual = x
-        x = nn.Conv(self.features, (3, 3, 3), padding="SAME",
-                    dtype=self.dtype, name="conv1")(x)
+        x = _make_conv(self.conv_impl, self.features, (3, 3, 3),
+                       self.dtype, "conv1")(x)
         x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
                          dtype=self.dtype, use_fast_variance=False,
                          name="norm1")(x)
         x = nn.elu(x)
-        x = nn.Conv(self.features, (3, 3, 3), padding="SAME",
-                    dtype=self.dtype, name="conv2")(x)
+        x = _make_conv(self.conv_impl, self.features, (3, 3, 3),
+                       self.dtype, "conv2")(x)
         x = nn.GroupNorm(num_groups=None, group_size=1, epsilon=1e-5,
                          dtype=self.dtype, use_fast_variance=False,
                          name="norm2")(x)
@@ -72,6 +83,93 @@ def depth_to_space(x, factor: Triple):
     return x.reshape(b, d * fz, h * fy, w * fx, cout)
 
 
+class MxuConv(nn.Module):
+    """Drop-in for ``nn.Conv(features, kernel_size, padding='SAME')`` with
+    an identical parameter tree, lowered as z-decomposed 2D convolutions.
+
+    XLA's native Conv3D lowering on TPU underuses the MXU (~3-4% of bf16
+    peak measured on the flagship, tools/profile_r03); a (kz, ky, kx) conv
+    is mathematically the sum of kz z-shifted (ky, kx) 2D convs, and 2D
+    convs with depth merged into batch hit the battle-tested conv2d path.
+    Same FLOPs, same parameters (kernel [kz,ky,kx,Cin,F] + bias), same
+    numerics up to float reassociation — asserted by
+    tests/inference/test_mxu_conv.py; A/B'd on chip by fwd_tpu_mxu."""
+
+    features: int
+    kernel_size: Triple
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from jax import lax
+
+        kz, ky, kx = self.kernel_size
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kz, ky, kx, cin, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        x = x.astype(self.dtype)
+        k = jnp.asarray(kernel, self.dtype)
+        b, d, h, w, _ = x.shape
+        if kz > 1:
+            # flax SAME padding: lo=(k-1)//2, hi=k//2
+            x = jnp.pad(x, ((0, 0), ((kz - 1) // 2, kz // 2),
+                            (0, 0), (0, 0), (0, 0)))
+        acc = None
+        for dz in range(kz):
+            xs = lax.slice_in_dim(x, dz, dz + d, axis=1)
+            y = lax.conv_general_dilated(
+                xs.reshape(b * d, h, w, cin),
+                k[dz],
+                window_strides=(1, 1),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            acc = y if acc is None else acc + y
+        acc = acc.reshape(b, d, h, w, self.features)
+        return acc + jnp.asarray(bias, self.dtype)
+
+
+class MxuConvTranspose(nn.Module):
+    """Drop-in for ``nn.ConvTranspose(features, k, strides=k)`` (the
+    kernel==strides upsampling used by the decoder) with an identical
+    parameter tree, lowered as one 1x1x1 GEMM + depth_to_space.
+
+    With kernel == strides the transposed conv's output blocks never
+    overlap: each input position emits an independent (fz, fy, fx, F)
+    block — i.e. a pure channel matmul (MXU-native) followed by a lossless
+    pixel shuffle, instead of XLA's general gradient-conv lowering."""
+
+    features: int
+    factor: Triple
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        fz, fy, fx = self.factor
+        cin = x.shape[-1]
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (fz, fy, fx, cin, self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(),
+                          (self.features,))
+        x = x.astype(self.dtype)
+        # lax.conv_transpose places the spatially FLIPPED kernel in each
+        # output block (verified with a one-hot probe), so flip to match
+        # nn.ConvTranspose exactly — checkpoints must interchange
+        k = jnp.asarray(kernel, self.dtype)[::-1, ::-1, ::-1]
+        # [fz,fy,fx,Cin,F] -> [Cin, fz*fy*fx*F] with channel order
+        # (i, j, k, f) — exactly what depth_to_space expects
+        k2 = k.transpose(3, 0, 1, 2, 4).reshape(cin, fz * fy * fx * self.features)
+        y = x @ k2
+        y = depth_to_space(y, self.factor)
+        return y + jnp.asarray(bias, self.dtype)
+
+
 class UNet3D(nn.Module):
     """Symmetric residual 3D UNet, channels-last.
 
@@ -97,6 +195,7 @@ class UNet3D(nn.Module):
     dtype: jnp.dtype = jnp.float32
     final_activation: str = "sigmoid"
     s2d_factor: Optional[Triple] = None
+    conv_impl: str = "native"  # "native" (XLA Conv3D) | "mxu" (2D/GEMM)
 
     @nn.compact
     def __call__(self, x):
@@ -104,17 +203,18 @@ class UNet3D(nn.Module):
         x = x.astype(self.dtype)
         depth = len(self.feature_maps)
         assert len(self.down_factors) == depth - 1
+        assert self.conv_impl in ("native", "mxu"), self.conv_impl
 
         if self.s2d_factor is not None:
             x = space_to_depth(x, self.s2d_factor)
 
-        x = nn.Conv(self.feature_maps[0], (1, 5, 5), padding="SAME",
-                    dtype=self.dtype, name="conv_in")(x)
+        x = _make_conv(self.conv_impl, self.feature_maps[0], (1, 5, 5),
+                       self.dtype, "conv_in")(x)
 
         skips = []
         for i in range(depth - 1):
             x = ConvBlock(self.feature_maps[i], dtype=self.dtype,
-                          name=f"enc{i}")(x)
+                          conv_impl=self.conv_impl, name=f"enc{i}")(x)
             skips.append(x)
             x = nn.max_pool(
                 x,
@@ -122,27 +222,37 @@ class UNet3D(nn.Module):
                 strides=self.down_factors[i],
             )
 
-        x = ConvBlock(self.feature_maps[-1], dtype=self.dtype, name="bridge")(x)
+        x = ConvBlock(self.feature_maps[-1], dtype=self.dtype,
+                      conv_impl=self.conv_impl, name="bridge")(x)
 
         for i in reversed(range(depth - 1)):
-            x = nn.ConvTranspose(
-                self.feature_maps[i],
-                kernel_size=self.down_factors[i],
-                strides=self.down_factors[i],
-                dtype=self.dtype,
-                name=f"up{i}",
-            )(x)
+            if self.conv_impl == "mxu":
+                x = MxuConvTranspose(
+                    self.feature_maps[i],
+                    factor=self.down_factors[i],
+                    dtype=self.dtype,
+                    name=f"up{i}",
+                )(x)
+            else:
+                x = nn.ConvTranspose(
+                    self.feature_maps[i],
+                    kernel_size=self.down_factors[i],
+                    strides=self.down_factors[i],
+                    dtype=self.dtype,
+                    name=f"up{i}",
+                )(x)
             x = x + skips[i]
             x = ConvBlock(self.feature_maps[i], dtype=self.dtype,
-                          name=f"dec{i}")(x)
+                          conv_impl=self.conv_impl, name=f"dec{i}")(x)
 
         if self.s2d_factor is None:
-            x = nn.Conv(self.out_channels, (1, 5, 5), padding="SAME",
-                        dtype=self.dtype, name="conv_out")(x)
+            x = _make_conv(self.conv_impl, self.out_channels, (1, 5, 5),
+                           self.dtype, "conv_out")(x)
         else:
             fz, fy, fx = self.s2d_factor
-            x = nn.Conv(self.out_channels * fz * fy * fx, (1, 5, 5),
-                        padding="SAME", dtype=self.dtype, name="conv_out")(x)
+            x = _make_conv(self.conv_impl,
+                           self.out_channels * fz * fy * fx, (1, 5, 5),
+                           self.dtype, "conv_out")(x)
             x = depth_to_space(x, self.s2d_factor)
         x = x.astype(jnp.float32)
         if self.final_activation == "sigmoid":
@@ -158,6 +268,7 @@ def create_tpu_optimized_model(
     in_channels: int = 1,
     out_channels: int = 3,
     dtype=jnp.bfloat16,
+    conv_impl: str = "native",
 ) -> "UNet3D":
     """The flagship affinity model tuned for the MXU.
 
@@ -166,6 +277,11 @@ def create_tpu_optimized_model(
     per-voxel FLOPs are identical (56^2 / 4 == 28^2) but convs run with
     56-128 channels instead of 28, so the 128-lane systolic array stays
     busy; compute in bfloat16 with float32 params and output.
+
+    ``conv_impl='mxu'`` additionally lowers every conv as z-decomposed 2D
+    convs / GEMM upsampling (MxuConv / MxuConvTranspose) — identical
+    parameters and numerics, different XLA lowering; selected per the
+    measured-winner rule once the fwd_tpu_mxu battery step has a number.
     """
     return UNet3D(
         in_channels=in_channels,
@@ -174,6 +290,7 @@ def create_tpu_optimized_model(
         down_factors=((1, 2, 2), (2, 2, 2), (2, 2, 2)),
         dtype=dtype,
         s2d_factor=(1, 2, 2),
+        conv_impl=conv_impl,
     )
 
 

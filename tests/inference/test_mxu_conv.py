@@ -1,0 +1,81 @@
+"""MXU conv lowering parity: MxuConv / MxuConvTranspose are pure lowering
+changes — identical parameter trees and (up to float reassociation)
+identical numerics to nn.Conv / nn.ConvTranspose. These tests pin that on
+CPU so the on-chip fwd_tpu_mxu battery step is a pure speed A/B."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chunkflow_tpu.models import unet3d
+
+
+def _tree_shapes(tree):
+    return jax.tree_util.tree_map(lambda a: a.shape, tree)
+
+
+@pytest.mark.parametrize("kernel", [(3, 3, 3), (1, 5, 5)])
+def test_mxu_conv_matches_nn_conv(kernel):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((2, 5, 8, 8, 3), dtype=np.float32))
+    native = unet3d._make_conv("native", 4, kernel, jnp.float32, "c")
+    mxu = unet3d._make_conv("mxu", 4, kernel, jnp.float32, "c")
+    params = native.init(jax.random.PRNGKey(0), x)
+    # identical parameter trees: checkpoints interchange between lowerings
+    assert _tree_shapes(params) == _tree_shapes(
+        mxu.init(jax.random.PRNGKey(0), x)
+    )
+    ref = native.apply(params, x)
+    got = mxu.apply(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("factor", [(1, 2, 2), (2, 2, 2)])
+def test_mxu_convtranspose_matches_nn(factor):
+    import flax.linen as nn
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.random((2, 4, 6, 6, 5), dtype=np.float32))
+    native = nn.ConvTranspose(3, kernel_size=factor, strides=factor)
+    mxu = unet3d.MxuConvTranspose(3, factor=factor)
+    params = native.init(jax.random.PRNGKey(0), x)
+    assert _tree_shapes(params) == _tree_shapes(
+        mxu.init(jax.random.PRNGKey(0), x)
+    )
+    ref = native.apply(params, x)
+    got = mxu.apply(params, x)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_full_unet_mxu_lowering_parity():
+    """One parameter set, both lowerings, same output — the flagship
+    architecture at toy scale."""
+    kwargs = dict(
+        in_channels=1, out_channels=3,
+        feature_maps=(8, 12, 16), down_factors=((1, 2, 2), (2, 2, 2)),
+        s2d_factor=(1, 2, 2),
+    )
+    native = unet3d.UNet3D(conv_impl="native", **kwargs)
+    mxu = unet3d.UNet3D(conv_impl="mxu", **kwargs)
+    params = unet3d.init_params(native, (4, 16, 16), 1)
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.random((2, 4, 16, 16, 1), dtype=np.float32))
+    ref = native.apply({"params": params}, x)
+    got = mxu.apply({"params": params}, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_engine_variant_tpu_mxu():
+    from chunkflow_tpu.inference import engines
+
+    eng = engines.create_engine(
+        "flax", input_patch_size=(4, 16, 16), num_output_channels=3,
+        model_variant="tpu_mxu",
+    )
+    x = jnp.zeros((2, 1, 4, 16, 16), jnp.float32)
+    out = eng.apply(eng.params, x)
+    assert out.shape == (2, 3, 4, 16, 16)
