@@ -11,10 +11,14 @@
 //     (bucket-sorted); an edge with exactly one labeled endpoint extends
 //     that region; edges below t_low never grow (those voxels stay 0);
 //  3. agglomerate: region adjacency graph scored by mean affinity of
-//     boundary edges; greedily merge pairs whose score >= merge_threshold.
-//     Scores are computed once on the initial watershed boundaries
-//     (single-shot agglomeration); incremental boundary rescoring after
-//     each merge is a planned refinement.
+//     boundary edges; hierarchical greedy merging (highest current score
+//     first) with full boundary-statistic rescoring after every merge —
+//     the waterz semantics. Rescoring is what keeps noisy small boundary
+//     patches from chain-merging distinct objects: a tiny high-variance
+//     boundary that scores above threshold pre-merge is re-evaluated
+//     against the COMBINED boundary after its region grows (single-shot
+//     scoring measured ARI 0.03 on a dropout-noise fixture vs 0.9+ with
+//     rescoring — tests/test_native.py TestAgglomerationQuality).
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
@@ -146,34 +150,62 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
     }
   }
 
-  // ---- 3: mean-affinity agglomeration on the region graph ----
+  // ---- 3: hierarchical mean-affinity agglomeration with rescoring ----
   if (merge_threshold > 0.0f && nseg > 1) {
-    // accumulate boundary statistics between regions
-    std::map<std::pair<uint32_t, uint32_t>, std::pair<double, int64_t>> bnd;
+    // region adjacency graph: per-root map of neighbor-root -> (sum, count)
+    // of boundary-edge affinities. Kept root-keyed through every merge.
+    std::vector<std::map<uint32_t, std::pair<double, int64_t>>> adj(nseg + 1);
     for (const Edge& e : edges) {
-      uint32_t a = ids[e.u], b = ids[e.v];
+      const uint32_t a = ids[e.u], b = ids[e.v];
       if (a == 0 || b == 0 || a == b) continue;
-      if (b < a) std::swap(a, b);
-      auto& s = bnd[{a, b}];
-      s.first += e.aff;
-      s.second += 1;
+      auto& sab = adj[a][b];
+      sab.first += e.aff;
+      sab.second += 1;
+      auto& sba = adj[b][a];
+      sba.first += e.aff;
+      sba.second += 1;
     }
     UnionFind ruf(nseg + 1);
     using QItem = std::pair<float, std::pair<uint32_t, uint32_t>>;
     std::priority_queue<QItem> queue;
-    for (const auto& kv : bnd) {
-      const float score =
-          static_cast<float>(kv.second.first / kv.second.second);
-      queue.push({score, kv.first});
-    }
+    for (uint32_t a = 1; a <= nseg; ++a)
+      for (const auto& kv : adj[a])
+        if (kv.first > a)
+          queue.push({static_cast<float>(kv.second.first / kv.second.second),
+                      {a, kv.first}});
     while (!queue.empty()) {
       const auto [score, pair] = queue.top();
       queue.pop();
+      // entries only ever go stale downward-in-validity, never does a
+      // current score lack an entry, so the popped score bounds every
+      // remaining current score: stop here
       if (score < merge_threshold) break;
-      const uint32_t ra = ruf.find(pair.first), rb = ruf.find(pair.second);
-      if (ra == rb) continue;
-      ruf.unite(ra, rb);
-      // lazy: stale queue entries resolve to already-merged roots and skip
+      const uint32_t a = pair.first, b = pair.second;
+      if (ruf.find(a) != a || ruf.find(b) != b) continue;  // merged away
+      const auto it = adj[a].find(b);
+      if (it == adj[a].end()) continue;
+      const float cur =
+          static_cast<float>(it->second.first / it->second.second);
+      if (cur != score) continue;  // stale; the fresh entry is queued
+      // merge b into the union-find winner; move the loser's boundaries
+      ruf.unite(a, b);
+      const uint32_t r = ruf.find(a);
+      const uint32_t o = (r == a) ? b : a;
+      adj[r].erase(o);
+      adj[o].erase(r);
+      for (const auto& kv : adj[o]) {
+        const uint32_t nb = kv.first;  // root-keyed invariant
+        adj[nb].erase(o);
+        auto& merged = adj[r][nb];
+        merged.first += kv.second.first;
+        merged.second += kv.second.second;
+        adj[nb][r] = merged;
+        // rescore the combined boundary against the grown region
+        queue.push(
+            {static_cast<float>(merged.first / merged.second),
+             {std::min(r, nb), std::max(r, nb)}});
+      }
+      adj[o].clear();
     }
     std::vector<uint32_t> remap(nseg + 1, 0);
     uint32_t finalc = 0;

@@ -151,3 +151,226 @@ def test_mesh_operator_and_manifest(tmp_path):
     assert op2(seg) == 2
     obj_files = os.listdir(str(tmp_path / "obj"))
     assert any(f.endswith(".obj") for f in obj_files)
+
+
+# ---------------------------------------------------------------------------
+# Mesh quality-parity harness (VERDICT r2 item 5): the reference meshes via
+# zmesh marching cubes + quadric simplification (reference flow/mesh.py:78-92);
+# this repo substitutes surface-nets + vertex clustering. These tests bound
+# the substitution quantitatively against analytic ground truth: two-sided
+# Hausdorff distance, enclosed volume, topology (Euler characteristic,
+# closedness), and the simplification error at production-style tolerances.
+# ---------------------------------------------------------------------------
+
+
+def _edge_counts(faces):
+    from collections import Counter
+
+    edges = Counter()
+    for tri in faces:
+        for a, b in ((0, 1), (1, 2), (2, 0)):
+            edges[tuple(sorted((int(tri[a]), int(tri[b]))))] += 1
+    return edges
+
+
+def _euler_characteristic(vertices, faces):
+    return vertices.shape[0] - len(_edge_counts(faces)) + faces.shape[0]
+
+
+def _is_closed(faces):
+    """Every edge shared by exactly two faces (watertight, no borders)."""
+    return all(c == 2 for c in _edge_counts(faces).values())
+
+
+def _signed_volume(vertices, faces):
+    v = vertices[faces]  # [F, 3, 3]
+    return float(
+        np.abs(np.einsum("ij,ij->i", v[:, 0], np.cross(v[:, 1], v[:, 2])).sum())
+        / 6.0
+    )
+
+
+def _ball(shape, center, radius):
+    zz, yy, xx = np.meshgrid(*(np.arange(s) for s in shape), indexing="ij")
+    d2 = (zz - center[0]) ** 2 + (yy - center[1]) ** 2 + (xx - center[2]) ** 2
+    return (d2 <= radius**2).astype(np.uint32)
+
+
+class TestMeshQuality:
+    def test_sphere_hausdorff_volume_topology(self):
+        from scipy.spatial import cKDTree
+
+        R, c = 20.0, 31.5
+        seg = _ball((64, 64, 64), (c, c, c), R)
+        vertices, faces = native.mesh_object(seg, 1)  # xyz voxel coords
+        assert vertices.shape[0] > 0 and _is_closed(faces)
+        assert _euler_characteristic(vertices, faces) == 2
+
+        # one-sided Hausdorff: every mesh vertex within 1 voxel of the
+        # analytic sphere (surface nets localize the boundary sub-voxel)
+        # vertex coords: voxel center == integer index (probe: a
+        # single voxel at index 3 meshes to the cube [2.5, 3.5]^3)
+        center_xyz = np.array([c, c, c])
+        radial = np.linalg.norm(vertices - center_xyz, axis=1)
+        assert np.abs(radial - R).max() <= 1.0, np.abs(radial - R).max()
+
+        # other side: every analytic-surface sample has a mesh vertex
+        # within 1.75 voxels (vertex spacing on the dual grid is ~1)
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(512, 3))
+        pts = center_xyz + R * pts / np.linalg.norm(pts, axis=1, keepdims=True)
+        d, _ = cKDTree(vertices).query(pts)
+        assert d.max() <= 1.75, d.max()
+
+        # enclosed volume within 10% of (4/3) pi R^3
+        vol = _signed_volume(vertices, faces)
+        true = 4.0 / 3.0 * np.pi * R**3
+        assert abs(vol - true) / true <= 0.10, (vol, true)
+
+    def test_torus_topology_and_hausdorff(self):
+        Rmaj, rmin = 14.0, 5.0
+        shape = (24, 48, 48)
+        cz, cy, cx = 11.5, 23.5, 23.5
+        zz, yy, xx = np.meshgrid(*(np.arange(s) for s in shape), indexing="ij")
+        ring = np.sqrt((yy - cy) ** 2 + (xx - cx) ** 2) - Rmaj
+        seg = ((ring**2 + (zz - cz) ** 2) <= rmin**2).astype(np.uint32)
+        vertices, faces = native.mesh_object(seg, 1)
+        assert vertices.shape[0] > 0 and _is_closed(faces)
+        # genus-1: V - E + F == 0
+        assert _euler_characteristic(vertices, faces) == 0
+        # Hausdorff (mesh -> analytic surface): distance from each vertex
+        # to the torus surface, in xyz coords (vertices are xyz!)
+        vx, vy, vz = vertices[:, 0], vertices[:, 1], vertices[:, 2]
+        ring_v = np.sqrt((vy - cy) ** 2 + (vx - cx) ** 2) - Rmaj
+        dist = np.abs(np.sqrt(ring_v**2 + (vz - cz) ** 2) - rmin)
+        assert dist.max() <= 1.0, dist.max()
+
+    def test_touching_blobs_stay_separate_and_closed(self):
+        # two labels sharing a planar interface: each mesh closed, neither
+        # bleeding into the other's half-space by more than the sub-voxel
+        # localization bound
+        seg = np.zeros((16, 16, 16), np.uint32)
+        ball = _ball((16, 16, 16), (7.5, 7.5, 7.5), 6.0)
+        seg[:8] = ball[:8]
+        seg[8:] = ball[8:] * 2
+        v1, f1 = native.mesh_object(seg, 1)
+        v2, f2 = native.mesh_object(seg, 2)
+        assert v1.shape[0] > 0 and v2.shape[0] > 0
+        assert _is_closed(f1) and _is_closed(f2)
+        # z is the third xyz component; interface plane at z=8.0
+        assert v1[:, 2].max() <= 8.0 + 0.5
+        assert v2[:, 2].min() >= 8.0 - 0.5
+
+    def test_simplification_error_at_production_tolerance(self):
+        from chunkflow_tpu.flow.mesh import simplify_mesh
+        from scipy.spatial import cKDTree
+
+        # production framing: 4 nm isotropic voxels, 8 nm simplification
+        # cell (reference max_simplification_error class of tolerances)
+        R, c, nm = 20.0, 31.5, 4.0
+        seg = _ball((64, 64, 64), (c, c, c), R)
+        vertices, faces = native.mesh_object(seg, 1)
+        vertices_nm = vertices * nm
+        cell = 8.0
+        sv, sf = simplify_mesh(vertices_nm, faces, cell)
+        # real reduction at this tolerance
+        assert sv.shape[0] <= 0.7 * vertices_nm.shape[0], (
+            sv.shape[0], vertices_nm.shape[0],
+        )
+        assert sf.shape[0] > 0
+        # error bound: pre-simplification Hausdorff (1 voxel = 4 nm) plus
+        # the clustering cell diagonal
+        center_nm = np.array([c] * 3) * nm
+        radial = np.linalg.norm(sv - center_nm, axis=1)
+        bound = 1.0 * nm + cell * np.sqrt(3.0)
+        assert np.abs(radial - R * nm).max() <= bound, (
+            np.abs(radial - R * nm).max(), bound,
+        )
+        # coverage survives simplification: analytic samples still have a
+        # nearby simplified vertex (cell-scale resolution)
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(256, 3))
+        pts = center_nm + R * nm * pts / np.linalg.norm(
+            pts, axis=1, keepdims=True
+        )
+        d, _ = cKDTree(sv).query(pts)
+        assert d.max() <= 2 * cell, d.max()
+
+
+# ---------------------------------------------------------------------------
+# Agglomeration quality-parity harness (VERDICT r2 item 6): the reference
+# agglomerates via waterz (reference plugins/agglomerate.py:35-43); this repo
+# substitutes native/src/watershed.cpp. Instead of a committed fixture
+# segmentation, ground truth is ANALYTIC (a deterministic Voronoi partition)
+# and the affinity map is derived from it — the floors below are therefore
+# absolute quality numbers, not self-comparisons.
+# ---------------------------------------------------------------------------
+
+
+def _voronoi_affinity_fixture(noise, inside, boundary, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (32, 64, 64)
+    n_objects = 12
+    seeds = np.stack([rng.uniform(0, s, n_objects) for s in shape], axis=1)
+    zz, yy, xx = np.meshgrid(*(np.arange(s) for s in shape), indexing="ij")
+    pts = np.stack([zz, yy, xx], -1).reshape(-1, 3)
+    d2 = ((pts[:, None, :] - seeds[None]) ** 2).sum(-1)
+    gt = (d2.argmin(1) + 1).reshape(shape).astype(np.uint32)
+    aff = np.empty((3,) + shape, np.float32)
+    for c, ax in enumerate((0, 1, 2)):
+        same = np.ones(shape, bool)
+        sl_a = [slice(None)] * 3
+        sl_b = [slice(None)] * 3
+        sl_a[ax] = slice(1, None)
+        sl_b[ax] = slice(0, -1)
+        same[tuple(sl_a)] = gt[tuple(sl_a)] == gt[tuple(sl_b)]
+        aff[c] = np.where(same, inside, boundary)
+    aff += rng.normal(0, noise, aff.shape).astype(np.float32)
+    return np.clip(aff, 0, 1).astype(np.float32), gt
+
+
+class TestAgglomerationQuality:
+    def test_clean_affinities_exact_recovery(self):
+        from chunkflow_tpu.chunk.segmentation import Segmentation
+
+        aff, gt = _voronoi_affinity_fixture(0.05, 0.9, 0.1)
+        seg, count = native.watershed_agglomerate(aff, 0.9, 0.3, 0.5)
+        assert count == 12
+        m = Segmentation(seg).evaluate(gt)
+        assert m["adjusted_rand_index"] >= 0.99, m
+        assert m["voi_split"] + m["voi_merge"] <= 0.02, m
+
+    def test_noisy_affinities_quality_floor(self):
+        from chunkflow_tpu.chunk.segmentation import Segmentation
+
+        # sigma-0.15 noise on 0.85/0.15 affinities; measured 2026-07-30
+        # (hierarchical rescoring agglomeration): 12/12 objects, ARI 1.0,
+        # VOI 0.0 — floors set with margin so a regression fails while the
+        # exact numbers stay on record here. (The pre-rescoring
+        # single-shot scoring measured ARI 0.775 on this fixture.)
+        aff, gt = _voronoi_affinity_fixture(0.15, 0.85, 0.15)
+        seg, count = native.watershed_agglomerate(aff, 0.9, 0.3, 0.5)
+        assert 10 <= count <= 14, count
+        m = Segmentation(seg).evaluate(gt)
+        assert m["rand_index"] >= 0.99, m
+        assert m["adjusted_rand_index"] >= 0.95, m
+        assert m["voi_split"] + m["voi_merge"] <= 0.10, m
+
+    def test_dropout_noise_quality_floor(self):
+        """Random low-affinity dropout inside objects — the fixture that
+        collapsed single-shot scoring (ARI 0.03, everything chain-merged
+        into 2 objects). With waterz-style rescoring after every merge:
+        measured ARI 0.9999, VOI 0.0006 (2026-07-30)."""
+        from chunkflow_tpu.chunk.segmentation import Segmentation
+
+        rng = np.random.default_rng(0)
+        aff, gt = _voronoi_affinity_fixture(0.0, 0.85, 0.15)
+        drop = rng.random(aff.shape) < 0.05
+        aff = np.where(drop, np.float32(0.3), aff)
+        aff += rng.normal(0, 0.15, aff.shape).astype(np.float32)
+        aff = np.clip(aff, 0, 1).astype(np.float32)
+        seg, count = native.watershed_agglomerate(aff, 0.9, 0.3, 0.5)
+        assert 10 <= count <= 24, count
+        m = Segmentation(seg).evaluate(gt)
+        assert m["adjusted_rand_index"] >= 0.95, m
+        assert m["voi_split"] + m["voi_merge"] <= 0.10, m
