@@ -871,11 +871,18 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
         chunk = task[input_chunk_name]
         if state.dry_run:
             return task
-        if (intensity_threshold is not None
+        thr = intensity_threshold
+        if thr is not None and thr <= 1.0 and np.dtype(chunk.dtype) == np.uint8:
+            # thresholds are tuned for [0,1] float probabilities; with
+            # --output-dtype uint8 the data arrives 0-255, so an
+            # unscaled threshold would never trigger the skip
+            thr = thr * 255.0
+            print(f"intensity threshold rescaled to {thr} for uint8 chunk")
+        if (thr is not None
                 # reduce on device when HBM-resident: only the scalar
                 # crosses D2H (np.asarray would pull the whole chunk)
-                and float(chunk.array.max()) < intensity_threshold):
-            print(f"skip save: max intensity below {intensity_threshold}")
+                and float(chunk.array.max()) < thr):
+            print(f"skip save: max intensity below {thr}")
             return task
         future = vol.save(
             chunk,
@@ -1500,7 +1507,8 @@ def copy_var_cmd(op_name, from_name, to_name):
                    "reference's save-time conversion (blend accumulation "
                    "stays float32 either way)")
 @click.option(
-    "--model-variant", type=click.Choice(["parity", "rsunet", "tpu"]),
+    "--model-variant",
+    type=click.Choice(["parity", "rsunet", "tpu", "tpu_mxu"]),
     default="parity",
     help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
 )
@@ -2038,7 +2046,13 @@ def gaussian_filter_cmd(op_name, sigma, input_chunk_name, output_chunk_name):
 @click.option("--output-names", "-o", type=str, default=DEFAULT_CHUNK_NAME, help="comma-separated task keys")
 @click.option("--args", "-a", type=str, default=None, help="k=v;k2=(1,2) plugin args")
 def plugin_cmd(name, input_names, output_names, args):
-    """Run a user plugin file: execute(*inputs, **args)."""
+    """Run a user plugin file: execute(*inputs, **args).
+
+    Bundled plugins are listed in chunkflow_tpu/plugins/. Note: the
+    bundled czann_inference plugin is a documented stub (it needs the
+    optional czmodel runtime, like the reference's own 2-line czann
+    plugin); use the 'universal' inference engine for extracted models.
+    """
     from chunkflow_tpu.flow.plugin import load_plugin, str_to_dict, wrap_outputs
 
     execute = load_plugin(name)

@@ -202,7 +202,13 @@ class PrecomputedVolume:
         if np.issubdtype(vol_dtype, np.floating) and arr.dtype == np.uint8:
             arr = arr.astype(vol_dtype) / np.array(255, vol_dtype)
         elif vol_dtype == np.uint8 and arr.dtype.kind == "f":
-            arr = arr * 255.0
+            # clip before scaling: float data outside [0,1] (e.g. raw
+            # 0-255 intensities stored as float) would wrap on the
+            # truncating astype below. The reference has the same latent
+            # bug (its `chunk.max() <= 1.` range check is a no-op
+            # expression, save_precomputed.py:88-92); clipping matches
+            # normalize_blend's uint8 quantization.
+            arr = np.clip(arr, 0.0, 1.0) * 255.0
         arr = arr.astype(self.dtype, copy=False)
         arr_xyzc = np.transpose(arr, (3, 2, 1, 0))
         sl_xyz = tuple(reversed(chunk.bbox.slices))

@@ -1,0 +1,60 @@
+"""Real-viewer layer construction (VERDICT r2 item 8).
+
+The stub tests in test_viewers.py exercise the dispatch logic; this module
+runs the SAME build_layers path against the real ``neuroglancer`` /
+``napari`` packages when they are importable. Neither ships in this image
+and installs are not possible here, so the tests gate with importorskip —
+in an environment with the viewers installed (e.g. the reference's own
+deployment image) they run as genuine layer-construction smoke tests
+against reference flow/neuroglancer.py:212-320 semantics.
+"""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk, LayerType
+
+
+def _datas():
+    img = Chunk(
+        (np.random.default_rng(0).random((4, 16, 16)) * 255).astype(np.uint8),
+        voxel_offset=(0, 0, 0),
+        voxel_size=(40, 8, 8),
+    )
+    img.layer_type = LayerType.IMAGE
+    seg = Chunk(
+        np.arange(4 * 16 * 16, dtype=np.uint32).reshape(4, 16, 16) % 7,
+        voxel_offset=(0, 0, 0),
+        voxel_size=(40, 8, 8),
+    )
+    seg.layer_type = LayerType.SEGMENTATION
+    return {"img": img, "seg": seg}
+
+
+def test_real_neuroglancer_layer_construction():
+    ng = pytest.importorskip("neuroglancer")
+
+    from chunkflow_tpu.flow.viewers import build_layers
+
+    viewer = ng.Viewer()
+    with viewer.txn() as txn:
+        n = build_layers(txn, _datas())
+    assert n == 2
+    state = viewer.state
+    assert {layer.name for layer in state.layers} == {"img", "seg"}
+
+
+def test_real_napari_layer_construction():
+    napari = pytest.importorskip("napari")
+
+    from chunkflow_tpu.flow.viewers import add_napari_layers
+
+    try:
+        viewer = napari.Viewer(show=False)
+    except Exception as e:  # headless box: Qt platform plugin missing
+        pytest.skip(f"napari importable but no display backend: {e}")
+    try:
+        n = add_napari_layers(viewer, _datas())
+        assert n == 2
+        assert len(viewer.layers) == 2
+    finally:
+        viewer.close()
