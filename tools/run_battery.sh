@@ -1,6 +1,7 @@
 #!/bin/bash
 # Cooldown then retry loop for the TPU validation battery (resumable:
 # completed steps skip; a tunnel drop only costs the failed step).
+cd "$(dirname "$0")/.." || exit 2
 sleep "${BATTERY_COOLDOWN:-600}"
 attempts="${BATTERY_ATTEMPTS:-12}"
 case "$attempts" in

@@ -195,17 +195,36 @@ def bench_flagship_xla():
     return _bench("0", "tpu", "bfloat16", 4)
 
 
-@step("bench_parity_f32_scan")
-def bench_parity_scan():
-    """A/B: per-batch scatter_add inside the scan (stacked path off).
-    The stacked single-accumulate redesign shipped unmeasured (tunnel was
-    down); the per-batch design measured 1.48 Mvox/s in round 1."""
-    return _bench("0", "parity", "float32", 2, stack_gb=0)
+@step("fwd_tpu_mxu")
+def fwd_tpu_mxu():
+    """Conv-lowering A/B vs fwd_tpu_bf16: same flagship, same parameters,
+    every conv lowered as z-decomposed 2D convs + GEMM upsampling
+    (unet3d.MxuConv) instead of XLA's native Conv3D."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.create_tpu_optimized_model(conv_impl="mxu")
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
+    dt = _fwd_time(model, params, x)
+    return {"ms": round(dt * 1e3, 1),
+            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
 
 
-@step("bench_tpu_bf16_scan")
-def bench_flagship_scan():
-    return _bench("0", "tpu", "bfloat16", 4, stack_gb=0)
+@step("bench_tpu_mxu_fold_stream_u8")
+def bench_mxu_fold_stream_u8():
+    """The full production stack on the mxu lowering."""
+    return _bench("0", "tpu_mxu", "bfloat16", 4, blend="fold", stream=5,
+                  output_dtype="uint8")
+
+
+@step("bench_tpu_bf16_stacked")
+def bench_flagship_stacked():
+    """A/B: the stacked single-trailing-scatter accumulation (round-2's
+    shipped-unmeasured redesign, now opt-in via CHUNKFLOW_BLEND_STACKED
+    after measuring 0.66 vs 1.48 Mvox/s for the per-batch default)."""
+    return _bench("0", "tpu", "bfloat16", 4, stacked="1")
 
 
 @step("bench_tpu_bf16_b8")
@@ -237,10 +256,10 @@ def check_pallas_oracle():
     import numpy as np
 
     os.environ["CHUNKFLOW_PALLAS"] = "1"
-    # the *_scan steps set a 0 stack budget via bench.run_config; clear it
-    # so the oracle vets the same (stacked) path bench_tpu_bf16_pallas
-    # measures
-    os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
+    # the stacked A/B step sets CHUNKFLOW_BLEND_STACKED via
+    # bench.run_config; clear it so the oracle vets the same (per-batch
+    # default) path bench_tpu_bf16_pallas measures
+    os.environ.pop("CHUNKFLOW_BLEND_STACKED", None)
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference.inferencer import Inferencer
 
@@ -278,9 +297,9 @@ def e2e_split():
     from chunkflow_tpu.inference import Inferencer
 
     os.environ["CHUNKFLOW_PALLAS"] = "0"
-    # defensive: this split is attributed to the stacked flagship config,
-    # so pin the default budget regardless of what ran before
-    os.environ.pop("CHUNKFLOW_BLEND_STACK_MAX_GB", None)
+    # defensive: this split is attributed to the default flagship config,
+    # so pin the default blend selection regardless of what ran before
+    os.environ.pop("CHUNKFLOW_BLEND_STACKED", None)
     inferencer = Inferencer(
         input_patch_size=bench.INPUT_PATCH,
         output_patch_overlap=bench.OUTPUT_OVERLAP,
@@ -335,14 +354,59 @@ def bench_flagship_fold_stream_u8():
                   output_dtype="uint8")
 
 
+@step("profile_flagship")
+def profile_flagship():
+    """VERDICT r2 item 3: committed profiler evidence for the forward
+    pass. Captures (a) XLA's own cost analysis of the compiled flagship
+    forward (FLOPs + bytes -> MXU utilization bound) and (b) a
+    jax.profiler perfetto trace of three steady-state forwards under
+    tools/profile_r03/ for offline op-level analysis."""
+    import jax
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.create_tpu_optimized_model()
+    params = unet3d.init_params(model, (20, 256, 256), 1)
+    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
+    f = jax.jit(lambda p, v: model.apply({"params": p}, v))
+    compiled = f.lower(params, x).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    keep = {k: v for k, v in cost.items()
+            if k in ("flops", "bytes accessed", "bytes accessed0{}",
+                     "bytes accessed1{}", "bytes accessedout{}",
+                     "optimal_seconds")}
+    compiled(params, x).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    for _ in range(3):
+        compiled(params, x).block_until_ready()
+    dt = (time.perf_counter() - t0) / 3
+    trace_dir = os.path.join(os.path.dirname(__file__), "profile_r03")
+    with jax.profiler.trace(trace_dir):
+        for _ in range(3):
+            compiled(params, x).block_until_ready()
+    # v5e peak: 197 TFLOP/s bf16, 819 GB/s HBM
+    flops = float(keep.get("flops", 0.0))
+    util = flops / dt / 197e12 if dt > 0 else 0.0
+    return {"steady_ms": round(dt * 1e3, 1), "cost": keep,
+            "mxu_util_bf16_peak": round(util, 4),
+            "trace_dir": os.path.relpath(trace_dir)}
+
+
 @step("bench_jumbo_bf16")
 def bench_jumbo():
     """Apples-to-apples with the reference's own headline task: its
     1.66 Mvoxel/s TITAN X number is a 108x2048x2048 affinity cutout
-    (tests/data/log/*.json). Per-batch scan accumulate (the stack budget
-    gates the stacked/fold paths off at this size), bf16 results."""
+    (tests/data/log/*.json). Production configuration: per-batch scan
+    accumulate (the stack budget gates the stacked/fold paths off at this
+    size — the OOM-guard path this step exists to exercise), pipelined
+    across 2 jumbo chunks, on-device uint8 results (the reference's own
+    save-time conversion)."""
     return _bench("0", "tpu", "bfloat16", 4,
-                  chunk_size=(108, 2048, 2048), output_dtype="bfloat16")
+                  chunk_size=(108, 2048, 2048), stream=2,
+                  output_dtype="uint8")
 
 
 @step("entry_compile")
@@ -363,19 +427,25 @@ def entry_compile():
 
 
 def main():
-    # Headline-class steps (bench.py's XLA-blend CONFIGS, whose compiled
-    # programs the persistent cache must hold for the driver's bench run)
-    # come first: tunnel windows have been ~25 min, so a single window
-    # should bank the numbers that matter before the A/B diagnostics.
-    # The pallas config stays riskiest-last on purpose.
-    steps = [check_tunnel, compile_split, fwd_parity, bench_parity,
-             fwd_tpu_variant, bench_flagship_xla,
-             bench_flagship_stream, bench_flagship_stream_bf16out,
-             bench_flagship_fold_stream, bench_flagship_fold_stream_u8,
-             e2e_split, bench_parity_scan, bench_flagship_scan,
-             bench_parity_fold, bench_flagship_fold, bench_flagship_b8,
+    # A/B-first (VERDICT r2 item 2): the blend-default decision — per-batch
+    # scatter (default) vs fold vs fold+stream+uint8 vs stacked — must bank
+    # inside the first ~10 minutes of a tunnel window; diagnostics and the
+    # riskiest steps (pallas, jumbo) come after.
+    steps = [check_tunnel,
+             bench_flagship_xla,            # per-batch scatter default
+             bench_flagship_fold,           # fold blend A/B
+             bench_flagship_fold_stream_u8,  # production pipeline
+             bench_flagship_fold_stream,    # fold+stream, bf16 out
+             bench_flagship_stream_bf16out,  # scatter+stream A/B partner
+             bench_flagship_stacked,        # round-2 regression check
+             fwd_tpu_variant, fwd_tpu_mxu,  # conv-lowering A/B
+             bench_mxu_fold_stream_u8,
+             profile_flagship, bench_flagship_b8,
+             fwd_parity, bench_parity, bench_parity_fold,
+             e2e_split, bench_flagship_stream, compile_split,
+             bench_jumbo,
              check_pallas_oracle, bench_flagship_pallas,
-             bench_jumbo, entry_compile]
+             entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
     # a cool-down, e.g.:
