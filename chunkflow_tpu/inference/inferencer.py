@@ -614,10 +614,19 @@ class Inferencer:
         arr = chunk.array
         if not chunk.is_on_device:
             arr = np.asarray(arr)
-        # int images normalize to [0, 1] float32 (reference :395-399)
-        if np.dtype(chunk.dtype).kind in "iu":
-            scale = np.float32(1.0 / np.iinfo(chunk.dtype).max)
-            arr = jnp.asarray(arr, dtype=jnp.float32) * scale
+        # int images normalize to [0, 1] float32 (reference :395-399).
+        # Transfer the NARROW dtype and convert on device: a uint8 EM
+        # chunk rides H2D at 1/4 the bytes of a host-side float32
+        # conversion, and XLA fuses the convert+scale into one kernel.
+        dt = np.dtype(chunk.dtype)
+        if dt.kind in "iu":
+            scale = np.float32(1.0 / np.iinfo(dt).max)
+            if dt.itemsize <= 4:
+                arr = jnp.asarray(arr).astype(jnp.float32) * scale
+            else:
+                # 64-bit ints would silently wrap in jnp.asarray (x64
+                # disabled downcasts to 32-bit first); convert on host
+                arr = jnp.asarray(np.asarray(arr, dtype=np.float32)) * scale
         else:
             arr = jnp.asarray(arr, dtype=jnp.float32)
         if arr.ndim == 3:

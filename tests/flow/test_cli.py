@@ -566,6 +566,37 @@ def test_load_save_precomputed_reference_options(runner, tmp_path):
         assert f[key].shape[-3:] == (8, 16, 8)
 
 
+def test_intensity_threshold_rescales_for_uint8(runner, tmp_path):
+    """Thresholds tuned for [0,1] float probabilities keep working when
+    the chunk is uint8 (0-255): values <= 1.0 are rescaled by 255,
+    loudly. Without the rescale a 0.99 threshold would never skip —
+    every nonzero uint8 chunk has max >= 1."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    root = tmp_path / "vol"
+    PrecomputedVolume.create(
+        str(root), volume_size=(8, 16, 16), dtype="uint8",
+        voxel_size=(40, 4, 4), block_size=(8, 8, 8),
+    )
+    # this sin chunk peaks at 250: rescaled 0.9 -> 229.5 < 250 -> saves
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--intensity-threshold", "0.9",
+    ])
+    assert result.exit_code == 0, result.output
+    assert "rescaled to 229.5" in result.output
+    assert "skip save" not in result.output
+
+    # all-zero chunk: rescaled 0.5 -> 127.5 > 0 -> skips
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "16", "16", "--pattern", "zero",
+        "save-precomputed", "-v", str(root), "--intensity-threshold", "0.5",
+    ])
+    assert result.exit_code == 0, result.output
+    assert "skip save" in result.output
+
+
 def test_downsample_upload_chunk_mip_semantics(runner, tmp_path):
     """Pyramid levels count from --chunk-mip; --start-mip at or below the
     chunk mip fails fast (reference downsample_upload.py asserts
