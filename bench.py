@@ -45,13 +45,36 @@ import numpy as np
 
 BASELINE_MVOX_S = 1.66  # TITAN X (Pascal), reference tests/data/log fixtures
 
-CHUNK_SIZE = (64, 512, 512)
-INPUT_PATCH = (20, 256, 256)
-OUTPUT_OVERLAP = (4, 64, 64)
+
+def _env_triple(name: str, default):
+    """Geometry override for smoke runs (parent and child must agree, and
+    the child is a subprocess — env is the only channel that reaches it)."""
+    value = os.environ.get(name)
+    if not value:
+        return default
+    try:
+        triple = tuple(int(x) for x in value.replace("x", ",").split(","))
+    except ValueError as e:
+        raise SystemExit(f"bad {name}={value!r}: {e}") from None
+    if len(triple) != 3:
+        raise SystemExit(f"bad {name}={value!r}: need 3 ints, got {triple}")
+    return triple
+
+
+CHUNK_SIZE = _env_triple("CHUNKFLOW_BENCH_CHUNK", (64, 512, 512))
+INPUT_PATCH = _env_triple("CHUNKFLOW_BENCH_PATCH", (20, 256, 256))
+OUTPUT_OVERLAP = _env_triple("CHUNKFLOW_BENCH_OVERLAP", (4, 64, 64))
 NUM_OUT = 3
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-RESULTS_PATH = os.path.join(_HERE, "bench_results.json")
+
+
+def _results_path() -> str:
+    """Env-overridable (tests): parent and child are separate processes
+    and must agree on where per-config results land."""
+    return os.environ.get(
+        "CHUNKFLOW_BENCH_RESULTS", os.path.join(_HERE, "bench_results.json")
+    )
 
 # Headline-first: the driver reports the best SUCCESSFUL config, and the
 # wall-clock cap may cut the list short, so the configs most likely to be
@@ -106,16 +129,17 @@ class _ConfigTimeout(Exception):
 
 def _record(results: dict, name: str, payload: dict):
     results[name] = payload
+    path = _results_path()
     try:
         # atomic replace: the parent may SIGKILL this child at any moment
         # (wall-clock cap), and a torn half-written file would erase every
         # banked number — the exact loss this file exists to prevent
-        tmp = RESULTS_PATH + ".tmp"
+        tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(results, f, indent=2)
-        os.replace(tmp, RESULTS_PATH)
+        os.replace(tmp, path)
     except OSError as e:
-        print(f"cannot write {RESULTS_PATH}: {e}", file=sys.stderr)
+        print(f"cannot write {path}: {e}", file=sys.stderr)
 
 
 # external override preserved across configs: a cfg's env tweaks apply to
@@ -310,6 +334,15 @@ def _cfg_name(cfg: dict) -> str:
         name += f"-{cfg['blend']}"
     if "chunk_size" in cfg:
         name += "-" + "x".join(str(s) for s in cfg["chunk_size"])
+    # env geometry overrides change the measured workload: stamp them into
+    # the name so a smoke-scale number can never masquerade as the
+    # production-geometry headline (same misattribution rule as
+    # pallas/fold)
+    if any(os.environ.get(v) for v in ("CHUNKFLOW_BENCH_CHUNK",
+                                       "CHUNKFLOW_BENCH_PATCH",
+                                       "CHUNKFLOW_BENCH_OVERLAP")):
+        name += "-geom" + "x".join(str(s) for s in CHUNK_SIZE)
+        name += "-p" + "x".join(str(s) for s in INPUT_PATCH)
     return name
 
 
@@ -437,10 +470,10 @@ def parent_main() -> int:
 
     # fresh results file: this run's numbers only
     try:
-        with open(RESULTS_PATH, "w") as f:
+        with open(_results_path(), "w") as f:
             f.write("{}")
     except OSError as e:
-        print(f"cannot reset {RESULTS_PATH}: {e}", file=sys.stderr)
+        print(f"cannot reset {_results_path()}: {e}", file=sys.stderr)
 
     child_budget = max(60.0, deadline - time.monotonic() - 45)
     env = dict(os.environ)
@@ -461,7 +494,7 @@ def parent_main() -> int:
               file=sys.stderr)
 
     try:
-        with open(RESULTS_PATH) as f:
+        with open(_results_path()) as f:
             results = json.load(f)
     except (OSError, ValueError):
         results = {}

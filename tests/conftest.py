@@ -14,6 +14,14 @@ import os
 # jax. bench.py and __graft_entry__ do not import this file, so they still
 # see the real chip.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Scrub the TPU-tunnel trigger for every SUBPROCESS tests spawn (pod-sim
+# workers, bench.py's probe/child): with PALLAS_AXON_POOL_IPS set, the
+# sitecustomize hook registers the single-client tunnel in each fresh
+# interpreter before any user code runs — in-process jax.config fixes
+# (below) cannot reach those children, and a probe against a dead tunnel
+# hangs ~25 min. Scrubbing here, in the parent, is the only early-enough
+# place.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -2,6 +2,8 @@
 (tiny shapes, CPU mesh) so breakage surfaces in CI, not in a scarce
 hardware window. The pallas config must fail loudly on a non-TPU backend
 rather than silently measuring the XLA path."""
+import json
+
 import numpy as np
 import pytest
 
@@ -85,10 +87,33 @@ def test_parent_emits_cached_on_probe_failure(monkeypatch, capsys):
     rc = bench.parent_main()
     out = capsys.readouterr().out.strip().splitlines()
     assert rc == 0
-    payload = __import__("json").loads(out[-1])
+    payload = json.loads(out[-1])
     assert payload["cached"] is True
     assert payload["unit"] == "Mvoxel/s/chip"
     assert payload["value"] > 0
+
+
+def test_parent_live_path_end_to_end(monkeypatch, capsys, tmp_path):
+    """The full parent->probe->child->live-result chain at smoke scale:
+    the one path the CPU could never finish at production geometry. The
+    child is a real subprocess, so the geometry rides env overrides."""
+    monkeypatch.setenv("CHUNKFLOW_BENCH_CHUNK", "16,64,64")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_PATCH", "8,32,32")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_OVERLAP", "2,8,8")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_VARIANT", "tpu")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_DTYPE", "float32")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_BATCH", "2")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_WALLCLOCK", "300")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_RESULTS",
+                       str(tmp_path / "bench_results.json"))
+    rc = bench.parent_main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    payload = json.loads(out[-1])
+    assert payload.get("cached") is None, payload  # LIVE, not fallback
+    assert payload["unit"] == "Mvoxel/s/chip"
+    assert payload["value"] > 0
+    assert payload["config"].startswith("tpu-float32-bs2")
 
 
 def test_cached_hardware_result_shape():
