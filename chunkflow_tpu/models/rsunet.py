@@ -24,6 +24,8 @@ from typing import Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from chunkflow_tpu.models.unet3d import MxuConvTranspose, _make_conv
+
 Triple = Tuple[int, int, int]
 
 
@@ -48,14 +50,15 @@ class RSBlock(nn.Module):
 
     features: int
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "native"
 
     def setup(self):
         f, dt = self.features, self.dtype
-        self.conv1 = nn.Conv(f, (1, 3, 3), padding="SAME", dtype=dt)
+        self.conv1 = _make_conv(self.conv_impl, f, (1, 3, 3), dt, None)
         self.bn1 = Affine(f, dtype=dt)
-        self.conv2 = nn.Conv(f, (3, 3, 3), padding="SAME", dtype=dt)
+        self.conv2 = _make_conv(self.conv_impl, f, (3, 3, 3), dt, None)
         self.bn2 = Affine(f, dtype=dt)
-        self.conv3 = nn.Conv(f, (3, 3, 3), padding="SAME", dtype=dt)
+        self.conv3 = _make_conv(self.conv_impl, f, (3, 3, 3), dt, None)
         self.bn3 = Affine(f, dtype=dt)
 
     def __call__(self, x):
@@ -81,20 +84,27 @@ class RSUNet(nn.Module):
     down_factors: Sequence[Triple] = ((1, 2, 2), (2, 2, 2), (2, 2, 2))
     dtype: jnp.dtype = jnp.float32
     final_activation: str = "sigmoid"
+    conv_impl: str = "native"  # "mxu": same params, 2D/GEMM lowering
 
     def setup(self):
         depth = len(self.width)
         assert len(self.down_factors) == depth - 1
-        dt = self.dtype
-        self.embed = nn.Conv(self.width[0], (1, 5, 5), padding="SAME",
-                             dtype=dt)
+        dt, impl = self.dtype, self.conv_impl
+        self.embed = _make_conv(impl, self.width[0], (1, 5, 5), dt, None)
         self.enc = [
-            RSBlock(self.width[i], dtype=dt, name=f"enc{i}")
+            RSBlock(self.width[i], dtype=dt, conv_impl=impl, name=f"enc{i}")
             for i in range(depth - 1)
         ]
-        self.bridge = RSBlock(self.width[-1], dtype=dt)
+        self.bridge = RSBlock(self.width[-1], dtype=dt, conv_impl=impl)
         self.up = [
-            nn.ConvTranspose(
+            MxuConvTranspose(
+                self.width[i],
+                factor=self.down_factors[i],
+                dtype=dt,
+                name=f"up{i}",
+            )
+            if impl == "mxu"
+            else nn.ConvTranspose(
                 self.width[i],
                 kernel_size=self.down_factors[i],
                 strides=self.down_factors[i],
@@ -104,11 +114,10 @@ class RSUNet(nn.Module):
             for i in range(depth - 1)
         ]
         self.dec = [
-            RSBlock(self.width[i], dtype=dt, name=f"dec{i}")
+            RSBlock(self.width[i], dtype=dt, conv_impl=impl, name=f"dec{i}")
             for i in range(depth - 1)
         ]
-        self.out = nn.Conv(self.out_channels, (1, 1, 1), padding="SAME",
-                           dtype=dt)
+        self.out = _make_conv(impl, self.out_channels, (1, 1, 1), dt, None)
 
     def __call__(self, x):
         orig_dtype = x.dtype
