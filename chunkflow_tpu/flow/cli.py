@@ -872,10 +872,12 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
         if state.dry_run:
             return task
         thr = intensity_threshold
-        if thr is not None and thr <= 1.0 and np.dtype(chunk.dtype) == np.uint8:
+        if thr is not None and thr < 1.0 and np.dtype(chunk.dtype) == np.uint8:
             # thresholds are tuned for [0,1] float probabilities; with
             # --output-dtype uint8 the data arrives 0-255, so an
-            # unscaled threshold would never trigger the skip
+            # unscaled threshold would never trigger the skip. Exactly
+            # 1.0 is treated as an absolute threshold (skip only
+            # all-zero uint8 chunks), not rescaled to 255.
             thr = thr * 255.0
             print(f"intensity threshold rescaled to {thr} for uint8 chunk")
         if (thr is not None

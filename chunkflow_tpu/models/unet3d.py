@@ -88,12 +88,16 @@ class MxuConv(nn.Module):
     an identical parameter tree, lowered as z-decomposed 2D convolutions.
 
     XLA's native Conv3D lowering on TPU underuses the MXU (~3-4% of bf16
-    peak measured on the flagship, tools/profile_r03); a (kz, ky, kx) conv
-    is mathematically the sum of kz z-shifted (ky, kx) 2D convs, and 2D
-    convs with depth merged into batch hit the battle-tested conv2d path.
-    Same FLOPs, same parameters (kernel [kz,ky,kx,Cin,F] + bias), same
-    numerics up to float reassociation — asserted by
-    tests/inference/test_mxu_conv.py; A/B'd on chip by fwd_tpu_mxu."""
+    peak, an arithmetic bound from the measured 28.5 Mvoxel/s raw forward
+    in tools/tpu_validation_oldblend.json `fwd_tpu_bf16` vs the 197
+    TFLOP/s v5e peak); a (kz, ky, kx) conv is mathematically the sum of
+    kz z-shifted (ky, kx) 2D convs, and 2D convs with depth merged into
+    batch hit the battle-tested conv2d path. Same FLOPs, same parameters
+    (kernel [kz,ky,kx,Cin,F] + bias); partials are accumulated in float32
+    (preferred_element_type) and rounded to the compute dtype once, so
+    bf16 numerics track native Conv3D's single-rounding accumulation —
+    asserted by tests/inference/test_mxu_conv.py; A/B'd on chip by
+    fwd_tpu_mxu."""
 
     features: int
     kernel_size: Triple
@@ -127,10 +131,12 @@ class MxuConv(nn.Module):
                 window_strides=(1, 1),
                 padding="SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.float32,
             )
             acc = y if acc is None else acc + y
         acc = acc.reshape(b, d, h, w, self.features)
-        return acc + jnp.asarray(bias, self.dtype)
+        acc = acc + jnp.asarray(bias, jnp.float32)
+        return acc.astype(self.dtype)
 
 
 class MxuConvTranspose(nn.Module):

@@ -596,6 +596,16 @@ def test_intensity_threshold_rescales_for_uint8(runner, tmp_path):
     assert result.exit_code == 0, result.output
     assert "skip save" in result.output
 
+    # exactly 1.0 is an ABSOLUTE threshold (ADVICE r3): skip only
+    # all-zero uint8 chunks, do not reinterpret as 255
+    result = runner.invoke(main, [
+        "create-chunk", "-s", "8", "16", "16", "--pattern", "sin",
+        "save-precomputed", "-v", str(root), "--intensity-threshold", "1.0",
+    ])
+    assert result.exit_code == 0, result.output
+    assert "rescaled" not in result.output
+    assert "skip save" not in result.output  # sin peaks at 250 >= 1.0
+
 
 def test_downsample_upload_chunk_mip_semantics(runner, tmp_path):
     """Pyramid levels count from --chunk-mip; --start-mip at or below the

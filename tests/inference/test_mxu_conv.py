@@ -50,6 +50,27 @@ def test_mxu_convtranspose_matches_nn(factor):
                                atol=1e-5, rtol=1e-5)
 
 
+def test_mxu_conv_bf16_accumulates_in_f32():
+    """ADVICE r3: in bf16 mode the kz partials must accumulate in f32
+    (one rounding at the end, like native Conv3D) — not partial-by-partial
+    bf16 rounding. Compare both bf16 lowerings against the f32 truth with
+    a tolerance sized for a single bf16 rounding (~2^-8 relative)."""
+    rng = np.random.default_rng(3)
+    x32 = jnp.asarray(rng.random((2, 5, 8, 8, 3), dtype=np.float32))
+    native32 = unet3d._make_conv("native", 4, (3, 3, 3), jnp.float32, "c")
+    params = native32.init(jax.random.PRNGKey(0), x32)
+    truth = np.asarray(native32.apply(params, x32), np.float32)
+
+    mxu16 = unet3d._make_conv("mxu", 4, (3, 3, 3), jnp.bfloat16, "c")
+    got = np.asarray(mxu16.apply(params, x32), np.float32)
+    scale = np.abs(truth).max()
+    # tolerance sized to SEPARATE the lowerings (measured on this exact
+    # seed/shape): f32-accumulated max err ~0.0063*, partial-by-partial
+    # bf16 accumulation ~0.0094* — scale/256 (~0.0073*) passes only the
+    # single-rounding accumulation
+    np.testing.assert_allclose(got, truth, atol=scale / 256.0)
+
+
 def test_full_unet_mxu_lowering_parity():
     """One parameter set, both lowerings, same output — the flagship
     architecture at toy scale."""

@@ -15,11 +15,15 @@ def summarize(path: str) -> None:
     with open(path) as f:
         data = json.load(f)
     print(f"### {os.path.basename(path)}\n")
+    meta = data.get("_meta")
+    if isinstance(meta, dict) and meta.get("measured_at_commit"):
+        print(f"measured at: `{meta['measured_at_commit']}`"
+              f" ({meta.get('measured_at_utc', '?')})\n")
     print("| step | result |")
     print("|---|---|")
     for step, payload in data.items():
-        if not isinstance(payload, dict):
-            continue
+        if step.startswith("_") or not isinstance(payload, dict):
+            continue  # _meta is provenance, not a battery step
         if not payload.get("ok"):
             err = (payload.get("error") or "").strip().splitlines()
             tail = err[-1][:80] if err else "?"
