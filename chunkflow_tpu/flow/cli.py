@@ -1510,7 +1510,7 @@ def copy_var_cmd(op_name, from_name, to_name):
                    "stays float32 either way)")
 @click.option(
     "--model-variant",
-    type=click.Choice(["parity", "rsunet", "tpu", "tpu_mxu"]),
+    type=click.Choice(["parity", "rsunet", "tpu", "tpu_mxu", "tpu_s2d4"]),
     default="parity",
     help="parity: reference-class UNet (torch-convertible); tpu: space-to-depth MXU-optimized flagship",
 )

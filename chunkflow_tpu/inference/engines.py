@@ -91,7 +91,7 @@ def create_flax_engine(
 
     if module is not None and hasattr(module, "create_model"):
         model = module.create_model(num_input_channels, num_output_channels)
-    elif model_variant in ("tpu", "tpu_mxu"):
+    elif model_variant in ("tpu", "tpu_mxu", "tpu_s2d4"):
         model = unet3d.create_tpu_optimized_model(
             in_channels=num_input_channels,
             out_channels=num_output_channels,
@@ -99,6 +99,9 @@ def create_flax_engine(
             # same parameters, different XLA lowering (z-decomposed 2D
             # convs + GEMM upsampling) — see unet3d.MxuConv
             conv_impl="mxu" if model_variant == "tpu_mxu" else "native",
+            # aggressive stem: 112-256 channels at 1/16 positions
+            s2d_factor=(1, 4, 4) if model_variant == "tpu_s2d4"
+            else (1, 2, 2),
         )
     elif model_variant == "rsunet":
         model = rsunet.RSUNet(

@@ -275,27 +275,33 @@ def create_tpu_optimized_model(
     out_channels: int = 3,
     dtype=jnp.bfloat16,
     conv_impl: str = "native",
+    s2d_factor: Triple = (1, 2, 2),
 ) -> "UNet3D":
     """The flagship affinity model tuned for the MXU.
 
-    Space-to-depth stem (1, 2, 2) with widths doubled relative to the
-    reference-class model (28, 36, 48, 64): at the full-resolution level the
-    per-voxel FLOPs are identical (56^2 / 4 == 28^2) but convs run with
-    56-128 channels instead of 28, so the 128-lane systolic array stays
-    busy; compute in bfloat16 with float32 params and output.
+    Space-to-depth stem with widths scaled by sqrt(prod(s2d_factor))
+    relative to the reference-class model (28, 36, 48, 64): at the
+    full-resolution level the per-voxel FLOPs are identical
+    ((28*s)^2 / s^2 == 28^2) but convs run with wide channels, so the
+    128-lane systolic array stays busy; compute in bfloat16 with float32
+    params and output. The default (1, 2, 2) stem gives 56-128 channels;
+    the aggressive (1, 4, 4) stem (battery A/B ``fwd_tpu_s2d4``) gives
+    112-256 channels at 1/16 the positions — trading first-stage
+    receptive-field granularity for near-saturated MXU lanes.
 
     ``conv_impl='mxu'`` additionally lowers every conv as z-decomposed 2D
     convs / GEMM upsampling (MxuConv / MxuConvTranspose) — identical
     parameters and numerics, different XLA lowering; selected per the
     measured-winner rule once the fwd_tpu_mxu battery step has a number.
     """
+    scale = int(round(float(np.prod(s2d_factor)) ** 0.5))
     return UNet3D(
         in_channels=in_channels,
         out_channels=out_channels,
-        feature_maps=(56, 72, 96, 128),
+        feature_maps=tuple(w * scale for w in (28, 36, 48, 64)),
         down_factors=((1, 2, 2), (2, 2, 2), (2, 2, 2)),
         dtype=dtype,
-        s2d_factor=(1, 2, 2),
+        s2d_factor=s2d_factor,
         conv_impl=conv_impl,
     )
 

@@ -136,3 +136,39 @@ def test_tpu_variant_bf16_through_inferencer():
     assert np.isfinite(arr).all()
     assert arr.std() > 0
     assert arr.dtype == np.float32
+
+
+def test_tpu_s2d4_variant_through_inferencer():
+    """The aggressive (1,4,4) space-to-depth variant (battery A/B
+    fwd_tpu_s2d4): widths scale by sqrt(prod(s2d)) so per-voxel FLOPs at
+    full resolution match the reference-class model, and the fused
+    program runs it end to end."""
+    import numpy as np
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+    from chunkflow_tpu.models import unet3d
+
+    model = unet3d.create_tpu_optimized_model(s2d_factor=(1, 4, 4))
+    assert model.feature_maps == (112, 144, 192, 256)
+    assert model.s2d_factor == (1, 4, 4)
+    # default stem unchanged by the refactor
+    flagship = unet3d.create_tpu_optimized_model()
+    assert flagship.feature_maps == (56, 72, 96, 128)
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="flax",
+        batch_size=2,
+        dtype="bfloat16",
+        model_variant="tpu_s2d4",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    arr = np.asarray(inferencer(chunk).array)
+    assert arr.shape == (3, 8, 32, 32)
+    assert np.isfinite(arr).all()
+    assert arr.std() > 0
