@@ -90,6 +90,14 @@ CONFIGS = [
     # conversion, save_precomputed.py:90-92) — quarter the D2H bytes
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "0", "stream": 5, "output_dtype": "uint8", "blend": "fold"},
+    # production pipeline + uint8 EM input riding the narrow H2D path
+    {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0", "stream": 5, "output_dtype": "uint8", "blend": "fold",
+     "input_dtype": "uint8"},
+    # the aggressive (1,4,4) space-to-depth stem: ~half the HBM traffic
+    # of the flagship at the same per-voxel FLOPs (docs/performance.md)
+    {"model_variant": "tpu_s2d4", "dtype": "bfloat16", "batch_size": 4,
+     "pallas": "0", "stream": 5, "output_dtype": "uint8", "blend": "fold"},
     # fold + pipeline, bfloat16 results (half the D2H bytes)
     {"model_variant": "tpu", "dtype": "bfloat16", "batch_size": 4,
      "pallas": "0", "stream": 5, "output_dtype": "bfloat16",
@@ -171,7 +179,16 @@ def run_config(cfg: dict) -> dict:
 
     chunk_size = tuple(cfg.get("chunk_size", CHUNK_SIZE))
     rng = np.random.default_rng(0)
-    chunk = Chunk(rng.random(chunk_size, dtype=np.float32))
+
+    def make_chunk():
+        # input_dtype=uint8 mirrors production EM imagery and rides the
+        # narrow H2D path (device-side normalize, 1/4 the transfer bytes)
+        if cfg.get("input_dtype") == "uint8":
+            return Chunk(rng.integers(
+                0, 256, chunk_size, dtype=np.uint8))
+        return Chunk(rng.random(chunk_size, dtype=np.float32))
+
+    chunk = make_chunk()
 
     inferencer = Inferencer(
         input_patch_size=INPUT_PATCH,
@@ -207,10 +224,7 @@ def run_config(cfg: dict) -> dict:
 
     n_stream = int(cfg.get("stream", 0))
     if n_stream:
-        chunks = [
-            Chunk(rng.random(chunk_size, dtype=np.float32))
-            for _ in range(n_stream)
-        ]
+        chunks = [make_chunk() for _ in range(n_stream)]
         start = time.perf_counter()
         outs = list(inferencer.stream(iter(chunks)))
         total = time.perf_counter() - start
@@ -356,6 +370,8 @@ def _cfg_name(cfg: dict) -> str:
         name += "-" + "x".join(str(s) for s in cfg["chunk_size"])
     if "overlap" in cfg:
         name += "-ov" + "x".join(str(s) for s in cfg["overlap"])
+    if cfg.get("input_dtype", "float32") != "float32":
+        name += f"-in{cfg['input_dtype']}"
     # env geometry overrides change the measured workload: stamp them into
     # the name so a smoke-scale number can never masquerade as the
     # production-geometry headline (same misattribution rule as

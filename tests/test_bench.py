@@ -132,6 +132,26 @@ def test_cached_hardware_result_shape():
     assert cached["measured_at_commit"] in cached["note"]
 
 
+def test_cached_result_skips_nondefault_geometry(tmp_path, monkeypatch):
+    """A battery row measured at a different patch/overlap geometry
+    (geometry_note) must never win the cached headline: the baseline was
+    measured at the default geometry."""
+    snap = {
+        "bench_fast_geom": {"ok": True, "commit": "c1",
+                            "value": {"mvox_s": 99.0,
+                                      "geometry_note": "overlap 2x32x32"}},
+        "bench_default": {"ok": True, "commit": "c2",
+                          "value": {"mvox_s": 2.0}},
+    }
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    (tools / "tpu_validation_test.json").write_text(json.dumps(snap))
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    cached = bench._cached_hardware_result()
+    assert cached["value"] == 2.0
+    assert cached["config"] == "cached:bench_default"
+
+
 def test_cached_result_prefers_per_row_commit(tmp_path, monkeypatch):
     """A battery row's own commit stamp wins over file-level _meta (resume
     runs can span commits)."""

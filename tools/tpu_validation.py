@@ -21,7 +21,12 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-RESULTS_PATH = os.path.join(os.path.dirname(__file__), "tpu_validation.json")
+# CHUNKFLOW_VALIDATION_RESULTS: redirect for CPU rehearsals so a smoke
+# run can never clobber the live battery's resume cache
+RESULTS_PATH = os.environ.get(
+    "CHUNKFLOW_VALIDATION_RESULTS",
+    os.path.join(os.path.dirname(__file__), "tpu_validation.json"),
+)
 RESULTS: dict = {}
 
 # The tunnel can drop mid-battery (observed: 26 min hang, then connection
@@ -49,6 +54,20 @@ def record(name, value):
     print(f"[{name}] {value}", flush=True)
 
 
+def _env_geometry_note():
+    """Non-empty when geometry env overrides are active (CPU rehearsals):
+    stamped into every row so a smoke-shape number can never pass for a
+    production measurement — bench.py's cached-headline pick skips any
+    row carrying a geometry_note."""
+    names = ("CHUNKFLOW_BENCH_CHUNK", "CHUNKFLOW_BENCH_PATCH",
+             "CHUNKFLOW_BENCH_OVERLAP", "CHUNKFLOW_BENCH_JUMBO")
+    over = {n: os.environ[n] for n in names if os.environ.get(n)}
+    if not over:
+        return ""
+    return "env geometry overrides: " + ", ".join(
+        f"{k.rsplit('_', 1)[-1].lower()}={v}" for k, v in sorted(over.items()))
+
+
 def step(name):
     def deco(fn):
         def run():
@@ -63,6 +82,9 @@ def step(name):
             t0 = time.perf_counter()
             try:
                 value = fn()
+                geom = _env_geometry_note()
+                if geom and isinstance(value, dict):
+                    value.setdefault("geometry_note", geom)
                 record(name, {"ok": True, "value": value,
                               "seconds": round(time.perf_counter() - t0, 1),
                               "commit": _commit()})
@@ -102,12 +124,16 @@ def _git_meta() -> dict:
             capture_output=True, text=True, timeout=10).stdout.strip())
     except Exception:
         commit, dirty = "unknown", False
-    return {
+    meta = {
         "measured_at_commit": commit + ("-dirty" if dirty else ""),
         "measured_at_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "blend_default": "fold-or-scatter-auto (per-batch scatter unless "
                          "fold fits budget); stacked/pallas opt-in",
     }
+    geom = _env_geometry_note()
+    if geom:
+        meta["geometry_note"] = geom
+    return meta
 
 
 @step("tunnel")
@@ -169,6 +195,16 @@ def compile_split():
             "run_s": round(t3 - t2, 3)}
 
 
+
+def _patch_shape():
+    """Flagship fwd-step patch shape: bench.INPUT_PATCH, so the CPU
+    rehearsal's smoke-geometry env overrides shrink these steps too
+    (production default unchanged: 20x256x256)."""
+    import bench
+
+    return tuple(bench.INPUT_PATCH)
+
+
 def _fwd_time(model, params, x, n=3):
     import jax
 
@@ -184,18 +220,27 @@ def _fwd_time(model, params, x, n=3):
     return dt
 
 
-@step("fwd_parity_f32")
-def fwd_parity():
+def _fwd_step(batch, make_model):
+    """Shared raw-forward timing body: one place owns the shape/metric
+    math for every fwd_* A/B step."""
+    import math
+
     import jax.numpy as jnp
 
     from chunkflow_tpu.models import unet3d
 
-    model = unet3d.UNet3D(in_channels=1, out_channels=3)
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((2, 20, 256, 256, 1), jnp.float32)
+    ps = _patch_shape()
+    model = make_model(unet3d)
+    params = unet3d.init_params(model, ps, 1)
+    x = jnp.zeros((batch,) + ps + (1,), jnp.float32)
     dt = _fwd_time(model, params, x)
     return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(2 * 20 * 256 * 256 / dt / 1e6, 2)}
+            "mvox_s": round(batch * math.prod(ps) / dt / 1e6, 2)}
+
+
+@step("fwd_parity_f32")
+def fwd_parity():
+    return _fwd_step(2, lambda u: u.UNet3D(in_channels=1, out_channels=3))
 
 
 def _bench(pallas: str, variant: str, dtype: str, batch: int, **extra):
@@ -215,16 +260,7 @@ def bench_parity():
 
 @step("fwd_tpu_bf16")
 def fwd_tpu_variant():
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.create_tpu_optimized_model()
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
+    return _fwd_step(4, lambda u: u.create_tpu_optimized_model())
 
 
 @step("bench_tpu_bf16_xla")
@@ -237,16 +273,8 @@ def fwd_tpu_mxu():
     """Conv-lowering A/B vs fwd_tpu_bf16: same flagship, same parameters,
     every conv lowered as z-decomposed 2D convs + GEMM upsampling
     (unet3d.MxuConv) instead of XLA's native Conv3D."""
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.create_tpu_optimized_model(conv_impl="mxu")
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
+    return _fwd_step(
+        4, lambda u: u.create_tpu_optimized_model(conv_impl="mxu"))
 
 
 @step("bench_tpu_mxu_fold_stream_u8")
@@ -261,31 +289,14 @@ def fwd_tpu_s2d4():
     """Layout A/B vs fwd_tpu_bf16: aggressive (1,4,4) space-to-depth stem
     (112-256 channels at 1/16 positions, ~same per-voxel FLOPs) — does
     saturating the 128 MXU lanes beat the (1,2,2) flagship?"""
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.create_tpu_optimized_model(s2d_factor=(1, 4, 4))
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(4 * 20 * 256 * 256 / dt / 1e6, 2)}
+    return _fwd_step(
+        4, lambda u: u.create_tpu_optimized_model(s2d_factor=(1, 4, 4)))
 
 
 @step("fwd_tpu_bf16_b8")
 def fwd_tpu_b8():
     """Raw-forward batch A/B: is the 28.5 Mvox/s forward starved at b4?"""
-    import jax.numpy as jnp
-
-    from chunkflow_tpu.models import unet3d
-
-    model = unet3d.create_tpu_optimized_model()
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((8, 20, 256, 256, 1), jnp.float32)
-    dt = _fwd_time(model, params, x)
-    return {"ms": round(dt * 1e3, 1),
-            "mvox_s": round(8 * 20 * 256 * 256 / dt / 1e6, 2)}
+    return _fwd_step(8, lambda u: u.create_tpu_optimized_model())
 
 
 @step("bench_tpu_s2d4_fold_stream_u8")
@@ -303,9 +314,14 @@ def bench_prod_overlap():
     config name carries the overlap stamp, and geometry_note excludes this
     row from the cached-headline pick (the 1.66 baseline was measured at
     the 4x64x64 geometry; cross-geometry wins would misattribute)."""
+    import bench
+
+    # half the default overlap: (2, 32, 32) at production geometry, and
+    # still valid under the CPU rehearsal's smoke-geometry env overrides
+    ov = tuple(o // 2 for o in bench.OUTPUT_OVERLAP)
     r = _bench("0", "tpu", "bfloat16", 4, blend="fold", stream=5,
-               output_dtype="uint8", overlap=(2, 32, 32))
-    r["geometry_note"] = "overlap 2x32x32 (non-default geometry)"
+               output_dtype="uint8", overlap=ov)
+    r["geometry_note"] = f"overlap {'x'.join(map(str, ov))} (non-default)"
     return r
 
 
@@ -456,9 +472,10 @@ def profile_flagship():
 
     from chunkflow_tpu.models import unet3d
 
+    ps = _patch_shape()
     model = unet3d.create_tpu_optimized_model()
-    params = unet3d.init_params(model, (20, 256, 256), 1)
-    x = jnp.zeros((4, 20, 256, 256, 1), jnp.float32)
+    params = unet3d.init_params(model, ps, 1)
+    x = jnp.zeros((4,) + ps + (1,), jnp.float32)
     f = jax.jit(lambda p, v: model.apply({"params": p}, v))
     compiled = f.lower(params, x).compile()
     cost = compiled.cost_analysis()
@@ -540,18 +557,22 @@ def bench_pipeline_seg():
     }
 
 
-@step("bench_jumbo_bf16")
+@step("bench_jumbo_bf16_u8")
 def bench_jumbo():
     """Apples-to-apples with the reference's own headline task: its
     1.66 Mvoxel/s TITAN X number is a 108x2048x2048 affinity cutout
     (tests/data/log/*.json). Production configuration: per-batch scan
     accumulate (the stack budget gates the stacked/fold paths off at this
     size — the OOM-guard path this step exists to exercise), pipelined
-    across 2 jumbo chunks, on-device uint8 results (the reference's own
-    save-time conversion)."""
+    across 2 jumbo chunks, uint8 EM input riding the narrow H2D path
+    (1/4 the transfer bytes of float32; device-side normalize), and
+    on-device uint8 results (the reference's own save-time conversion)."""
+    import bench
+
+    jumbo = bench._env_triple("CHUNKFLOW_BENCH_JUMBO", (108, 2048, 2048))
     return _bench("0", "tpu", "bfloat16", 4,
-                  chunk_size=(108, 2048, 2048), stream=2,
-                  output_dtype="uint8")
+                  chunk_size=jumbo, stream=2,
+                  output_dtype="uint8", input_dtype="uint8")
 
 
 @step("entry_compile")
