@@ -321,6 +321,12 @@ def _cached_hardware_result():
                 # baseline — comparable only within its own battery row,
                 # never as the cached headline
                 continue
+            plat = payload.get("platform")
+            if plat and plat not in ("tpu", "axon"):
+                # a CPU/GPU rehearsal row (e.g. a redirected results file
+                # named tools/tpu_validation_*.json) is not a real-chip
+                # number; legacy rows without the stamp were all on-chip
+                continue
             # provenance: per-row commit stamp if present, else the
             # file-level _meta, else explicit "unknown" (VERDICT r3
             # weak#1: a cached number must say what code it measured).

@@ -152,6 +152,26 @@ def test_cached_result_skips_nondefault_geometry(tmp_path, monkeypatch):
     assert cached["config"] == "cached:bench_default"
 
 
+def test_cached_result_skips_non_tpu_platform(tmp_path, monkeypatch):
+    """A battery row stamped with a CPU backend (rehearsal output saved
+    under a tools/tpu_validation*.json name) must never become the cached
+    'real chip' headline; rows stamped tpu/axon or unstamped (legacy
+    on-chip snapshots) stay eligible."""
+    snap = {
+        "bench_cpu_rehearsal": {"ok": True, "platform": "cpu",
+                                "value": {"mvox_s": 99.0}},
+        "bench_on_chip": {"ok": True, "platform": "axon",
+                          "value": {"mvox_s": 2.0}},
+    }
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    (tools / "tpu_validation_test.json").write_text(json.dumps(snap))
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    cached = bench._cached_hardware_result()
+    assert cached["config"] == "cached:bench_on_chip"
+    assert cached["value"] == 2.0
+
+
 def test_cached_result_prefers_per_row_commit(tmp_path, monkeypatch):
     """A battery row's own commit stamp wins over file-level _meta (resume
     runs can span commits)."""

@@ -87,7 +87,8 @@ def step(name):
                     value.setdefault("geometry_note", geom)
                 record(name, {"ok": True, "value": value,
                               "seconds": round(time.perf_counter() - t0, 1),
-                              "commit": _commit()})
+                              "commit": _commit(),
+                              "platform": _platform()})
                 return True
             except Exception:
                 record(name, {"ok": False,
@@ -97,6 +98,20 @@ def step(name):
         run.step_name = name
         return run
     return deco
+
+
+def _platform() -> str:
+    """Backend this row was measured on ('' if jax not yet imported).
+    bench.py's cached-headline pick rejects non-TPU-class rows, so a CPU
+    rehearsal pointed at a tools/tpu_validation*.json path can never pass
+    for a real-chip number."""
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        return ""
+    try:
+        return str(jaxmod.default_backend())
+    except Exception:
+        return ""
 
 
 _COMMIT_CACHE: list = []
@@ -557,6 +572,88 @@ def bench_pipeline_seg():
     }
 
 
+@step("bench_cli_task_loop")
+def bench_cli_task_loop():
+    """The reference's canonical production path, end to end through the
+    CLI runtime: generate-tasks over a local precomputed volume ->
+    load-precomputed -> flagship inference -> save-precomputed
+    --async-write, with per-task timing-log sidecars. Metric = the
+    reference's own log-summary semantics
+    (/root/reference/chunkflow/flow/log_summary.py:69-71): per-task
+    voxels / per-task seconds from the logs, steady-state = mean over
+    tasks excluding the compile-carrying slowest one (a single
+    invocation, so no cross-invocation retrace is misattributed as
+    runtime overhead)."""
+    import glob
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from click.testing import CliRunner
+
+    import bench
+    from chunkflow_tpu.flow.cli import main as cli_main
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    cz, cy, cx = bench.CHUNK_SIZE
+    n_tasks = 4
+    vol_size = (n_tasks * cz, cy, cx)  # tasks stacked along z
+    tmp = tempfile.mkdtemp(prefix="chunkflow_cli_bench_")
+    try:
+        src = os.path.join(tmp, "src")
+        dst = os.path.join(tmp, "dst")
+        PrecomputedVolume.create(
+            src, volume_size=vol_size, dtype="uint8",
+            voxel_size=(40, 4, 4), block_size=(min(cz, 64),) * 3,
+        )
+        PrecomputedVolume.create(
+            dst, volume_size=vol_size, dtype="uint8", num_channels=3,
+            voxel_size=(40, 4, 4), block_size=(min(cz, 64),) * 3,
+        )
+        vol = PrecomputedVolume(src)
+        from chunkflow_tpu.chunk.base import Chunk
+
+        rng = np.random.default_rng(0)
+        vol.save(Chunk(rng.integers(0, 256, vol_size, dtype=np.uint8)))
+
+        runner = CliRunner()
+        args = [
+            "generate-tasks", "-v", src,
+            "--chunk-size", str(cz), str(cy), str(cx),
+            "load-precomputed", "-v", src,
+            "inference",
+            "--input-patch-size", *map(str, bench.INPUT_PATCH),
+            "--output-patch-overlap", *map(str, bench.OUTPUT_OVERLAP),
+            "--num-output-channels", "3",
+            "--framework", "flax", "--model-variant", "tpu",
+            "--dtype", "bfloat16", "--batch-size", "4",
+            "--output-dtype", "uint8",
+            "save-precomputed", "-v", dst, "--async-write",
+        ]
+        t0 = time.perf_counter()
+        r = runner.invoke(cli_main, args, catch_exceptions=False)
+        wall = time.perf_counter() - t0
+        assert r.exit_code == 0, r.output[-2000:]
+        logs = sorted(glob.glob(os.path.join(dst, "log", "*.json")))
+        assert len(logs) == n_tasks, (len(logs), n_tasks)
+        totals = []
+        for path in logs:
+            with open(path) as f:
+                rec = json.load(f)
+            totals.append(sum(rec["timer"].values()))
+        totals.sort()
+        steady = totals[:-1]  # drop the compile-carrying slowest task
+        nvox_task = float(np.prod(bench.CHUNK_SIZE))
+        return {
+            "mvox_s": round(nvox_task / (sum(steady) / len(steady)) / 1e6, 3),
+            "tasks": n_tasks,
+            "wall_s": round(wall, 1),
+            "task_seconds": [round(t, 2) for t in totals],
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 @step("bench_jumbo_bf16_u8")
 def bench_jumbo():
     """Apples-to-apples with the reference's own headline task: its
@@ -593,6 +690,15 @@ def entry_compile():
 
 
 def main():
+    # Fail malformed geometry env up front (battery start, clear message)
+    # rather than hours in: bench's module import parses CHUNK/PATCH/
+    # OVERLAP; JUMBO is otherwise only parsed inside bench_jumbo, whose
+    # SystemExit would escape the step decorator and kill the battery
+    # with no failure row.
+    import bench
+
+    bench._env_triple("CHUNKFLOW_BENCH_JUMBO", (108, 2048, 2048))
+
     # A/B-first (VERDICT r2 item 2): the blend-default decision — per-batch
     # scatter (default) vs fold vs fold+stream+uint8 vs stacked — must bank
     # inside the first ~10 minutes of a tunnel window; diagnostics and the
@@ -611,7 +717,7 @@ def main():
              profile_flagship, bench_flagship_b8,
              fwd_parity, bench_parity, bench_parity_fold,
              e2e_split, bench_flagship_stream, compile_split,
-             bench_pipeline_seg, bench_jumbo,
+             bench_pipeline_seg, bench_cli_task_loop, bench_jumbo,
              check_pallas_oracle, bench_flagship_pallas,
              entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
