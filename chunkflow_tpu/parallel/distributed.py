@@ -88,6 +88,56 @@ def build_sharded_program(
     return program
 
 
+# compiled-program reuse across chunk tasks with identical geometry: a
+# worker loop must pay the (multi-minute on a pod) XLA compile once, not
+# per chunk. Keyed on engine identity + every shape that feeds tracing.
+_PROGRAM_CACHE: dict = {}
+
+
+def prepare_sharded(
+    chunk_shape,
+    engine,
+    input_patch_size,
+    output_patch_size,
+    output_patch_overlap,
+    batch_size: int,
+    mesh,
+):
+    """Shared plumbing for the single-host and multi-host wrappers:
+    patch grid + padded coordinate arrays + the (cached) compiled
+    program. Returns (program, in_starts, out_starts, valid)."""
+    from chunkflow_tpu.inference.bump import bump_map
+    from chunkflow_tpu.inference.patching import enumerate_patches, pad_to_batch
+
+    grid = enumerate_patches(
+        tuple(chunk_shape), input_patch_size, output_patch_size,
+        output_patch_overlap,
+    )
+    in_starts, out_starts, valid = pad_to_batch(
+        grid, batch_size * mesh.devices.size
+    )
+    key = (
+        id(engine), tuple(chunk_shape), tuple(input_patch_size),
+        tuple(grid.output_patch_size), tuple(output_patch_overlap),
+        batch_size, tuple(mesh.axis_names),
+        tuple(d.id for d in mesh.devices.flat),
+    )
+    program = _PROGRAM_CACHE.get(key)
+    if program is None:
+        program = build_sharded_program(
+            engine.apply,
+            engine.num_input_channels,
+            engine.num_output_channels,
+            input_patch_size,
+            grid.output_patch_size,
+            batch_size,
+            mesh,
+            bump_map(tuple(grid.output_patch_size)),
+        )
+        _PROGRAM_CACHE[key] = program
+    return program, in_starts, out_starts, valid
+
+
 def sharded_inference(
     chunk_array: np.ndarray,
     engine,
@@ -100,28 +150,11 @@ def sharded_inference(
     """Convenience wrapper: run multi-chip fused inference on an array."""
     import jax.numpy as jnp
 
-    from chunkflow_tpu.inference.bump import bump_map
-    from chunkflow_tpu.inference.patching import enumerate_patches, pad_to_batch
-
     if mesh is None:
         mesh = make_mesh()
-    n_dev = mesh.devices.size
-
-    grid = enumerate_patches(
-        chunk_array.shape, input_patch_size, output_patch_size,
-        output_patch_overlap,
-    )
-    in_starts, out_starts, valid = pad_to_batch(grid, batch_size * n_dev)
-
-    program = build_sharded_program(
-        engine.apply,
-        engine.num_input_channels,
-        engine.num_output_channels,
-        input_patch_size,
-        grid.output_patch_size,
-        batch_size,
-        mesh,
-        bump_map(tuple(grid.output_patch_size)),
+    program, in_starts, out_starts, valid = prepare_sharded(
+        chunk_array.shape, engine, input_patch_size, output_patch_size,
+        output_patch_overlap, batch_size, mesh,
     )
     arr = jnp.asarray(chunk_array, dtype=jnp.float32)
     if arr.ndim == 3:

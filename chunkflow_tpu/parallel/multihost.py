@@ -78,3 +78,86 @@ def is_coordinator() -> bool:
     import jax
 
     return jax.process_index() == 0
+
+
+def sharded_inference_global(
+    chunk_array,
+    engine,
+    input_patch_size,
+    output_patch_size,
+    output_patch_overlap,
+    batch_size: int = 1,
+    mesh=None,
+    check_consistency: bool = True,
+):
+    """ONE jit'ed patch-parallel inference program spanning hosts.
+
+    The cross-host analog of ``distributed.sharded_inference`` (which
+    builds process-local arrays and therefore only works when the mesh is
+    fully addressable): every process feeds the same host-side chunk and
+    patch coordinates, inputs become global ``jax.Array``s over the
+    DCN x ICI mesh via ``make_array_from_process_local_data``, the patch
+    list shards across every chip of every host, partial blend buffers
+    merge with one ``psum``, and the replicated output is returned as
+    host numpy read from this process's local shard. The reference has no
+    equivalent — its only cross-host runtime is the task queue.
+
+    ``check_consistency`` (default on): allgather a checksum of the chunk
+    and params first and fail loudly if any process disagrees — divergent
+    "replicated" inputs would otherwise psum into silently corrupt output
+    on every host. Costs one tiny collective per call.
+    """
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from chunkflow_tpu.parallel.distributed import prepare_sharded
+
+    if mesh is None:
+        mesh = global_mesh()
+
+    if check_consistency and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        leaves = jax.tree_util.tree_leaves(engine.params)
+        digest = np.asarray(
+            [float(np.asarray(chunk_array, np.float64).sum())]
+            + [float(np.asarray(leaf, np.float64).sum()) for leaf in leaves],
+            np.float64,
+        )
+        gathered = multihost_utils.process_allgather(digest)
+        if not np.allclose(gathered, gathered[0], rtol=0, atol=0):
+            raise ValueError(
+                "sharded_inference_global: chunk/params checksums differ "
+                f"across processes:\n{gathered}\nevery process must feed "
+                "identical replicated inputs"
+            )
+
+    program, in_starts, out_starts, valid = prepare_sharded(
+        np.asarray(chunk_array).shape, engine, input_patch_size,
+        output_patch_size, output_patch_overlap, batch_size, mesh,
+    )
+
+    def to_global(host_array, spec):
+        host_array = np.asarray(host_array)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), host_array, host_array.shape
+        )
+
+    arr = np.asarray(chunk_array, dtype=np.float32)
+    if arr.ndim == 3:
+        arr = arr[None]
+    out = program(
+        to_global(arr, P()),
+        to_global(np.asarray(in_starts), P("data")),
+        to_global(np.asarray(out_starts), P("data")),
+        to_global(np.asarray(valid), P("data")),
+        jax.tree_util.tree_map(
+            lambda p: to_global(p, P()), engine.params
+        ),
+    )
+    # replicated output: every process holds a full copy locally, but the
+    # global array is not fully addressable from one process — read the
+    # local shard
+    return np.asarray(out.addressable_shards[0].data)

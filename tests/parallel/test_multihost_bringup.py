@@ -57,6 +57,27 @@ expected = float(sum(
     (np.arange(12, dtype=np.float32) + 100 * p).sum() for p in (0, 1)
 ))
 np.testing.assert_allclose(float(total), expected)
+
+# the full cross-host inference program: patch-parallel sharded_inference
+# over the 2-process x 4-device mesh, identity-engine oracle (the blended
+# overlap-add of identity patches must reproduce the input chunk)
+from chunkflow_tpu.inference import engines
+
+pin = (4, 16, 16)
+engine = engines.create_identity_engine(
+    input_patch_size=pin, output_patch_size=pin,
+    num_input_channels=1, num_output_channels=3,
+)
+rng = np.random.default_rng(42)  # same seed everywhere: identical chunks
+chunk = rng.random((8, 32, 32)).astype(np.float32)
+out = multihost.sharded_inference_global(
+    chunk, engine,
+    input_patch_size=pin, output_patch_size=pin,
+    output_patch_overlap=(2, 8, 8), batch_size=1, mesh=mesh,
+)
+assert out.shape == (3, 8, 32, 32), out.shape
+np.testing.assert_allclose(out, np.broadcast_to(chunk, out.shape),
+                           atol=1e-5)
 print("WORKER_OK", {pid})
 """
 
