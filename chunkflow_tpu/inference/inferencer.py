@@ -411,6 +411,20 @@ class Inferencer:
             in_starts, out_starts, valid = pad_to_batch(
                 grid, self.batch_size * n_dev
             )
+            import jax
+
+            if jax.process_count() > 1:
+                # mesh spans hosts: route through the one shared
+                # cross-host recipe (global arrays, cached global params,
+                # checksum consistency guard that fails loudly if two
+                # workers pulled different tasks into one collective)
+                from chunkflow_tpu.parallel.multihost import run_global
+
+                out = run_global(
+                    self._sharded_program, np.asarray(arr), in_starts,
+                    out_starts, valid, self.engine.params, mesh,
+                )
+                return jnp.asarray(out)
             return self._sharded_program(
                 arr,
                 jnp.asarray(in_starts),

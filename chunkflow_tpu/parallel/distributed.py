@@ -91,7 +91,10 @@ def build_sharded_program(
 # compiled-program reuse across chunk tasks with identical geometry: a
 # worker loop must pay the (multi-minute on a pod) XLA compile once, not
 # per chunk. Keyed on engine identity + every shape that feeds tracing.
+# Bounded FIFO: each entry's closure pins its engine (and params) alive,
+# so an unbounded cache would grow without limit across edge-chunk shapes.
 _PROGRAM_CACHE: dict = {}
+_PROGRAM_CACHE_MAX = 16
 
 
 def prepare_sharded(
@@ -122,8 +125,10 @@ def prepare_sharded(
         batch_size, tuple(mesh.axis_names),
         tuple(d.id for d in mesh.devices.flat),
     )
-    program = _PROGRAM_CACHE.get(key)
-    if program is None:
+    entry = _PROGRAM_CACHE.get(key)
+    # the strong engine reference in the entry guarantees id(engine) in
+    # the key cannot be recycled while the entry lives
+    if entry is None or entry[0] is not engine:
         program = build_sharded_program(
             engine.apply,
             engine.num_input_channels,
@@ -134,7 +139,11 @@ def prepare_sharded(
             mesh,
             bump_map(tuple(grid.output_patch_size)),
         )
-        _PROGRAM_CACHE[key] = program
+        _PROGRAM_CACHE[key] = (engine, program)
+        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    else:
+        program = entry[1]
     return program, in_starts, out_starts, valid
 
 

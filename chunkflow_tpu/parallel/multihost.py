@@ -80,6 +80,117 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+# global-params reuse: building global jax.Arrays for the parameter tree
+# is a full H2D transfer — pay it once per (params, mesh), not per chunk.
+# Entries hold a strong reference to the keyed params object, so an id()
+# can never be recycled while its cache entry lives. Bounded FIFO.
+_GLOBAL_PARAMS_CACHE: "dict" = {}
+_PARAMS_DIGEST_CACHE: "dict" = {}
+_CACHE_MAX = 4
+
+
+def _mesh_key(mesh):
+    return (tuple(mesh.axis_names),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+def run_global(
+    program,
+    chunk_arr,
+    in_starts,
+    out_starts,
+    valid,
+    params,
+    mesh,
+    check_consistency: bool = True,
+):
+    """Run a compiled sharded program over a mesh that spans processes.
+
+    The one place that owns the cross-host recipe (used by both
+    ``sharded_inference_global`` and ``Inferencer(sharding='patch')``):
+    host inputs become global ``jax.Array``s via
+    ``make_array_from_process_local_data``, the parameter tree is
+    converted once per (params, mesh) and cached, and the replicated
+    output is read back from this process's local shard.
+
+    ``check_consistency`` (default on): allgather a checksum of the chunk
+    and params first and fail loudly if any process disagrees — divergent
+    "replicated" inputs (e.g. two queue workers that each pulled a
+    DIFFERENT task while sharing one jax.distributed runtime) would
+    otherwise psum into silently corrupt output on every host. The digest
+    is a no-copy float64 sum; NaN entries compare equal so masked chunks
+    don't spuriously abort.
+    """
+    import numpy as np
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mkey = _mesh_key(mesh)
+    if check_consistency and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        dkey = (id(params), mkey)
+        entry = _PARAMS_DIGEST_CACHE.get(dkey)
+        if entry is None or entry[0] is not params:
+            pdig = [
+                float(np.asarray(leaf).sum(dtype=np.float64))
+                for leaf in jax.tree_util.tree_leaves(params)
+            ]
+            _PARAMS_DIGEST_CACHE[dkey] = (params, pdig)
+            while len(_PARAMS_DIGEST_CACHE) > _CACHE_MAX:
+                _PARAMS_DIGEST_CACHE.pop(next(iter(_PARAMS_DIGEST_CACHE)))
+        else:
+            pdig = entry[1]
+        digest = np.asarray(
+            [float(np.asarray(chunk_arr).sum(dtype=np.float64))] + pdig,
+            np.float64,
+        )
+        gathered = multihost_utils.process_allgather(digest)
+        ref = gathered[0][None]
+        same = np.all(
+            (gathered == ref) | (np.isnan(gathered) & np.isnan(ref))
+        )
+        if not same:
+            raise ValueError(
+                "run_global: chunk/params checksums differ across "
+                f"processes:\n{gathered}\nevery process must feed "
+                "identical replicated inputs (did two workers pull "
+                "different tasks while sharing one jax.distributed "
+                "runtime?)"
+            )
+
+    def to_global(host_array, spec):
+        host_array = np.asarray(host_array)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), host_array, host_array.shape
+        )
+
+    gkey = (id(params), mkey)
+    entry = _GLOBAL_PARAMS_CACHE.get(gkey)
+    if entry is None or entry[0] is not params:
+        gparams = jax.tree_util.tree_map(
+            lambda p: to_global(p, P()), params
+        )
+        _GLOBAL_PARAMS_CACHE[gkey] = (params, gparams)
+        while len(_GLOBAL_PARAMS_CACHE) > _CACHE_MAX:
+            _GLOBAL_PARAMS_CACHE.pop(next(iter(_GLOBAL_PARAMS_CACHE)))
+    else:
+        gparams = entry[1]
+
+    out = program(
+        to_global(chunk_arr, P()),
+        to_global(np.asarray(in_starts), P("data")),
+        to_global(np.asarray(out_starts), P("data")),
+        to_global(np.asarray(valid), P("data")),
+        gparams,
+    )
+    # replicated output: every process holds a full copy locally, but the
+    # global array is not fully addressable from one process — read the
+    # local shard
+    return np.asarray(out.addressable_shards[0].data)
+
+
 def sharded_inference_global(
     chunk_array,
     engine,
@@ -94,70 +205,25 @@ def sharded_inference_global(
 
     The cross-host analog of ``distributed.sharded_inference`` (which
     builds process-local arrays and therefore only works when the mesh is
-    fully addressable): every process feeds the same host-side chunk and
-    patch coordinates, inputs become global ``jax.Array``s over the
-    DCN x ICI mesh via ``make_array_from_process_local_data``, the patch
-    list shards across every chip of every host, partial blend buffers
-    merge with one ``psum``, and the replicated output is returned as
-    host numpy read from this process's local shard. The reference has no
-    equivalent — its only cross-host runtime is the task queue.
-
-    ``check_consistency`` (default on): allgather a checksum of the chunk
-    and params first and fail loudly if any process disagrees — divergent
-    "replicated" inputs would otherwise psum into silently corrupt output
-    on every host. Costs one tiny collective per call.
+    fully addressable). See :func:`run_global` for the global-array
+    recipe and the consistency guard. The reference has no equivalent —
+    its only cross-host runtime is the task queue.
     """
     import numpy as np
-
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from chunkflow_tpu.parallel.distributed import prepare_sharded
 
     if mesh is None:
         mesh = global_mesh()
 
-    if check_consistency and jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        leaves = jax.tree_util.tree_leaves(engine.params)
-        digest = np.asarray(
-            [float(np.asarray(chunk_array, np.float64).sum())]
-            + [float(np.asarray(leaf, np.float64).sum()) for leaf in leaves],
-            np.float64,
-        )
-        gathered = multihost_utils.process_allgather(digest)
-        if not np.allclose(gathered, gathered[0], rtol=0, atol=0):
-            raise ValueError(
-                "sharded_inference_global: chunk/params checksums differ "
-                f"across processes:\n{gathered}\nevery process must feed "
-                "identical replicated inputs"
-            )
-
     program, in_starts, out_starts, valid = prepare_sharded(
         np.asarray(chunk_array).shape, engine, input_patch_size,
         output_patch_size, output_patch_overlap, batch_size, mesh,
     )
-
-    def to_global(host_array, spec):
-        host_array = np.asarray(host_array)
-        return jax.make_array_from_process_local_data(
-            NamedSharding(mesh, spec), host_array, host_array.shape
-        )
-
     arr = np.asarray(chunk_array, dtype=np.float32)
     if arr.ndim == 3:
         arr = arr[None]
-    out = program(
-        to_global(arr, P()),
-        to_global(np.asarray(in_starts), P("data")),
-        to_global(np.asarray(out_starts), P("data")),
-        to_global(np.asarray(valid), P("data")),
-        jax.tree_util.tree_map(
-            lambda p: to_global(p, P()), engine.params
-        ),
+    return run_global(
+        program, arr, in_starts, out_starts, valid, engine.params, mesh,
+        check_consistency=check_consistency,
     )
-    # replicated output: every process holds a full copy locally, but the
-    # global array is not fully addressable from one process — read the
-    # local shard
-    return np.asarray(out.addressable_shards[0].data)

@@ -78,6 +78,26 @@ out = multihost.sharded_inference_global(
 assert out.shape == (3, 8, 32, 32), out.shape
 np.testing.assert_allclose(out, np.broadcast_to(chunk, out.shape),
                            atol=1e-5)
+
+# the production surface: Inferencer(sharding='patch') routes through the
+# same global-array path whenever the runtime spans processes
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.inference.inferencer import Inferencer
+
+inferencer = Inferencer(
+    input_patch_size=pin,
+    output_patch_overlap=(2, 8, 8),
+    num_output_channels=3,
+    framework="identity",
+    batch_size=1,
+    sharding="patch",
+    crop_output_margin=False,
+)
+inferencer._mesh = mesh
+out2 = np.asarray(inferencer(Chunk(chunk)).array)
+assert out2.shape == (3, 8, 32, 32), out2.shape
+np.testing.assert_allclose(out2, np.broadcast_to(chunk, out2.shape),
+                           atol=1e-5)
 print("WORKER_OK", {pid})
 """
 
