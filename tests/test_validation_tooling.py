@@ -1,0 +1,111 @@
+"""Unit tests for the battery's provenance machinery
+(tools/tpu_validation.py): every recorded row must carry the measuring
+commit, the jax backend, and — under geometry env overrides — a
+geometry_note, so a rehearsal number can never masquerade as a
+production on-chip measurement (ROUND4.md §2)."""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+_TV_PATH = Path(__file__).resolve().parents[1] / "tools" / "tpu_validation.py"
+
+
+@pytest.fixture()
+def tv(tmp_path, monkeypatch):
+    """Import tools/tpu_validation.py with a redirected results file."""
+    results = tmp_path / "results.json"
+    monkeypatch.setenv("CHUNKFLOW_VALIDATION_RESULTS", str(results))
+    monkeypatch.setenv("CHUNKFLOW_REVALIDATE", "1")
+    spec = importlib.util.spec_from_file_location(
+        "tv_under_test", str(_TV_PATH)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["tv_under_test"] = mod
+    try:
+        spec.loader.exec_module(mod)
+        yield mod, results
+    finally:
+        sys.modules.pop("tv_under_test", None)
+
+
+def test_env_geometry_note_empty_without_overrides(tv, monkeypatch):
+    mod, _ = tv
+    for name in ("CHUNKFLOW_BENCH_CHUNK", "CHUNKFLOW_BENCH_PATCH",
+                 "CHUNKFLOW_BENCH_OVERLAP", "CHUNKFLOW_BENCH_JUMBO"):
+        monkeypatch.delenv(name, raising=False)
+    assert mod._env_geometry_note() == ""
+
+
+def test_env_geometry_note_lists_overrides(tv, monkeypatch):
+    mod, _ = tv
+    monkeypatch.setenv("CHUNKFLOW_BENCH_CHUNK", "16,64,64")
+    monkeypatch.setenv("CHUNKFLOW_BENCH_JUMBO", "24,128,128")
+    note = mod._env_geometry_note()
+    assert "chunk=16,64,64" in note
+    assert "jumbo=24,128,128" in note
+
+
+def test_step_stamps_commit_platform_and_geometry(tv, monkeypatch):
+    mod, results = tv
+    monkeypatch.setenv("CHUNKFLOW_BENCH_CHUNK", "16,64,64")
+
+    @mod.step("bench_fake")
+    def fake():
+        return {"mvox_s": 1.0}
+
+    assert fake()
+    row = json.loads(results.read_text())["bench_fake"]
+    assert row["ok"] is True
+    assert row["commit"] and row["commit"] != "unknown"
+    # conftest pins the cpu backend and jax is already imported, so the
+    # platform stamp must be exactly "cpu" — "" would mean stamping broke
+    assert row["platform"] == "cpu"
+    assert "geometry_note" in row["value"]
+
+
+def test_step_records_failure_with_provenance(tv):
+    mod, results = tv
+
+    @mod.step("bench_boom")
+    def boom():
+        raise RuntimeError("deliberate")
+
+    assert not boom()
+    row = json.loads(results.read_text())["bench_boom"]
+    assert row["ok"] is False
+    assert "deliberate" in row["error"]
+    # failure rows carry provenance too: a failed row in the resume cache
+    # must be attributable to the commit/platform it failed on
+    assert row["commit"] and row["commit"] != "unknown"
+    assert row["platform"] == "cpu"
+
+
+def test_step_resume_skips_prior_success(tv):
+    mod, results = tv
+    calls = []
+
+    @mod.step("bench_once")
+    def once():
+        calls.append(1)
+        return {"mvox_s": 2.0}
+
+    assert once()
+    assert once()  # second call skips (prior ok)
+    assert len(calls) == 1
+
+
+def test_tunnel_step_never_resume_skipped(tv):
+    mod, _ = tv
+    calls = []
+
+    @mod.step("tunnel")
+    def fake_tunnel():
+        calls.append(1)
+        return "devices"
+
+    assert fake_tunnel()
+    assert fake_tunnel()
+    assert len(calls) == 2  # liveness gate re-runs every attempt
