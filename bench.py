@@ -200,6 +200,7 @@ def run_config(cfg: dict) -> dict:
         output_dtype=cfg.get("output_dtype", "float32"),
         model_variant=cfg["model_variant"],
         blend=cfg.get("blend", "auto"),
+        augment=bool(cfg.get("tta")),
         crop_output_margin=False,
     )
 
@@ -378,6 +379,8 @@ def _cfg_name(cfg: dict) -> str:
         name += "-ov" + "x".join(str(s) for s in cfg["overlap"])
     if cfg.get("input_dtype", "float32") != "float32":
         name += f"-in{cfg['input_dtype']}"
+    if cfg.get("tta"):
+        name += "-tta8"
     # env geometry overrides change the measured workload: stamp them into
     # the name so a smoke-scale number can never masquerade as the
     # production-geometry headline (same misattribution rule as

@@ -323,6 +323,17 @@ def bench_s2d4_fold_stream_u8():
                   output_dtype="uint8")
 
 
+@step("bench_tpu_tta8")
+def bench_tta8():
+    """8x test-time augmentation (the reference's production option,
+    transform.py:114-156) on the full production stack: the scanned-TTA
+    design compiles the UNet once — this row prices what TTA actually
+    costs on chip (ideal: 1/8 the non-TTA throughput; better means the
+    forward was launch-bound)."""
+    return _bench("0", "tpu", "bfloat16", 4, blend="fold", stream=2,
+                  output_dtype="uint8", tta=True)
+
+
 @step("bench_tpu_prod_overlap")
 def bench_prod_overlap():
     """Geometry A/B: the reference's own production tutorial runs overlap
@@ -723,7 +734,7 @@ def main():
              fwd_tpu_variant, fwd_tpu_mxu,  # conv-lowering A/B
              fwd_tpu_s2d4, fwd_tpu_b8,      # layout / batch A/Bs
              bench_mxu_fold_stream_u8, bench_s2d4_fold_stream_u8,
-             bench_prod_overlap,
+             bench_prod_overlap, bench_tta8,
              profile_flagship, bench_flagship_b8,
              fwd_parity, bench_parity, bench_parity_fold,
              e2e_split, bench_flagship_stream, compile_split,
