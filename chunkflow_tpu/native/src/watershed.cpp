@@ -7,14 +7,21 @@
 // Algorithm:
 //  1. seeds: connected components of the graph restricted to edges with
 //     affinity >= t_high (strongly-connected cores);
-//  2. grow: flood remaining edges in descending affinity order via a
-//     bucket queue (32768 affinity buckets — quantized ordering, FIFO
-//     within a bucket); an edge with exactly one labeled endpoint
-//     extends that region; edges below t_low never grow (those voxels
-//     stay 0). The bucket queue replaces a binary heap: O(1) push/pop
-//     instead of O(log n) over ~6n pushes, and edges are enumerated
-//     implicitly from the affinity array (no materialized edge vector —
-//     the old one cost 24 bytes x 3n, 1.2 GB at 64x512x512).
+//  2. fragments: steepest-ascent watershed (Zlateski/Seung zwatershed
+//     semantics — the fragment algorithm behind the reference's waterz
+//     wheel): edges below t_low are removed, every voxel computes its
+//     best surviving incident affinity, and each surviving edge that is
+//     the steepest edge of either endpoint is contracted. Voxels with no
+//     surviving edge stay background (0). Order-independent linear
+//     passes, no queue — measured 0.4 s vs 18.8 s for a priority-flood
+//     at 64x512x512, with equal quality-harness ARI/VOI (the flood
+//     variant was deleted per the measured-winner rule; history in git).
+//     Tie semantics (canonical zwatershed): ALL tied steepest edges
+//     contract, so a constant-affinity plateau becomes one fragment and
+//     can bridge seed cores it touches — measured harmless on
+//     uint8-quantized realistic fixtures (ARI 1.0,
+//     tests/test_native.py::TestAgglomerationQuality::test_quantized_...)
+//     and pinned as documented behavior by ::test_plateau_merges_as_one.
 //  3. agglomerate: region adjacency graph scored by mean affinity of
 //     boundary edges; hierarchical greedy merging (highest current score
 //     first) with full boundary-statistic rescoring after every merge —
@@ -58,16 +65,6 @@ struct UnionFind {
     return true;
   }
 };
-
-// affinity quantization for the flood; 32768 so bucket+1 fits uint16 in
-// the per-voxel queued[] dedup array (resolution 3e-5 — far below any
-// meaningful affinity difference)
-constexpr int kBuckets = 32768;
-
-inline int bucket_of(float a) {
-  int b = static_cast<int>(a * (kBuckets - 1));
-  return b < 0 ? 0 : (b >= kBuckets ? kBuckets - 1 : b);
-}
 
 // CHUNKFLOW_WATERSHED_TIMING=1: phase timings on stderr (perf diagnosis)
 struct PhaseTimer {
@@ -126,83 +123,49 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
     }
 
   timer.lap("phase1 seeds");
-  // ---- 2: bucket-queue flood: attach the unlabeled voxel with the
-  // highest-affinity edge to any region, highest buckets first ----
+  // ---- 2: steepest-ascent fragments (see header) ----
   {
-    const int low_bucket = bucket_of(t_low);
-    // per-bucket FIFO of packed (unlabeled voxel v << 3) | edge direction,
-    // where direction 0..2 = v's neighbor at +stride[d] (edge stored at
-    // v + stride[d], channel d), 3..5 = v's neighbor at -stride[d] (edge
-    // stored at v, channel d-3).
-    std::vector<std::vector<int64_t>> buckets(kBuckets);
-    std::vector<size_t> pos(kBuckets, 0);  // drain cursor per bucket
-    // best queued bucket per voxel + 1 (0 = never queued): a voxel seen
-    // from several labeled neighbors is pushed only when the new edge
-    // outranks its best queued one — cuts duplicate pushes (the flood is
-    // memory-bound; fewer pushes = fewer cache misses)
-    std::vector<uint16_t> queued(n, 0);
-
-    auto push_edges_of_labeled = [&](int64_t u, int& top) {
-      const int64_t x = u % sx;
-      const int64_t y = (u / sx) % sy;
-      const int64_t z = u / (sx * sy);
-      // v = u - stride[d]: edge stored at u, channel d; from v's view the
-      // labeled neighbor is at +stride[d] -> direction d
-      const bool lo_ok[3] = {z > 0, y > 0, x > 0};
-      const bool hi_ok[3] = {z + 1 < sz, y + 1 < sy, x + 1 < sx};
-      for (int d = 0; d < 3; ++d) {
-        if (lo_ok[d]) {
-          const int64_t v = u - strides[d];
-          if (!active[v]) {
-            const int b = bucket_of(chan[d][u]);
-            if (b + 1 > queued[v]) {
-              queued[v] = static_cast<uint16_t>(b + 1);
-              buckets[b].push_back((v << 3) | d);
-              if (b > top) top = b;
-            }
+    // one edge enumerator shared by both passes: edges of channel d
+    // connect i and i - strides[d]; the axis-d loop starts at 1 so no
+    // per-voxel bounds check is needed
+    auto for_each_edge = [&](int d, auto&& fn) {
+      const float* a = chan[d];
+      const int64_t s = strides[d];
+      const int64_t z0 = (d == 0) ? 1 : 0;
+      const int64_t y0 = (d == 1) ? 1 : 0;
+      const int64_t x0 = (d == 2) ? 1 : 0;
+      for (int64_t z = z0; z < sz; ++z)
+        for (int64_t y = y0; y < sy; ++y) {
+          const int64_t row = (z * sy + y) * sx;
+          for (int64_t x = x0; x < sx; ++x) {
+            const int64_t i = row + x;
+            fn(i, i - s, a[i]);
           }
         }
-        if (hi_ok[d]) {
-          const int64_t v = u + strides[d];
-          if (!active[v]) {
-            const int b = bucket_of(chan[d][v]);
-            if (b + 1 > queued[v]) {
-              queued[v] = static_cast<uint16_t>(b + 1);
-              buckets[b].push_back((v << 3) | (d + 3));
-              if (b > top) top = b;
-            }
-          }
-        }
-      }
     };
 
-    int top = -1;
-    for (int64_t i = 0; i < n; ++i)
-      if (active[i]) push_edges_of_labeled(i, top);
-
-    for (int b = top; b >= low_bucket; ) {
-      if (pos[b] >= buckets[b].size()) {
-        // keep capacity: b bounces up/down constantly and shrink/regrow
-        // realloc churn dominates otherwise
-        buckets[b].clear();
-        pos[b] = 0;
-        --b;
-        continue;
-      }
-      const int64_t packed = buckets[b][pos[b]++];
-      const int64_t v = packed >> 3;
-      if (active[v]) continue;  // already claimed by a stronger edge
-      const int dir = static_cast<int>(packed & 7);
-      const int64_t u = dir < 3 ? v + strides[dir] : v - strides[dir - 3];
-      uf.unite(static_cast<uint32_t>(u), static_cast<uint32_t>(v));
-      active[v] = 1;
-      int new_top = b;
-      push_edges_of_labeled(v, new_top);
-      b = new_top;  // claimed voxel may expose higher-affinity edges
-    }
+    // best surviving (>= t_low) incident affinity per voxel; the filter
+    // runs BEFORE the steepest computation (zwatershed order), so a
+    // voxel whose strongest edge was removed can still be claimed by a
+    // neighbor whose steepest surviving edge reaches it
+    std::vector<float> best(n, 0.0f);
+    for (int d = 0; d < 3; ++d)
+      for_each_edge(d, [&](int64_t i, int64_t j, float e) {
+        if (e < t_low) return;  // removed edge
+        if (e > best[i]) best[i] = e;
+        if (e > best[j]) best[j] = e;
+      });
+    for (int d = 0; d < 3; ++d)
+      for_each_edge(d, [&](int64_t i, int64_t j, float e) {
+        if (e < t_low) return;
+        if (e == best[i] || e == best[j]) {
+          uf.unite(static_cast<uint32_t>(i), static_cast<uint32_t>(j));
+          active[i] = active[j] = 1;
+        }
+      });
   }
 
-  timer.lap("phase2 flood");
+  timer.lap("phase2 fragments");
   // compact region ids
   std::vector<uint32_t> ids(n, 0);
   uint32_t nseg = 0;

@@ -375,6 +375,39 @@ class TestAgglomerationQuality:
         assert m["adjusted_rand_index"] >= 0.95, m
         assert m["voi_split"] + m["voi_merge"] <= 0.10, m
 
+    def test_quantized_affinities_quality_floor(self):
+        """uint8-quantized affinities (save-precomputed then agglomerate)
+        make exact ties ubiquitous; the steepest-ascent tie rule (ALL
+        tied maximal edges contract) must not degrade quality. Measured
+        ARI 1.0 on both fixtures (2026-07-30)."""
+        from chunkflow_tpu.chunk.segmentation import Segmentation
+
+        for fixture, params in [
+            (_voronoi_affinity_fixture(0.05, 0.9, 0.1), (0.9, 0.3, 0.5)),
+            (_voronoi_affinity_fixture(0.15, 0.85, 0.15, seed=1),
+             (0.9, 0.2, 0.6)),
+        ]:
+            aff, gt = fixture
+            q = (np.round(aff * 255) / 255).astype(np.float32)
+            seg, count = native.watershed_agglomerate(q, *params)
+            assert count == 12, count
+            m = Segmentation(seg).evaluate(gt)
+            assert m["adjusted_rand_index"] >= 0.95, m
+
+    def test_plateau_merges_as_one(self):
+        """Documented steepest-ascent tie semantics (canonical
+        zwatershed): a constant-affinity plateau is one fragment and
+        bridges the seed cores it touches. Real affinity maps never hold
+        an exactly-constant plateau spanning two true objects; the
+        quantized-fixture test above shows realistic ties are harmless."""
+        aff = np.full((3, 8, 16, 32), 0.5, np.float32)
+        aff[:, :, :, :6] = 0.995
+        aff[:, :, :, 26:] = 0.995
+        seg, count = native.watershed_agglomerate(
+            aff, 0.99, 0.3, 2.0)  # merge_threshold 2.0: no agglomeration
+        assert count == 1, count
+        assert seg[0, 0, 0] == seg[0, 0, -1]
+
 
 class TestAgglomerationThinProcesses:
     def test_parallel_tubes_do_not_merge(self):
