@@ -102,6 +102,50 @@ print("WORKER_OK", {pid})
 """
 
 
+DIVERGENT_WORKER = r"""
+import sys
+sys.path.insert(0, {repo!r})
+
+from chunkflow_tpu.parallel import multihost
+
+multihost.initialize(
+    coordinator_address={coord!r},
+    num_processes=2,
+    process_id={pid},
+)
+import jax
+import numpy as np
+
+# a silent single-process bring-up (the documented sitecustomize failure
+# mode) would skip the guard's process_count gate entirely — fail here
+# with the real diagnosis instead of a bogus "guard did not fire"
+assert jax.process_count() == 2, jax.process_count()
+
+from chunkflow_tpu.inference import engines
+
+pin = (4, 16, 16)
+engine = engines.create_identity_engine(
+    input_patch_size=pin, output_patch_size=pin,
+    num_input_channels=1, num_output_channels=3,
+)
+# DIFFERENT chunk per process: the checksum guard must abort loudly on
+# every host instead of psum-ing silently corrupt output
+rng = np.random.default_rng(100 + {pid})
+chunk = rng.random((8, 32, 32)).astype(np.float32)
+try:
+    multihost.sharded_inference_global(
+        chunk, engine,
+        input_patch_size=pin, output_patch_size=pin,
+        output_patch_overlap=(2, 8, 8), batch_size=1,
+    )
+except ValueError as e:
+    assert "checksums differ" in str(e), e
+    print("GUARD_FIRED", {pid})
+else:
+    raise AssertionError("divergent inputs were not rejected")
+"""
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -131,7 +175,7 @@ def _worker_env() -> dict:
     return env
 
 
-def test_two_process_distributed_bringup(tmp_path):
+def _run_two_workers(tmp_path, template, ok_marker):
     import chunkflow_tpu
 
     repo = str(next(iter(chunkflow_tpu.__path__)).rsplit("/", 1)[0])
@@ -145,7 +189,7 @@ def test_two_process_distributed_bringup(tmp_path):
         with open(logs[pid], "w") as log:
             procs.append(subprocess.Popen(
                 [sys.executable, "-c",
-                 WORKER.format(repo=repo, coord=coord, pid=pid)],
+                 template.format(repo=repo, coord=coord, pid=pid)],
                 stdout=log, stderr=subprocess.STDOUT, env=_worker_env(),
             ))
     try:
@@ -159,7 +203,7 @@ def test_two_process_distributed_bringup(tmp_path):
                 if p.poll() is not None:
                     out = logs[pid].read_text()
                     assert p.returncode == 0, f"worker {pid} failed:\n{out}"
-                    assert f"WORKER_OK {pid}" in out
+                    assert f"{ok_marker} {pid}" in out
                     del pending[pid]
             time.sleep(0.2)
         assert not pending, f"workers {sorted(pending)} timed out"
@@ -169,3 +213,14 @@ def test_two_process_distributed_bringup(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_consistency_guard_rejects_divergent_inputs(tmp_path):
+    """Two processes feed DIFFERENT chunks into one collective: the
+    checksum allgather must raise on every host (silent cross-host
+    psum corruption is the failure mode this guards)."""
+    _run_two_workers(tmp_path, DIVERGENT_WORKER, "GUARD_FIRED")
+
+
+def test_two_process_distributed_bringup(tmp_path):
+    _run_two_workers(tmp_path, WORKER, "WORKER_OK")
