@@ -281,6 +281,14 @@ _TUNNEL_ERROR_MARKS = (
     "DEADLINE_EXCEEDED",
 )
 
+# The only files whose rows may lack a platform stamp and still count as
+# on-chip: round-2 snapshots frozen before rows carried the stamp. Rows
+# in any other tpu_validation*.json must stamp tpu/axon (ADVICE r4).
+_LEGACY_UNSTAMPED_SNAPSHOTS = frozenset({
+    "tpu_validation_oldblend.json",
+    "tpu_validation_r02_partial.json",
+})
+
 
 def _failures_look_like_dead_tunnel(results: dict) -> bool:
     errors = [
@@ -323,10 +331,19 @@ def _cached_hardware_result():
                 # never as the cached headline
                 continue
             plat = payload.get("platform")
-            if plat and plat not in ("tpu", "axon"):
-                # a CPU/GPU rehearsal row (e.g. a redirected results file
-                # named tools/tpu_validation_*.json) is not a real-chip
-                # number; legacy rows without the stamp were all on-chip
+            if plat:
+                if plat not in ("tpu", "axon"):
+                    # a CPU/GPU rehearsal row (e.g. a redirected results
+                    # file named tools/tpu_validation_*.json) is not a
+                    # real-chip number
+                    continue
+            elif os.path.basename(path) not in _LEGACY_UNSTAMPED_SNAPSHOTS:
+                # ADVICE r4: the no-stamp exemption is frozen to the two
+                # known round-2 snapshot files (measured before rows
+                # carried a platform stamp, verified on-chip at the
+                # time). Any OTHER file must stamp tpu/axon explicitly —
+                # a future rehearsal tool writing unstamped rows into a
+                # tpu_validation*.json name must not regain eligibility.
                 continue
             # provenance: per-row commit stamp if present, else the
             # file-level _meta, else explicit "unknown" (VERDICT r3

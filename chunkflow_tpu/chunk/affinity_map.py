@@ -15,6 +15,46 @@ class AffinityMap(Chunk):
         # Chunk.__init__ copies all metadata when given a Chunk
         return cls(chunk)
 
+    @classmethod
+    def from_segmentation(
+        cls,
+        seg,
+        inside: float = 1.0,
+        boundary: float = 0.0,
+        **kwargs,
+    ) -> "AffinityMap":
+        """Ground-truth affinity graph of a segmentation.
+
+        Channel ``c`` at voxel (z, y, x) holds the edge to its neighbor
+        one step NEGATIVE along axis ``c`` — the zyx convention shared by
+        the native watershed (native/src/watershed.cpp) and the
+        reference's affinity outputs. An edge scores ``inside`` iff both
+        endpoints share the same nonzero label, else ``boundary``;
+        label 0 is background and never connects. Leading-plane edges
+        (no neighbor in range) score ``inside`` (self-edge). Used for
+        training-target generation and as the analytic fixture behind
+        the agglomeration quality harness and watershed bench.
+        """
+        if isinstance(seg, Chunk):
+            kwargs.setdefault("voxel_offset", seg.voxel_offset)
+            kwargs.setdefault("voxel_size", seg.voxel_size)
+            seg = seg.array
+        arr = np.asarray(seg)
+        if arr.ndim != 3:
+            raise ValueError(f"need a 3D (z, y, x) segmentation, got "
+                             f"{arr.shape}")
+        aff = np.full((3,) + arr.shape, np.float32(inside), np.float32)
+        for c in range(3):
+            sl_a = [slice(None)] * 3
+            sl_b = [slice(None)] * 3
+            sl_a[c] = slice(1, None)
+            sl_b[c] = slice(0, -1)
+            a, b = arr[tuple(sl_a)], arr[tuple(sl_b)]
+            aff[(c, *sl_a)] = np.where(
+                (a == b) & (a != 0), np.float32(inside), np.float32(boundary)
+            )
+        return cls(aff, **kwargs)
+
     def __init__(self, array, **kwargs):
         kwargs.setdefault("layer_type", LayerType.AFFINITY_MAP)
         super().__init__(array, **kwargs)

@@ -83,7 +83,11 @@ def is_coordinator() -> bool:
 # global-params reuse: building global jax.Arrays for the parameter tree
 # is a full H2D transfer — pay it once per (params, mesh), not per chunk.
 # Entries hold a strong reference to the keyed params object, so an id()
-# can never be recycled while its cache entry lives. Bounded FIFO.
+# can never be recycled while its cache entry lives; a cheap content
+# fingerprint (leaf shapes/dtypes + strided-sample sums) is re-checked on
+# every hit so reloading weights INTO the same pytree in place invalidates
+# the entry instead of silently serving stale device params (ADVICE r4).
+# Bounded FIFO.
 _GLOBAL_PARAMS_CACHE: "dict" = {}
 _PARAMS_DIGEST_CACHE: "dict" = {}
 _CACHE_MAX = 4
@@ -92,6 +96,62 @@ _CACHE_MAX = 4
 def _mesh_key(mesh):
     return (tuple(mesh.axis_names),
             tuple(d.id for d in mesh.devices.flat))
+
+
+def _params_fingerprint(params) -> tuple:
+    """O(leaves * 128) content fingerprint: shape, dtype, and a
+    strided-sample float64 sum per leaf. Not cryptographic — it exists to
+    catch in-place weight reloads, which change many entries at once."""
+    import numpy as np
+
+    import jax
+
+    parts = []
+    for leaf in jax.tree_util.tree_leaves(params):
+        a = np.asarray(leaf)
+        flat = a.reshape(-1)
+        stride = max(1, flat.size // 128)
+        parts.append((
+            a.shape, str(a.dtype),
+            float(flat[::stride].sum(dtype=np.float64)),
+        ))
+    return tuple(parts)
+
+
+def _chunk_digest(arr) -> "list":
+    """Per-process digest of a replicated input: full float64 sum plus
+    shape-crc, nan-aware min/max, and a crc32 of a strided byte sample —
+    so permuted or sign-cancelling divergence that keeps the plain sum
+    equal still trips the guard (ADVICE r4)."""
+    import warnings
+    import zlib
+
+    import numpy as np
+
+    a = np.asarray(arr)
+    flat = a.reshape(-1)
+    if flat.size == 0:
+        return [0.0, float(zlib.crc32(repr(a.shape).encode())), 0.0, 0.0,
+                0.0]
+    stride = max(1, flat.size // 16384)
+    sample = np.ascontiguousarray(flat[::stride])
+    if np.issubdtype(flat.dtype, np.floating):
+        # nanmin/nanmax are no-copy scans (this runs per chunk); all-NaN
+        # yields NaN, which the NaN-aware compare in run_global accepts
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            lo = float(np.nanmin(flat))
+            hi = float(np.nanmax(flat))
+    else:
+        lo = float(flat.min())
+        hi = float(flat.max())
+    return [
+        float(flat.sum(dtype=np.float64)),
+        float(zlib.crc32(repr(a.shape).encode())),
+        lo,
+        hi,
+        float(zlib.crc32(sample.tobytes())),
+    ]
 
 
 def run_global(
@@ -113,13 +173,14 @@ def run_global(
     converted once per (params, mesh) and cached, and the replicated
     output is read back from this process's local shard.
 
-    ``check_consistency`` (default on): allgather a checksum of the chunk
+    ``check_consistency`` (default on): allgather a digest of the chunk
     and params first and fail loudly if any process disagrees — divergent
     "replicated" inputs (e.g. two queue workers that each pulled a
     DIFFERENT task while sharing one jax.distributed runtime) would
-    otherwise psum into silently corrupt output on every host. The digest
-    is a no-copy float64 sum; NaN entries compare equal so masked chunks
-    don't spuriously abort.
+    otherwise psum into silently corrupt output on every host. The chunk
+    digest is sum + shape-crc + min/max + a strided-sample byte crc (a
+    permutation of the same values no longer slips through); NaN entries
+    compare equal so masked chunks don't spuriously abort.
     """
     import numpy as np
 
@@ -127,25 +188,23 @@ def run_global(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mkey = _mesh_key(mesh)
+    fingerprint = _params_fingerprint(params)
     if check_consistency and jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         dkey = (id(params), mkey)
         entry = _PARAMS_DIGEST_CACHE.get(dkey)
-        if entry is None or entry[0] is not params:
+        if entry is None or entry[0] is not params or entry[1] != fingerprint:
             pdig = [
                 float(np.asarray(leaf).sum(dtype=np.float64))
                 for leaf in jax.tree_util.tree_leaves(params)
             ]
-            _PARAMS_DIGEST_CACHE[dkey] = (params, pdig)
+            _PARAMS_DIGEST_CACHE[dkey] = (params, fingerprint, pdig)
             while len(_PARAMS_DIGEST_CACHE) > _CACHE_MAX:
                 _PARAMS_DIGEST_CACHE.pop(next(iter(_PARAMS_DIGEST_CACHE)))
         else:
-            pdig = entry[1]
-        digest = np.asarray(
-            [float(np.asarray(chunk_arr).sum(dtype=np.float64))] + pdig,
-            np.float64,
-        )
+            pdig = entry[2]
+        digest = np.asarray(_chunk_digest(chunk_arr) + pdig, np.float64)
         gathered = multihost_utils.process_allgather(digest)
         ref = gathered[0][None]
         same = np.all(
@@ -168,15 +227,15 @@ def run_global(
 
     gkey = (id(params), mkey)
     entry = _GLOBAL_PARAMS_CACHE.get(gkey)
-    if entry is None or entry[0] is not params:
+    if entry is None or entry[0] is not params or entry[1] != fingerprint:
         gparams = jax.tree_util.tree_map(
             lambda p: to_global(p, P()), params
         )
-        _GLOBAL_PARAMS_CACHE[gkey] = (params, gparams)
+        _GLOBAL_PARAMS_CACHE[gkey] = (params, fingerprint, gparams)
         while len(_GLOBAL_PARAMS_CACHE) > _CACHE_MAX:
             _GLOBAL_PARAMS_CACHE.pop(next(iter(_GLOBAL_PARAMS_CACHE)))
     else:
-        gparams = entry[1]
+        gparams = entry[2]
 
     out = program(
         to_global(chunk_arr, P()),

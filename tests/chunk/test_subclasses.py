@@ -138,3 +138,33 @@ def test_normalize_contrast_on_device_matches_host():
         np.asarray(host_out.array).astype(np.int32),
         atol=1,  # percentile interpolation may differ by 1 grey level
     )
+
+
+def test_affinity_from_segmentation():
+    """Ground-truth affinity generation: same nonzero label -> inside,
+    different labels or background -> boundary, leading planes inside
+    (self-edge); metadata carries over from a Chunk input."""
+    import numpy as np
+
+    from chunkflow_tpu.chunk import AffinityMap, Segmentation
+
+    seg = np.zeros((2, 3, 3), np.uint32)
+    seg[:, :, 0] = 1
+    seg[:, :, 2] = 2  # column x=1 stays background 0
+    aff = AffinityMap.from_segmentation(seg, inside=0.9, boundary=0.1)
+    arr = np.asarray(aff.array)
+    assert arr.shape == (3, 2, 3, 3)
+    # x-channel: edge (x=1 -> x=0) touches background -> boundary;
+    # leading plane x=0 -> inside
+    assert arr[2, 0, 0, 0] == np.float32(0.9)
+    assert arr[2, 0, 0, 1] == np.float32(0.1)
+    assert arr[2, 0, 0, 2] == np.float32(0.1)
+    # z-channel within label 1: inside
+    assert arr[0, 1, 0, 0] == np.float32(0.9)
+    # background-background z edge (x=1 column): never connects
+    assert arr[0, 1, 0, 1] == np.float32(0.1)
+    # metadata from a Chunk input
+    chunk = Segmentation(seg, voxel_offset=(5, 6, 7), voxel_size=(40, 8, 8))
+    aff2 = AffinityMap.from_segmentation(chunk)
+    assert tuple(aff2.voxel_offset) == (5, 6, 7)
+    assert tuple(aff2.voxel_size) == (40, 8, 8)

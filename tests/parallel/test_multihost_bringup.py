@@ -128,10 +128,14 @@ engine = engines.create_identity_engine(
     input_patch_size=pin, output_patch_size=pin,
     num_input_channels=1, num_output_channels=3,
 )
-# DIFFERENT chunk per process: the checksum guard must abort loudly on
-# every host instead of psum-ing silently corrupt output
-rng = np.random.default_rng(100 + {pid})
+# DIFFERENT chunk per process — but a PERMUTATION of the same values, so
+# the plain float64 sums agree exactly and only the strengthened digest
+# (strided-sample crc, ADVICE r4) can tell them apart. The guard must
+# abort loudly on every host instead of psum-ing silently corrupt output.
+rng = np.random.default_rng(100)  # same seed: same value multiset
 chunk = rng.random((8, 32, 32)).astype(np.float32)
+if {pid} == 1:
+    chunk = np.ascontiguousarray(chunk[::-1])
 try:
     multihost.sharded_inference_global(
         chunk, engine,
@@ -216,10 +220,58 @@ def _run_two_workers(tmp_path, template, ok_marker):
 
 
 def test_consistency_guard_rejects_divergent_inputs(tmp_path):
-    """Two processes feed DIFFERENT chunks into one collective: the
-    checksum allgather must raise on every host (silent cross-host
-    psum corruption is the failure mode this guards)."""
+    """Two processes feed DIFFERENT chunks into one collective — value
+    permutations with IDENTICAL plain sums: the strengthened digest
+    allgather must raise on every host (silent cross-host psum
+    corruption is the failure mode this guards)."""
     _run_two_workers(tmp_path, DIVERGENT_WORKER, "GUARD_FIRED")
+
+
+def test_chunk_digest_distinguishes_permutations():
+    """ADVICE r4: a permutation (or sign-cancelling rearrangement) of the
+    same values keeps the plain sum equal; the digest must still differ,
+    while identical arrays and NaN-masked copies must agree."""
+    import numpy as np
+
+    from chunkflow_tpu.parallel.multihost import _chunk_digest
+
+    rng = np.random.default_rng(0)
+    a = rng.random((4, 8, 8)).astype(np.float32)
+    b = np.ascontiguousarray(a[::-1])
+    assert np.isclose(_chunk_digest(a)[0], _chunk_digest(b)[0])  # sums tie
+    assert _chunk_digest(a) != _chunk_digest(b)
+    assert _chunk_digest(a) == _chunk_digest(a.copy())
+    # sign-cancelling divergence: add +x to one voxel, -x to another
+    c = a.copy()
+    c[0, 0, 0] += 0.25
+    c[1, 1, 1] -= 0.25
+    assert _chunk_digest(a) != _chunk_digest(c)
+    # different shape, same bytes
+    assert _chunk_digest(a) != _chunk_digest(a.reshape(8, 4, 8))
+    # NaN-masked chunks: equal copies agree under the NaN-aware compare
+    # run_global applies (the sum entry is NaN, so plain == would differ)
+    d = a.copy()
+    d[2, 2, 2] = np.nan
+    da, db = _chunk_digest(d), _chunk_digest(d.copy())
+    assert all(
+        x == y or (np.isnan(x) and np.isnan(y)) for x, y in zip(da, db)
+    )
+
+
+def test_params_fingerprint_detects_inplace_reload():
+    """ADVICE r4: reloading weights INTO the same pytree object must
+    change the fingerprint so run_global's caches re-transfer instead of
+    serving stale device params behind a passing digest."""
+    import numpy as np
+
+    from chunkflow_tpu.parallel.multihost import _params_fingerprint
+
+    params = {"dense": {"kernel": np.ones((8, 8), np.float32),
+                        "bias": np.zeros((8,), np.float32)}}
+    fp0 = _params_fingerprint(params)
+    assert fp0 == _params_fingerprint(params)
+    params["dense"]["kernel"][3, 3] = 7.0  # in-place mutation, same id()
+    assert _params_fingerprint(params) != fp0
 
 
 def test_two_process_distributed_bringup(tmp_path):

@@ -137,10 +137,10 @@ def test_cached_result_skips_nondefault_geometry(tmp_path, monkeypatch):
     (geometry_note) must never win the cached headline: the baseline was
     measured at the default geometry."""
     snap = {
-        "bench_fast_geom": {"ok": True, "commit": "c1",
+        "bench_fast_geom": {"ok": True, "commit": "c1", "platform": "axon",
                             "value": {"mvox_s": 99.0,
                                       "geometry_note": "overlap 2x32x32"}},
-        "bench_default": {"ok": True, "commit": "c2",
+        "bench_default": {"ok": True, "commit": "c2", "platform": "axon",
                           "value": {"mvox_s": 2.0}},
     }
     tools = tmp_path / "tools"
@@ -150,6 +150,23 @@ def test_cached_result_skips_nondefault_geometry(tmp_path, monkeypatch):
     cached = bench._cached_hardware_result()
     assert cached["value"] == 2.0
     assert cached["config"] == "cached:bench_default"
+
+
+def test_cached_result_requires_platform_stamp(tmp_path, monkeypatch):
+    """ADVICE r4: the no-stamp exemption is frozen to the two known
+    round-2 snapshot filenames. An unstamped row in any OTHER
+    tpu_validation*.json (e.g. a future rehearsal tool that forgets the
+    stamp) must not regain 'real chip' eligibility; the same row under a
+    legacy filename stays eligible."""
+    snap = {"bench_a": {"ok": True, "value": {"mvox_s": 42.0}}}
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    (tools / "tpu_validation_future_tool.json").write_text(json.dumps(snap))
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    assert bench._cached_hardware_result() is None
+    (tools / "tpu_validation_oldblend.json").write_text(json.dumps(snap))
+    cached = bench._cached_hardware_result()
+    assert cached is not None and cached["value"] == 42.0
 
 
 def test_cached_result_skips_non_tpu_platform(tmp_path, monkeypatch):
@@ -177,7 +194,7 @@ def test_cached_result_prefers_per_row_commit(tmp_path, monkeypatch):
     runs can span commits)."""
     snap = {
         "_meta": {"measured_at_commit": "filelevel0", "blend_default": "x"},
-        "bench_a": {"ok": True, "commit": "rowlevel1",
+        "bench_a": {"ok": True, "commit": "rowlevel1", "platform": "axon",
                     "value": {"mvox_s": 5.0}},
     }
     tools = tmp_path / "tools"

@@ -308,6 +308,8 @@ class TestMeshQuality:
 
 
 def _voronoi_affinity_fixture(noise, inside, boundary, seed=0):
+    from chunkflow_tpu.chunk import AffinityMap
+
     rng = np.random.default_rng(seed)
     shape = (32, 64, 64)
     n_objects = 12
@@ -316,16 +318,11 @@ def _voronoi_affinity_fixture(noise, inside, boundary, seed=0):
     pts = np.stack([zz, yy, xx], -1).reshape(-1, 3)
     d2 = ((pts[:, None, :] - seeds[None]) ** 2).sum(-1)
     gt = (d2.argmin(1) + 1).reshape(shape).astype(np.uint32)
-    aff = np.empty((3,) + shape, np.float32)
-    for c, ax in enumerate((0, 1, 2)):
-        same = np.ones(shape, bool)
-        sl_a = [slice(None)] * 3
-        sl_b = [slice(None)] * 3
-        sl_a[ax] = slice(1, None)
-        sl_b[ax] = slice(0, -1)
-        same[tuple(sl_a)] = gt[tuple(sl_a)] == gt[tuple(sl_b)]
-        aff[c] = np.where(same, inside, boundary)
-    aff += rng.normal(0, noise, aff.shape).astype(np.float32)
+    aff = np.asarray(
+        AffinityMap.from_segmentation(gt, inside=inside, boundary=boundary)
+        .array
+    )
+    aff = aff + rng.normal(0, noise, aff.shape).astype(np.float32)
     return np.clip(aff, 0, 1).astype(np.float32), gt
 
 

@@ -31,6 +31,8 @@ def voronoi_affinity(shape, n_objects=600, noise=0.1, inside=0.9,
     of int64 temporaries at 64x512x512 — watch BENCH_SHAPE upscaling)."""
     from scipy.spatial import cKDTree
 
+    from chunkflow_tpu.chunk import AffinityMap
+
     rng = np.random.default_rng(seed)
     seeds = np.stack([rng.uniform(0, s, n_objects) for s in shape], axis=1)
     tree = cKDTree(seeds)
@@ -38,16 +40,11 @@ def voronoi_affinity(shape, n_objects=600, noise=0.1, inside=0.9,
     pts = np.stack([zz.ravel(), yy.ravel(), xx.ravel()], 1)
     _, nearest = tree.query(pts, workers=-1)
     gt = (nearest + 1).reshape(shape).astype(np.uint32)
-    aff = np.empty((3,) + shape, np.float32)
-    for c in range(3):
-        same = np.ones(shape, bool)
-        sl_a = [slice(None)] * 3
-        sl_b = [slice(None)] * 3
-        sl_a[c] = slice(1, None)
-        sl_b[c] = slice(0, -1)
-        same[tuple(sl_a)] = gt[tuple(sl_a)] == gt[tuple(sl_b)]
-        aff[c] = np.where(same, inside, boundary)
-    aff += rng.normal(0, noise, aff.shape).astype(np.float32)
+    aff = np.asarray(
+        AffinityMap.from_segmentation(gt, inside=inside, boundary=boundary)
+        .array
+    )
+    aff = aff + rng.normal(0, noise, aff.shape).astype(np.float32)
     return np.clip(aff, 0, 1).astype(np.float32), gt
 
 
