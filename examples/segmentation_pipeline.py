@@ -39,6 +39,23 @@ def main():
     )
     print(f"agglomeration: {n_seg} segments")
 
+    # 2b) production spelling for MANY chunks: stream(postprocess=...)
+    #     runs the host watershed of chunk i in a worker thread while
+    #     chunk i+1's program executes on device, so the CPU stage the
+    #     reference ships to separate fleets hides behind chip time
+    tasks = [Chunk(rng.random((16, 64, 64)).astype(np.float32),
+                   voxel_offset=(16 * i, 0, 0)) for i in range(3)]
+
+    def agglomerate(out_chunk):
+        arr = np.asarray(out_chunk.array, dtype=np.float32)
+        return native.watershed_agglomerate(
+            arr, t_high=0.9999, t_low=0.2, merge_threshold=0.7
+        )
+    for (seg_i, n_i), task in zip(
+        inferencer.stream(iter(tasks), postprocess=agglomerate), tasks
+    ):
+        print(f"  streamed task z={task.voxel_offset[0]}: {n_i} segments")
+
     # 3) connected components split spatially-disconnected labels
     cc, n_cc = native.connected_components(seg)
     print(f"connected components: {n_cc}")

@@ -299,6 +299,90 @@ def test_stream_pipelined_matches_sequential_calls():
         np.testing.assert_allclose(np.asarray(out.array), ref, atol=1e-6)
 
 
+def test_stream_postprocess_overlaps_and_preserves_order():
+    """stream(postprocess=...) runs the host stage in a worker thread
+    while the next chunk's program is in flight (VERDICT r4 #3): results
+    arrive in order, each produced off the dispatch thread, and wall
+    clock beats the strictly-sequential sum of load + post stages."""
+    import threading
+    import time
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(13)
+    chunks = [
+        Chunk(rng.random((8, 32, 32)).astype(np.float32),
+              voxel_offset=(i * 8, 0, 0))
+        for i in range(4)
+    ]
+    load_s = post_s = 0.15
+    main_thread = threading.get_ident()
+    post_threads = []
+
+    def slow_loader():
+        for c in chunks:
+            time.sleep(load_s)  # simulated volume cutout
+            yield c
+
+    def postprocess(out):
+        post_threads.append(threading.get_ident())
+        time.sleep(post_s)  # simulated watershed/agglomeration
+        return (tuple(out.voxel_offset), np.asarray(out.array)[0].copy())
+
+    # warm the compiled program so compile time doesn't mask the overlap
+    inferencer(chunks[0])
+    t0 = time.perf_counter()
+    results = list(inferencer.stream(slow_loader(), postprocess=postprocess))
+    elapsed = time.perf_counter() - t0
+
+    assert [r[0] for r in results] == [
+        tuple(c.voxel_offset) for c in chunks
+    ]
+    for (_, arr), src in zip(results, chunks):
+        np.testing.assert_allclose(arr, np.asarray(src.array), atol=1e-6)
+    assert all(t != main_thread for t in post_threads)
+    sequential_floor = len(chunks) * (load_s + post_s)
+    assert elapsed < sequential_floor * 0.9, (
+        f"no overlap: {elapsed:.2f}s vs sequential {sequential_floor:.2f}s"
+    )
+
+
+def test_stream_postprocess_propagates_errors():
+    """an exception inside the worker-thread postprocess surfaces to the
+    caller instead of being swallowed by the executor."""
+    import pytest
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(5)
+    chunks = [Chunk(rng.random((8, 32, 32)).astype(np.float32))
+              for _ in range(2)]
+
+    def explode(out):
+        raise RuntimeError("post stage failed")
+
+    with pytest.raises(RuntimeError, match="post stage failed"):
+        list(inferencer.stream(iter(chunks), postprocess=explode))
+
+
 def test_stream_empty_and_single():
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference.inferencer import Inferencer
