@@ -27,6 +27,7 @@ from chunkflow_tpu.flow.runtime import (
     generator,
     operator,
     process_stream,
+    write_operator,
 )
 
 state = PipelineState()
@@ -399,25 +400,67 @@ def prefetch_cmd(depth, to_device):
                    "sqs_queue.py:115-130)")
 @click.option("--num", type=int, default=-1, help="max tasks to process (-1: drain)")
 def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num):
-    """Pull bbox tasks from a queue; ack via delete-task-in-queue."""
+    """Pull bbox tasks from a queue; ack via delete-task-in-queue.
+
+    When the jax runtime spans processes (one inference program over a
+    multi-host mesh), the task stream must be single-sourced: only the
+    coordinator touches the queue, broadcasting each bbox to every peer
+    (parallel/multihost.broadcast_string); peers yield mirror tasks that
+    run the compute collectives but skip writes and acks
+    (runtime.is_mirror_task). The reference's workers never share a
+    runtime, so its loop (sqs_queue.py:115-130) has no such mode."""
 
     @generator
     def stage(task):
         from chunkflow_tpu.flow.runtime import new_task
         from chunkflow_tpu.parallel.queues import open_queue
 
+        try:
+            import jax
+
+            crosshost = jax.process_count() > 1
+        except Exception:
+            crosshost = False
+
+        if crosshost:
+            from chunkflow_tpu.parallel import multihost
+
+            if not multihost.is_coordinator():
+                # mirror loop: receive bboxes until the stop sentinel;
+                # compute collectives run, writes/acks are skipped
+                # (runtime.is_mirror_task)
+                while True:
+                    body = multihost.broadcast_string(None)
+                    if body is None:
+                        break
+                    t = new_task()
+                    t["bbox"] = BoundingBox.from_string(body)
+                    t["replica_mirror"] = True
+                    yield t
+                return
+
         queue = open_queue(queue_name, visibility_timeout=visibility_timeout)
         queue.max_empty_retries = retry_times
         count = 0
-        for handle, body in queue:
-            t = new_task()
-            t["bbox"] = BoundingBox.from_string(body)
-            t["queue"] = queue
-            t["task_handle"] = handle
-            yield t
-            count += 1
-            if 0 <= num <= count:
-                break
+        try:
+            for handle, body in queue:
+                if crosshost:
+                    multihost.broadcast_string(body)
+                t = new_task()
+                t["bbox"] = BoundingBox.from_string(body)
+                t["queue"] = queue
+                t["task_handle"] = handle
+                yield t
+                count += 1
+                if 0 <= num <= count:
+                    break
+        finally:
+            # sentinel on EVERY exit path — normal drain, --num cap,
+            # downstream exception, generator close. A coordinator that
+            # dies without broadcasting it would leave every peer blocked
+            # forever inside the collective waiting for the next task.
+            if crosshost:
+                multihost.broadcast_string(None)
 
     return stage()
 
@@ -564,7 +607,7 @@ def save_h5_cmd(op_name, file_name, file_name_prefix, chunk_size, compression,
             "save-h5 needs exactly one of --file-name / --file-name-prefix"
         )
 
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         if dtype is not None:
@@ -623,7 +666,7 @@ def load_tif_cmd(op_name, file_name, output_chunk_name, voxel_offset,
               help="tifffile compression codec")
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 def save_tif_cmd(op_name, file_name, dtype, compression, input_chunk_name):
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         if dtype is not None:
@@ -866,7 +909,7 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
 
     vol = PrecomputedVolume(volume_path)
 
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         if state.dry_run:
@@ -989,7 +1032,7 @@ def load_synapses_cmd(op_name, file_name, suffix, resolution, output_name):
 @click.option("--file-name", "--file-path", "-f", type=str, required=True)
 @click.option("--input-name", "-i", type=str, default="synapses")
 def save_synapses_cmd(op_name, file_name, input_name):
-    @operator
+    @write_operator
     def stage(task):
         task[input_name].to_file(file_name)
         return task
@@ -1004,7 +1047,7 @@ def save_synapses_cmd(op_name, file_name, input_name):
 def save_points_cmd(op_name, file_name, input_name):
     from chunkflow_tpu.annotations.point_cloud import PointCloud
 
-    @operator
+    @write_operator
     def stage(task):
         points = task[input_name]
         if not isinstance(points, PointCloud):
@@ -1048,7 +1091,7 @@ def load_skeleton_cmd(op_name, file_name, voxel_offset, voxel_size,
               help=".swc path, or a prefix completed per skeleton id")
 @click.option("--input-name", "-i", type=str, default="skeleton")
 def save_swc_cmd(op_name, file_name, input_name):
-    @operator
+    @write_operator
     def stage(task):
         value = task[input_name]
         if isinstance(value, dict):
@@ -1096,7 +1139,7 @@ def load_npy_cmd(op_name, file_name, voxel_offset, voxel_size,
 @click.option("--file-name", "-f", type=str, required=True)
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 def save_npy_cmd(op_name, file_name, input_chunk_name):
-    @operator
+    @write_operator
     def stage(task):
         task[input_chunk_name].to_npy(file_name)
         return task
@@ -1183,7 +1226,7 @@ def save_zarr_cmd(op_name, store_path, input_chunk_name, volume_size,
     """Write the chunk into a zyx zarr array at its voxel offset."""
     import tensorstore as ts
 
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         if dtype is not None:
@@ -1405,7 +1448,7 @@ def mark_complete_cmd(op_name, prefix, suffix):
     """Touch a completion marker file for the task bbox."""
     import os
 
-    @operator
+    @write_operator
     def stage(task):
         from chunkflow_tpu.flow.runtime import drain_pending_writes
 
@@ -2081,7 +2124,7 @@ def plugin_cmd(name, input_names, output_names, args):
 def save_pngs_cmd(op_name, output_path, dtype, input_chunk_name):
     from chunkflow_tpu.volume.io_png import save_pngs
 
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         if dtype is not None:
@@ -2291,7 +2334,7 @@ def save_nrrd_cmd(op_name, file_name, input_chunk_name):
     """Save the chunk as an NRRD file (reference flow/flow.py:853)."""
     from chunkflow_tpu.volume.io_nrrd import save_nrrd
 
-    @operator
+    @write_operator
     def stage(task):
         chunk = task[input_chunk_name]
         save_nrrd(

@@ -96,6 +96,30 @@ def operator(func: Callable) -> Callable:
     return wrapper
 
 
+def is_mirror_task(task: Optional[dict]) -> bool:
+    """True for tasks mirrored onto non-coordinator processes of a
+    multi-process jax runtime (fetch-task-from-queue broadcast mode):
+    every process must run the compute stages — the global inference
+    program is a collective — but storage writes and queue acks are the
+    coordinator's job, or N processes would write the same bytes N
+    times (and non-coordinators hold no queue lease to ack)."""
+    return bool(task and task.get("replica_mirror"))
+
+
+def write_operator(func: Callable) -> Callable:
+    """An :func:`operator` whose body is a storage write (save-*,
+    mark-complete): skipped — task passed through untouched — on
+    mirror tasks. See :func:`is_mirror_task`."""
+
+    @functools.wraps(func)
+    def guarded(task, **kwargs):
+        if is_mirror_task(task):
+            return task
+        return func(task, **kwargs)
+
+    return operator(guarded)
+
+
 def generator(func: Callable) -> Callable:
     """Decorate a task source: ``func(task, **kwargs) -> iterator of tasks``.
 

@@ -80,6 +80,41 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+def broadcast_string(s: Optional[str] = None, max_len: int = 512):
+    """Collective: the coordinator's string reaches every process.
+
+    The cross-host task loop's distribution primitive: the coordinator
+    pulls bbox tasks from the queue and broadcasts each body here (None
+    broadcasts a stop sentinel); non-coordinators pass anything (their
+    value is ignored) and receive. Every process must call this the same
+    number of times — it is a collective like any other. The reference
+    has no analog: its workers never share a runtime (SQS only,
+    lib/aws/sqs_queue.py); here one inference program can span hosts, so
+    the task stream itself must be single-sourced.
+    """
+    import numpy as np
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(2 + max_len, np.int32)
+    if jax.process_index() == 0 and s is not None:
+        data = s.encode("utf-8")
+        if len(data) > max_len:
+            raise ValueError(
+                f"task string of {len(data)} bytes exceeds the "
+                f"{max_len}-byte broadcast frame"
+            )
+        buf[0] = 1
+        buf[1] = len(data)
+        buf[2:2 + len(data)] = np.frombuffer(data, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    if int(out[0]) == 0:
+        return None
+    n = int(out[1])
+    return bytes(out[2:2 + n].astype(np.uint8)).decode("utf-8")
+
+
 # global-params reuse: building global jax.Arrays for the parameter tree
 # is a full H2D transfer — pay it once per (params, mesh), not per chunk.
 # Entries hold a strong reference to the keyed params object, so an id()

@@ -79,6 +79,17 @@ assert out.shape == (3, 8, 32, 32), out.shape
 np.testing.assert_allclose(out, np.broadcast_to(chunk, out.shape),
                            atol=1e-5)
 
+# replica agreement across processes: each host's copy of the
+# "replicated" output may differ in the LAST ULP (all-reduce rounding
+# is per-rank on this backend — measured here, which is exactly why
+# the CLI publishes only the coordinator's copy, a single source of
+# truth rather than N almost-identical ones), but any difference
+# beyond ulp noise means the program forked
+gathered_out = multihost_utils.process_allgather(out)
+assert gathered_out.shape[0] == 2, gathered_out.shape
+np.testing.assert_allclose(gathered_out[0], gathered_out[1],
+                           atol=2e-6, rtol=0)
+
 # the production surface: Inferencer(sharding='patch') routes through the
 # same global-array path whenever the runtime spans processes
 from chunkflow_tpu.chunk.base import Chunk
