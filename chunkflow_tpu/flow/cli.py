@@ -1569,7 +1569,7 @@ def copy_var_cmd(op_name, from_name, to_name):
     "--shape-bucket", default=None,
     help="pad chunk shapes up to multiples of this zyx quantum so ragged "
          "edge chunks reuse one compiled program (trade-off: the net sees "
-         "zero padding past the true edge)",
+         "edge-replicated padding past the true edge)",
 )
 @click.option(
     "--blend", type=click.Choice(["auto", "scatter", "fold"]),

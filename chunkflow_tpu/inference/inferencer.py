@@ -105,10 +105,10 @@ class Inferencer:
         # Optional shape bucketing (SURVEY §7 hard parts): pad every chunk
         # up to multiples of this zyx quantum so ragged edge chunks reuse
         # the same compiled program instead of recompiling per shape.
-        # Trade-off: the convnet sees zero padding past the true edge
-        # instead of the reference's edge-snapped real context, so
-        # predictions within one patch of a padded face can differ — hence
-        # opt-in.
+        # Trade-off: the convnet sees edge-replicated padding past the
+        # true edge instead of the reference's edge-snapped real context,
+        # so predictions within one patch of a padded face can differ —
+        # hence opt-in.
         self.shape_bucket = (
             Cartesian.from_collection(shape_bucket)
             if shape_bucket is not None and any(shape_bucket)
@@ -337,9 +337,11 @@ class Inferencer:
     def _run_fold(self, arr):
         """Static-geometry scatter-free path (ops/fold_blend.py): pad to
         a uniform patch grid, run the cached per-shape fold program, crop
-        back. Edge predictions within one patch of a padded face see zero
-        padding instead of edge-snapped context (the shape-bucketing
-        trade-off), which is why fold is opt-in."""
+        back. Edge predictions within one patch of a padded face see
+        EDGE-REPLICATED context (the closest uniform-grid analog of the
+        reference's edge-snapped real context) rather than true snapped
+        data — still a face-adjacent approximation, which is why fold is
+        opt-in."""
         import jax.numpy as jnp
 
         from chunkflow_tpu.ops.fold_blend import build_fold_program
@@ -351,7 +353,11 @@ class Inferencer:
         padded, _ = self._fold_geometry(zyx)
         if padded != zyx:
             pad = [(0, 0)] + [(0, p - s) for p, s in zip(padded, zyx)]
-            arr = jnp.pad(arr, pad)
+            # edge-replicate, not zeros: grid-edge patches then see real
+            # boundary context (the closest uniform-grid analog of the
+            # reference's edge-snapped patch starts,
+            # inferencer.py:404-455); padded voxels are cropped below
+            arr = jnp.pad(arr, pad, mode="edge")
         if padded not in self._fold_programs:
             self._fold_programs[padded] = build_fold_program(
                 self._forward,
@@ -687,7 +693,9 @@ class Inferencer:
             pad = [(0, 0)] + [
                 (0, r - s) for r, s in zip(run_zyx, orig_zyx)
             ]
-            arr = jnp.pad(arr, pad)
+            # shape-bucket padding replicates the boundary plane so the
+            # net sees plausible context instead of a zero wall
+            arr = jnp.pad(arr, pad, mode="edge")
 
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)

@@ -231,10 +231,51 @@ def test_inferencer_sharded_modes_match_single_device(sharding):
     np.testing.assert_allclose(result[0], np.asarray(chunk.array), atol=1e-5)
 
 
+def test_padded_context_is_edge_replicated():
+    """Bucket and fold padding feed the net EDGE-REPLICATED boundary
+    context (the uniform-grid analog of the reference's edge-snapped
+    patch starts), not a zero wall: a patch-mean engine over an all-ones
+    ragged chunk must return exactly 1.0 everywhere — zero padding would
+    drag every edge patch's mean (and the blended voxels it touches)
+    below 1. The identity oracle cannot see pad mode; this engine can."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference.engines import Engine
+    from chunkflow_tpu.inference.inferencer import Inferencer
+
+    pin = (4, 16, 16)
+
+    def apply(params, batch):
+        m = batch.mean(axis=(1, 2, 3, 4), keepdims=True)
+        return jnp.broadcast_to(m, (batch.shape[0], 1) + pin)
+
+    eng = Engine(params=(), apply=apply,
+                 num_input_channels=1, num_output_channels=1)
+    for kwargs in ({"shape_bucket": (8, 32, 32)}, {"blend": "fold"}):
+        inferencer = Inferencer(
+            input_patch_size=pin,
+            output_patch_overlap=(2, 8, 8),
+            num_output_channels=1,
+            framework="identity",
+            engine=eng,
+            batch_size=2,
+            crop_output_margin=False,
+            **kwargs,
+        )
+        out = np.asarray(
+            inferencer(Chunk(np.ones((7, 30, 30), np.float32))).array
+        )
+        assert out.shape[-3:] == (7, 30, 30)
+        np.testing.assert_allclose(out, 1.0, atol=1e-6, err_msg=str(kwargs))
+
+
 def test_shape_bucketing_identity_oracle_and_program_reuse():
     """With --shape-bucket, ragged chunks pad up to the bucket quantum and
     reuse ONE compiled program; the identity oracle still holds exactly
-    (identity forward copies voxels, so zero padding cannot leak in)."""
+    (identity forward copies voxels, so the PAD REGION cannot leak in;
+    pad-mode sensitivity is covered by
+    test_padded_context_is_edge_replicated)."""
     from chunkflow_tpu.chunk.base import Chunk
     from chunkflow_tpu.inference.inferencer import Inferencer
 
