@@ -18,6 +18,7 @@ _SRC_DIR = os.path.join(os.path.dirname(__file__), "src")
 _LIB_DIR = os.path.join(os.path.dirname(__file__), "lib")
 _LIB_PATH = os.path.join(_LIB_DIR, "libchunkflow_native.so")
 _SOURCES = ("cc3d.cpp", "watershed.cpp", "surface_nets.cpp", "remap.cpp")
+_HEADERS = ("zslab.h",)
 
 _lib: Optional[ctypes.CDLL] = None
 
@@ -30,7 +31,7 @@ def _needs_build() -> bool:
     lib_mtime = os.path.getmtime(_LIB_PATH)
     return any(
         os.path.getmtime(os.path.join(_SRC_DIR, s)) > lib_mtime
-        for s in _SOURCES
+        for s in _SOURCES + _HEADERS
     )
 
 
@@ -97,6 +98,12 @@ def connected_components(arr: np.ndarray, connectivity: int = 26) -> Tuple[np.nd
     lib = load()
     if connectivity not in (6, 18, 26):
         raise ValueError(f"connectivity must be 6/18/26, got {connectivity}")
+    if arr.size >= 1 << 32:
+        # voxel-index union-find addresses voxels as uint32
+        raise ValueError(
+            f"volume of {arr.size} voxels exceeds the native kernel's "
+            f"2^32 voxel addressing; split the chunk first"
+        )
     arr = np.ascontiguousarray(arr)
     if arr.dtype == np.bool_:
         arr = arr.astype(np.uint8)
@@ -129,6 +136,13 @@ def watershed_agglomerate(
     lib = load()
     if affinity.ndim != 4 or affinity.shape[0] != 3:
         raise ValueError(f"need [3, z, y, x] affinities, got {affinity.shape}")
+    if affinity[0].size >= 1 << 32:
+        # voxel-index union-find addresses voxels as uint32 (same limit
+        # as connected_components); wrapping would merge unrelated voxels
+        raise ValueError(
+            f"volume of {affinity[0].size} voxels exceeds the native "
+            f"kernel's 2^32 voxel addressing; split the chunk first"
+        )
     aff = np.ascontiguousarray(affinity, dtype=np.float32)
     out = np.empty(aff.shape[1:], dtype=np.uint32)
     count = lib.watershed_agglomerate(

@@ -1,37 +1,24 @@
-// 3D connected components via two-pass union-find.
+// 3D connected components via union-find over voxel indices.
 // Native equivalent of the cc3d wheel the reference depends on
 // (chunkflow/chunk/base.py:136): label distinct-value regions of a 3D
-// volume under 6/18/26 connectivity. Sequential union-find is inherently
-// host-side work (SURVEY §2.9) — kept off the TPU critical path.
+// volume under 6/18/26 connectivity. Host-side work (SURVEY §2.9), kept
+// off the TPU critical path; threaded over z-slabs (zslab.h): each
+// worker unites same-value neighbor pairs whose BOTH endpoints lie in
+// its slab, the seam planes (neighbors with dz = -1 crossing a slab
+// boundary) stitch sequentially after the join, and the final ids are
+// assigned by one sequential first-encounter raster scan — so the
+// labeling is identical for every thread count (components and
+// numbering are both order-independent).
 #include <cstdint>
-#include <cstring>
 #include <vector>
+
+#include "zslab.h"
 
 namespace {
 
-struct UnionFind {
-  std::vector<uint32_t> parent;
-  explicit UnionFind(size_t n) : parent(n) {
-    for (size_t i = 0; i < n; ++i) parent[i] = static_cast<uint32_t>(i);
-  }
-  uint32_t find(uint32_t x) {
-    while (parent[x] != x) {
-      parent[x] = parent[parent[x]];  // path halving
-      x = parent[x];
-    }
-    return x;
-  }
-  void unite(uint32_t a, uint32_t b) {
-    a = find(a);
-    b = find(b);
-    if (a == b) return;
-    if (b < a) std::swap(a, b);
-    parent[b] = a;  // smaller root wins -> deterministic labeling
-  }
-};
-
-// neighbor offsets with all coordinates <= 0 and lexicographically negative
-// (already-visited voxels in raster order), grouped by connectivity class
+// neighbor offsets with all coordinates <= 0 and lexicographically
+// negative (each undirected edge enumerated once), grouped by
+// connectivity class
 struct Offset { int dz, dy, dx; int cls; };  // cls: 1=face 2=edge 3=corner
 constexpr Offset kOffsets[] = {
     {0, 0, -1, 1},  {0, -1, 0, 1},  {-1, 0, 0, 1},
@@ -45,54 +32,73 @@ uint32_t label_impl(const T* in, uint32_t* out, int64_t sz, int64_t sy,
                     int64_t sx, int connectivity) {
   const int max_cls = connectivity == 6 ? 1 : (connectivity == 18 ? 2 : 3);
   const int64_t n = sz * sy * sx;
-  // provisional labels, 0 = background
-  UnionFind uf(1);
-  uf.parent.reserve(1 << 16);
-  std::vector<uint32_t> labels(n, 0);
-  uint32_t next = 0;
+  const int nt = chunkflow::thread_count(sz);
+  chunkflow::UnionFind uf(n);
 
-  for (int64_t z = 0; z < sz; ++z) {
-    for (int64_t y = 0; y < sy; ++y) {
-      for (int64_t x = 0; x < sx; ++x) {
-        const int64_t idx = (z * sy + y) * sx + x;
-        const T v = in[idx];
-        if (v == 0) continue;
-        uint32_t assigned = 0;
-        for (const auto& off : kOffsets) {
-          if (off.cls > max_cls) continue;
-          const int64_t nz = z + off.dz, ny = y + off.dy, nx = x + off.dx;
-          if (nz < 0 || ny < 0 || ny >= sy || nx < 0 || nx >= sx) continue;
-          const int64_t nidx = (nz * sy + ny) * sx + nx;
-          if (in[nidx] != v) continue;
-          const uint32_t nl = labels[nidx];
-          if (nl == 0) continue;
-          if (assigned == 0) {
-            assigned = nl;
-          } else if (assigned != nl) {
-            uf.unite(assigned, nl);
+  // visit the (already-enumerated-once) neighbor edges of voxels in
+  // z-range [z0, z1). Slab pass (seam_only = false): edges whose
+  // neighbor falls below z0 are skipped — they cross the slab seam and
+  // run later in the sequential seam pass (seam_only = true, which
+  // visits ONLY the dz = -1 edges of one boundary plane).
+  auto unite_range = [&](int64_t z0, int64_t z1, bool seam_only) {
+    for (int64_t z = z0; z < z1; ++z) {
+      for (int64_t y = 0; y < sy; ++y) {
+        const int64_t row = (z * sy + y) * sx;
+        for (int64_t x = 0; x < sx; ++x) {
+          const int64_t idx = row + x;
+          const T v = in[idx];
+          if (v == 0) continue;
+          for (const auto& off : kOffsets) {
+            if (off.cls > max_cls) continue;
+            if (seam_only && off.dz == 0) continue;
+            const int64_t nz = z + off.dz;
+            if (!seam_only && nz < z0) continue;  // crosses the seam
+            const int64_t ny = y + off.dy, nx = x + off.dx;
+            if (nz < 0 || ny < 0 || ny >= sy || nx < 0 || nx >= sx)
+              continue;
+            const int64_t nidx = (nz * sy + ny) * sx + nx;
+            if (in[nidx] != v) continue;
+            uf.unite(static_cast<uint32_t>(idx),
+                     static_cast<uint32_t>(nidx));
           }
         }
-        if (assigned == 0) {
-          assigned = ++next;
-          uf.parent.push_back(assigned);
-        }
-        labels[idx] = assigned;
       }
+    }
+  };
+
+  chunkflow::run_slabs(sz, nt, [&](int, int64_t z0, int64_t z1) {
+    unite_range(z0, z1, /*seam_only=*/false);
+  });
+  if (nt > 1) {
+    // seam stitch: the one z-plane per interior boundary, sequential
+    const auto bounds = chunkflow::slab_bounds(sz, nt);
+    for (int t = 1; t < nt; ++t) {
+      const int64_t z = bounds[t];
+      if (z > 0) unite_range(z, z + 1, /*seam_only=*/true);
     }
   }
 
-  // second pass: flatten union-find into consecutive final ids
-  std::vector<uint32_t> remap(next + 1, 0);
+  // Final ids by sequential first-encounter raster scan, allocation-free
+  // (no O(n) remap vector): smaller-root-wins makes every root the
+  // component's MINIMUM voxel index, i.e. its first raster encounter.
+  // After full path compression, roots renumber in place — parent[root]
+  // is overwritten with the component id, and every later voxel of the
+  // component reads it directly (its root index is always < its own).
+  for (int64_t i = 0; i < n; ++i)
+    if (in[i] != 0) uf.parent[i] = uf.find(static_cast<uint32_t>(i));
   uint32_t count = 0;
   for (int64_t i = 0; i < n; ++i) {
-    const uint32_t l = labels[i];
-    if (l == 0) {
+    if (in[i] == 0) {
       out[i] = 0;
       continue;
     }
-    const uint32_t root = uf.find(l);
-    if (remap[root] == 0) remap[root] = ++count;
-    out[i] = remap[root];
+    const uint32_t root = uf.parent[i];
+    if (root == static_cast<uint32_t>(i)) {
+      uf.parent[i] = ++count;
+      out[i] = count;
+    } else {
+      out[i] = uf.parent[root];
+    }
   }
   return count;
 }

@@ -51,36 +51,19 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
-#include <functional>
 #include <limits>
 #include <queue>
-#include <thread>
 #include <utility>
 #include <vector>
 
+#include "zslab.h"
+
 namespace {
 
-struct UnionFind {
-  std::vector<uint32_t> parent;
-  explicit UnionFind(size_t n) : parent(n) {
-    for (size_t i = 0; i < n; ++i) parent[i] = static_cast<uint32_t>(i);
-  }
-  uint32_t find(uint32_t x) {
-    while (parent[x] != x) {
-      parent[x] = parent[parent[x]];
-      x = parent[x];
-    }
-    return x;
-  }
-  bool unite(uint32_t a, uint32_t b) {
-    a = find(a);
-    b = find(b);
-    if (a == b) return false;
-    if (b < a) std::swap(a, b);
-    parent[b] = a;
-    return true;
-  }
-};
+using chunkflow::UnionFind;
+using chunkflow::run_slabs;
+using chunkflow::slab_bounds;
+using chunkflow::thread_count;
 
 // CHUNKFLOW_WATERSHED_TIMING=1: phase timings on stderr (perf diagnosis)
 struct PhaseTimer {
@@ -94,47 +77,6 @@ struct PhaseTimer {
     t = now;
   }
 };
-
-// CHUNKFLOW_NATIVE_THREADS overrides; default = hardware_concurrency
-// capped at 8 (the edge scans saturate memory bandwidth well before
-// that). Small volumes stay sequential: the slab machinery only pays
-// off when each slab has real work.
-int thread_count(int64_t sz) {
-  int nt = 0;
-  if (const char* env = std::getenv("CHUNKFLOW_NATIVE_THREADS")) {
-    nt = std::atoi(env);
-  }
-  if (nt <= 0) {
-    nt = static_cast<int>(std::thread::hardware_concurrency());
-    if (nt > 8) nt = 8;
-  }
-  if (nt < 1) nt = 1;
-  // need >= 2 z-planes per slab so every slab owns interior z-edges
-  const int max_by_work = static_cast<int>(sz / 2);
-  if (nt > max_by_work) nt = max_by_work;
-  return nt < 1 ? 1 : nt;
-}
-
-// contiguous z-slab [z0, z1) per worker; deterministic for fixed (sz, nt)
-std::vector<int64_t> slab_bounds(int64_t sz, int nt) {
-  std::vector<int64_t> bounds(nt + 1);
-  for (int t = 0; t <= nt; ++t) bounds[t] = sz * t / nt;
-  return bounds;
-}
-
-void run_slabs(int64_t sz, int nt,
-               const std::function<void(int, int64_t, int64_t)>& body) {
-  const auto bounds = slab_bounds(sz, nt);
-  if (nt == 1) {
-    body(0, bounds[0], bounds[1]);
-    return;
-  }
-  std::vector<std::thread> workers;
-  workers.reserve(nt);
-  for (int t = 0; t < nt; ++t)
-    workers.emplace_back(body, t, bounds[t], bounds[t + 1]);
-  for (auto& w : workers) w.join();
-}
 
 // Flat open-addressing map from a canonical region pair (lo<<32|hi, both
 // >= 1 so key is never 0) to boundary statistics. Linear probing with
@@ -356,17 +298,24 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
   }
 
   timer.lap("phase2 fragments");
-  // compact region ids (sequential scan keeps first-encounter numbering
-  // deterministic and identical to the single-thread layout)
+  // compact region ids: sequential first-encounter raster numbering,
+  // allocation-free (no O(n) remap vector) — smaller-root-wins makes
+  // every root its fragment's minimum voxel index, so after full path
+  // compression roots renumber in place (see cc3d.cpp for the pattern)
   std::vector<uint32_t> ids(n, 0);
   uint32_t nseg = 0;
   {
-    std::vector<uint32_t> remap(n, 0);
+    for (int64_t i = 0; i < n; ++i)
+      if (active[i]) uf.parent[i] = uf.find(static_cast<uint32_t>(i));
     for (int64_t i = 0; i < n; ++i) {
       if (!active[i]) continue;
-      const uint32_t root = uf.find(static_cast<uint32_t>(i));
-      if (remap[root] == 0) remap[root] = ++nseg;
-      ids[i] = remap[root];
+      const uint32_t root = uf.parent[i];
+      if (root == static_cast<uint32_t>(i)) {
+        uf.parent[i] = ++nseg;
+        ids[i] = nseg;
+      } else {
+        ids[i] = uf.parent[root];
+      }
     }
   }
 

@@ -495,3 +495,32 @@ class TestWatershedThreading:
         monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", "64")
         seg, count = native.watershed_agglomerate(aff, 0.9, 0.3, 0.5)
         assert count == 12
+
+
+class TestCC3DThreading:
+    def test_threaded_matches_sequential(self, monkeypatch):
+        """cc3d z-slab threading is invisible: identical labels (values,
+        not just partition — first-encounter raster numbering is
+        sequential) for every thread count, all connectivities."""
+        rng = np.random.default_rng(4)
+        arr = rng.integers(0, 3, (16, 32, 32)).astype(np.uint32)
+        for conn in (6, 18, 26):
+            monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", "1")
+            seq, n_seq = native.connected_components(arr, connectivity=conn)
+            for nt in ("2", "5"):
+                monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", nt)
+                par, n_par = native.connected_components(
+                    arr, connectivity=conn)
+                assert n_par == n_seq, (conn, nt)
+                np.testing.assert_array_equal(par, seq)
+
+    def test_component_spanning_all_seams(self, monkeypatch):
+        # one thin column through every slab plus per-slab islands: the
+        # seam stitch must fuse the column into ONE component
+        monkeypatch.setenv("CHUNKFLOW_NATIVE_THREADS", "4")
+        arr = np.zeros((16, 8, 8), np.uint8)
+        arr[:, 4, 4] = 1  # column crossing all 3 seams
+        arr[3, 0, 0] = arr[7, 0, 0] = arr[12, 0, 0] = 1  # isolated islands
+        labels, count = native.connected_components(arr, connectivity=6)
+        assert count == 4, count
+        assert len(np.unique(labels[:, 4, 4])) == 1
