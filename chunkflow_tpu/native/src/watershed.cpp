@@ -42,9 +42,9 @@
 // fixed CHUNKFLOW_NATIVE_THREADS. The phase-3 merge loop itself stays
 // sequential (priority-queue semantics), but its region graph is a flat
 // open-addressing pair map + CSR neighbor lists instead of per-region
-// std::map trees — measured 67.9 s -> 21.2 s single-threaded on the
+// std::map trees — measured 67.9 s -> 18.2 s single-threaded on the
 // 2.8M-fragment worst case (uniform-random affinities, t_low ~ 0),
-// with the realistic 600-object fixture at 9.8 Mvox/s (1.7 s).
+// with the realistic 600-object fixture at 10.4 Mvox/s (1.6 s).
 #include <algorithm>
 #include <chrono>
 #include <cstdint>
