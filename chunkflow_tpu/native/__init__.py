@@ -68,6 +68,16 @@ def load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
         ctypes.c_float, ctypes.c_float, ctypes.c_float,
     ]
+    lib.watershed_agglomerate_scored.restype = ctypes.c_uint32
+    lib.watershed_agglomerate_scored.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_int,
+    ]
+    lib.agglomerate_fragments.restype = ctypes.c_uint32
+    lib.agglomerate_fragments.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, i64, i64, i64,
+        ctypes.c_float, ctypes.c_int,
+    ]
     lib.surface_nets_mesh_u32.restype = ctypes.c_int32
     lib.surface_nets_mesh_u32.argtypes = [
         ctypes.c_void_p, i64, i64, i64, ctypes.c_uint32,
@@ -126,13 +136,26 @@ def connected_components(arr: np.ndarray, connectivity: int = 26) -> Tuple[np.nd
     return out, int(count)
 
 
+SCORING = {"mean": 0, "max": 1, "min": 2}
+
+
 def watershed_agglomerate(
     affinity: np.ndarray,
     t_high: float = 0.99,
     t_low: float = 0.3,
     merge_threshold: float = 0.5,
+    scoring: str = "mean",
+    fragments: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, int]:
-    """Affinity map [3, z, y, x] float32 -> (segmentation uint32, count)."""
+    """Affinity map [3, z, y, x] float32 -> (segmentation uint32, count).
+
+    ``scoring`` selects the waterz-style boundary aggregator used for
+    merge priority: ``mean`` (default — the reference plugin's
+    OneMinus<MeanAffinity<...>> spelling), ``max``, or ``min``. With
+    ``fragments`` (a [z, y, x] uint32 pre-segmentation, 0 = background)
+    the seed/steepest-ascent phases are skipped and only hierarchical
+    agglomeration runs on the given fragments — the reference plugin's
+    ``fragments=`` input (waterz agglomerate(affs, fragments=...))."""
     lib = load()
     if affinity.ndim != 4 or affinity.shape[0] != 3:
         raise ValueError(f"need [3, z, y, x] affinities, got {affinity.shape}")
@@ -143,11 +166,41 @@ def watershed_agglomerate(
             f"volume of {affinity[0].size} voxels exceeds the native "
             f"kernel's 2^32 voxel addressing; split the chunk first"
         )
+    if scoring not in SCORING:
+        raise ValueError(
+            f"scoring must be one of {sorted(SCORING)}, got {scoring!r}"
+        )
     aff = np.ascontiguousarray(affinity, dtype=np.float32)
     out = np.empty(aff.shape[1:], dtype=np.uint32)
-    count = lib.watershed_agglomerate(
+    if fragments is not None:
+        frags = np.asarray(fragments)
+        if tuple(frags.shape) != tuple(aff.shape[1:]):
+            raise ValueError(
+                f"fragments shape {frags.shape} does not match the "
+                f"affinity volume {aff.shape[1:]}"
+            )
+        if frags.dtype.kind not in "iu":
+            raise TypeError(
+                f"fragments must be integer labels, got {frags.dtype}"
+            )
+        if frags.size and (int(frags.max()) > 0xFFFFFFFF
+                           or int(frags.min()) < 0):
+            # a silent uint32 cast would wrap distinct 64-bit supervoxel
+            # ids onto each other and fuse unrelated fragments
+            raise ValueError(
+                "fragment labels must fit uint32; renumber them first "
+                "(native.renumber)"
+            )
+        frags = np.ascontiguousarray(frags, dtype=np.uint32)
+        count = lib.agglomerate_fragments(
+            aff.ctypes.data, frags.ctypes.data, out.ctypes.data,
+            *aff.shape[1:], float(merge_threshold), SCORING[scoring],
+        )
+        return out, int(count)
+    count = lib.watershed_agglomerate_scored(
         aff.ctypes.data, out.ctypes.data, *aff.shape[1:],
         float(t_high), float(t_low), float(merge_threshold),
+        SCORING[scoring],
     )
     return out, int(count)
 

@@ -58,6 +58,13 @@
 
 #include "zslab.h"
 
+// first-encounter label compaction shared with the Python renumber API
+// (defined in remap.cpp, same shared library)
+extern "C" int64_t cf_renumber_u32(const uint32_t* in, uint32_t* out,
+                                   int64_t n, uint64_t start_id,
+                                   uint64_t* keys, uint64_t* vals,
+                                   int64_t max_pairs);
+
 namespace {
 
 using chunkflow::UnionFind;
@@ -83,12 +90,48 @@ struct PhaseTimer {
 // backward-shift deletion: the merge loop erases one entry per moved
 // boundary, and tombstones would degrade probe lengths over millions of
 // merges.
+// Boundary statistics per region pair. The default (mean scoring)
+// carries only sum/count; max/min scoring instantiates the extended
+// stat — keeping the hot mean path's table entries 8 bytes smaller
+// (measured ~6% realistic / ~35% pathological end-to-end when extrema
+// tracking was unconditionally in the one struct).
 struct PairStat {
+  static constexpr bool kExtrema = false;
   uint64_t key = 0;  // 0 = empty
   double sum = 0.0;
   int64_t cnt = 0;
+  void absorb_edge(float e) {
+    sum += e;
+    cnt += 1;
+  }
+  void absorb(const PairStat& o) {
+    sum += o.sum;
+    cnt += o.cnt;
+  }
 };
 
+struct PairStatEx {
+  static constexpr bool kExtrema = true;
+  uint64_t key = 0;  // 0 = empty
+  double sum = 0.0;
+  int64_t cnt = 0;
+  float mx = -std::numeric_limits<float>::infinity();
+  float mn = std::numeric_limits<float>::infinity();
+  void absorb_edge(float e) {
+    sum += e;
+    cnt += 1;
+    if (e > mx) mx = e;
+    if (e < mn) mn = e;
+  }
+  void absorb(const PairStatEx& o) {
+    sum += o.sum;
+    cnt += o.cnt;
+    if (o.mx > mx) mx = o.mx;
+    if (o.mn < mn) mn = o.mn;
+  }
+};
+
+template <class Stat>
 class PairMap {
  public:
   explicit PairMap(size_t expected = 16) { rehash(capacity_for(expected)); }
@@ -98,7 +141,7 @@ class PairMap {
     return (static_cast<uint64_t>(a) << 32) | b;
   }
 
-  PairStat* find(uint64_t key) {
+  Stat* find(uint64_t key) {
     size_t i = index_of(key);
     while (slots_[i].key != 0) {
       if (slots_[i].key == key) return &slots_[i];
@@ -107,16 +150,15 @@ class PairMap {
     return nullptr;
   }
 
-  PairStat& upsert(uint64_t key) {
+  Stat& upsert(uint64_t key) {
     if ((size_ + 1) * 10 > capacity() * 7) rehash(capacity() * 2);
     size_t i = index_of(key);
     while (slots_[i].key != 0) {
       if (slots_[i].key == key) return slots_[i];
       i = (i + 1) & mask_;
     }
+    slots_[i] = Stat{};
     slots_[i].key = key;
-    slots_[i].sum = 0.0;
-    slots_[i].cnt = 0;
     ++size_;
     return slots_[i];
   }
@@ -145,7 +187,7 @@ class PairMap {
   }
 
   size_t size() const { return size_; }
-  const std::vector<PairStat>& raw() const { return slots_; }
+  const std::vector<Stat>& raw() const { return slots_; }
 
  private:
   static size_t capacity_for(size_t n) {
@@ -163,23 +205,219 @@ class PairMap {
     return static_cast<size_t>(h) & mask_;
   }
   void rehash(size_t new_cap) {
-    std::vector<PairStat> old;
+    std::vector<Stat> old;
     old.swap(slots_);
-    slots_.assign(new_cap, PairStat{});
+    slots_.assign(new_cap, Stat{});
     mask_ = new_cap - 1;
     size_ = 0;
     for (const auto& s : old) {
       if (s.key == 0) continue;
-      PairStat& dst = upsert(s.key);
-      dst.sum = s.sum;
-      dst.cnt = s.cnt;
+      Stat& dst = upsert(s.key);
+      const uint64_t k = dst.key;
+      dst = s;
+      dst.key = k;
     }
   }
 
-  std::vector<PairStat> slots_;
+  std::vector<Stat> slots_;
   size_t mask_ = 0;
   size_t size_ = 0;
 };
+
+
+// waterz-parity boundary scoring: how a region pair's merge priority is
+// derived from its boundary-edge statistics. Mean is the default (the
+// reference plugin's OneMinus<MeanAffinity<...>> spelling); max/min map
+// the waterz Max/MinAffinity aggregators. All three stay EXACT under
+// hierarchical rescoring: sums/counts add and max/min combine when
+// boundaries merge.
+enum Scoring { kScoreMean = 0, kScoreMax = 1, kScoreMin = 2 };
+
+template <class Stat>
+inline float score_of(const Stat& s, int scoring) {
+  if constexpr (Stat::kExtrema) {
+    switch (scoring) {
+      case kScoreMax: return s.mx;
+      case kScoreMin: return s.mn;
+      default: break;
+    }
+  }
+  return static_cast<float>(s.sum / s.cnt);
+}
+
+// Phase 3 (shared by the full watershed entry and the
+// fragments-provided entry): hierarchical agglomeration with full
+// rescoring over a compact fragment labeling ids[] (values 1..nseg,
+// 0 = background). Writes the final compact segmentation to out and
+// returns its segment count.
+template <class Stat>
+uint32_t agglomerate_ids(const float* const chan[3], const uint32_t* ids,
+                         uint32_t nseg, int64_t sz, int64_t sy, int64_t sx,
+                         float merge_threshold, int scoring, uint32_t* out,
+                         PhaseTimer& timer) {
+  const int64_t n = sz * sy * sx;
+  const int64_t strides[3] = {sy * sx, sx, 1};
+  const int nt = thread_count(sz);
+  if (merge_threshold <= 0.0f || nseg <= 1) {
+    std::memcpy(out, ids, n * sizeof(uint32_t));
+    return nseg;
+  }
+  // 3a. boundary statistics, threaded: each slab accumulates its own
+  // PairMap (edges reaching into the previous slab only READ ids[], so
+  // no seam special-case is needed), merged into the global map in
+  // slab order for deterministic double sums. stats starts empty: at
+  // nt == 1 it is move-assigned from the single accumulator, and at
+  // nt > 1 it grows on merge — pre-sizing it here would just be a
+  // wasted multi-hundred-MB zero-fill on the worst cases.
+  PairMap<Stat> stats;
+  {
+    std::vector<PairMap<Stat>> local;
+    local.reserve(nt);
+    for (int t = 0; t < nt; ++t)
+      local.emplace_back(static_cast<size_t>(nseg / nt) * 3 + 16);
+    run_slabs(sz, nt, [&](int t, int64_t z0, int64_t z1) {
+      PairMap<Stat>& m = local[t];
+      auto add = [&](uint32_t a, uint32_t b, float e) {
+        if (!a || !b || a == b) return;
+        m.upsert(PairMap<Stat>::make_key(a, b)).absorb_edge(e);
+      };
+      for (int64_t z = z0; z < z1; ++z)
+        for (int64_t y = 0; y < sy; ++y) {
+          const int64_t row = (z * sy + y) * sx;
+          for (int64_t x = 0; x < sx; ++x) {
+            const int64_t i = row + x;
+            const uint32_t a = ids[i];
+            if (z > 0) add(a, ids[i - strides[0]], chan[0][i]);
+            if (y > 0) add(a, ids[i - strides[1]], chan[1][i]);
+            if (x > 0) add(a, ids[i - strides[2]], chan[2][i]);
+          }
+        }
+    });
+    if (nt == 1) {
+      stats = std::move(local[0]);
+    } else {
+      for (int t = 0; t < nt; ++t)
+        for (const auto& s : local[t].raw()) {
+          if (s.key == 0) continue;
+          stats.upsert(s.key).absorb(s);
+        }
+    }
+  }
+  timer.lap("phase3a rag");
+
+  // 3b. CSR neighbor lists from the initial pair set, plus a linked
+  // overflow chain for neighbors gained through merges (lazy deletion:
+  // stale entries are skipped when their pair stat no longer exists).
+  std::vector<int64_t> offsets(nseg + 2, 0);
+  std::vector<uint32_t> csr;
+  {
+    for (const auto& s : stats.raw()) {
+      if (s.key == 0) continue;
+      const uint32_t a = static_cast<uint32_t>(s.key >> 32);
+      const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
+      ++offsets[a + 1];
+      ++offsets[b + 1];
+    }
+    for (size_t r = 1; r < offsets.size(); ++r) offsets[r] += offsets[r - 1];
+    csr.resize(static_cast<size_t>(offsets[nseg + 1]));
+    std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
+    for (const auto& s : stats.raw()) {
+      if (s.key == 0) continue;
+      const uint32_t a = static_cast<uint32_t>(s.key >> 32);
+      const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
+      csr[static_cast<size_t>(cursor[a]++)] = b;
+      csr[static_cast<size_t>(cursor[b]++)] = a;
+    }
+  }
+  struct ExtraNode {
+    uint32_t nb;
+    int64_t next;
+  };
+  std::vector<int64_t> extra_head(nseg + 1, -1);
+  std::vector<ExtraNode> extra;
+  auto for_each_neighbor = [&](uint32_t r, auto&& fn) {
+    for (int64_t k = offsets[r]; k < offsets[r + 1]; ++k)
+      fn(csr[static_cast<size_t>(k)]);
+    for (int64_t node = extra_head[r]; node != -1;
+         node = extra[static_cast<size_t>(node)].next)
+      fn(extra[static_cast<size_t>(node)].nb);
+  };
+  auto add_neighbor = [&](uint32_t r, uint32_t nb) {
+    extra.push_back({nb, extra_head[r]});
+    extra_head[r] = static_cast<int64_t>(extra.size()) - 1;
+  };
+
+  UnionFind ruf(nseg + 1);
+  using QItem = std::pair<float, std::pair<uint32_t, uint32_t>>;
+  std::priority_queue<QItem> queue;
+  for (const auto& s : stats.raw()) {
+    if (s.key == 0) continue;
+    const float score = score_of(s, scoring);
+    if (score < merge_threshold) continue;  // can only go stale downward
+    queue.push({score,
+                {static_cast<uint32_t>(s.key >> 32),
+                 static_cast<uint32_t>(s.key & 0xffffffffu)}});
+  }
+  while (!queue.empty()) {
+    const auto [score, pair] = queue.top();
+    queue.pop();
+    // entries only ever go stale downward-in-validity, never does a
+    // current score lack an entry, so the popped score bounds every
+    // remaining current score: stop here. (Holds for max/min scoring
+    // too: a merged boundary's max can only stay or RISE, and every
+    // rise is re-pushed; mean and min only fall or re-push.)
+    if (score < merge_threshold) break;
+    const uint32_t a = pair.first, b = pair.second;
+    if (ruf.find(a) != a || ruf.find(b) != b) continue;  // merged away
+    Stat* st = stats.find(PairMap<Stat>::make_key(a, b));
+    if (st == nullptr) continue;
+    const float cur = score_of(*st, scoring);
+    if (cur != score) continue;  // stale; the fresh entry is queued
+    // merge the larger-id root into the smaller (matches UnionFind)
+    ruf.unite(a, b);
+    const uint32_t r = ruf.find(a);
+    const uint32_t o = (r == a) ? b : a;
+    stats.erase(PairMap<Stat>::make_key(a, b));
+    // move the loser's boundaries onto the winner, rescoring each
+    // combined boundary against the grown region
+    for_each_neighbor(o, [&](uint32_t nb) {
+      if (nb == r || nb == o) return;
+      Stat* src = stats.find(PairMap<Stat>::make_key(o, nb));
+      if (src == nullptr) return;  // stale/lazy-deleted entry
+      const Stat moved = *src;
+      stats.erase(PairMap<Stat>::make_key(o, nb));
+      Stat& dst = stats.upsert(PairMap<Stat>::make_key(r, nb));
+      dst.absorb(moved);
+      add_neighbor(r, nb);
+      add_neighbor(nb, r);
+      const float rescored = score_of(dst, scoring);
+      if (rescored >= merge_threshold)
+        queue.push({rescored, {std::min(r, nb), std::max(r, nb)}});
+    });
+  }
+  timer.lap("phase3 agglomerate");
+  std::vector<uint32_t> remap(nseg + 1, 0);
+  uint32_t finalc = 0;
+  for (uint32_t s = 1; s <= nseg; ++s) {
+    const uint32_t root = ruf.find(s);
+    if (remap[root] == 0) remap[root] = ++finalc;
+    remap[s] = remap[root];
+  }
+  for (int64_t i = 0; i < n; ++i) out[i] = remap[ids[i]];
+  return finalc;
+}
+
+uint32_t agglomerate_dispatch(const float* const chan[3],
+                              const uint32_t* ids, uint32_t nseg,
+                              int64_t sz, int64_t sy, int64_t sx,
+                              float merge_threshold, int scoring,
+                              uint32_t* out, PhaseTimer& timer) {
+  if (scoring == kScoreMean)
+    return agglomerate_ids<PairStat>(chan, ids, nseg, sz, sy, sx,
+                                     merge_threshold, scoring, out, timer);
+  return agglomerate_ids<PairStatEx>(chan, ids, nseg, sz, sy, sx,
+                                     merge_threshold, scoring, out, timer);
+}
 
 }  // namespace
 
@@ -190,9 +428,10 @@ extern "C" {
 // NEGATIVE along axis c (the common zyx affinity convention): channel 0
 // edge (i, i - sy*sx), channel 1 edge (i, i - sx), channel 2 edge
 // (i, i - 1).
-uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
-                               int64_t sy, int64_t sx, float t_high,
-                               float t_low, float merge_threshold) {
+uint32_t watershed_agglomerate_scored(const float* aff, uint32_t* out,
+                                      int64_t sz, int64_t sy, int64_t sx,
+                                      float t_high, float t_low,
+                                      float merge_threshold, int scoring) {
   PhaseTimer timer;
   const int64_t n = sz * sy * sx;
   const int64_t strides[3] = {sy * sx, sx, 1};
@@ -320,174 +559,43 @@ uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
   }
 
   timer.lap("compact");
-  // ---- 3: hierarchical mean-affinity agglomeration with rescoring ----
-  if (merge_threshold > 0.0f && nseg > 1) {
-    // 3a. boundary statistics, threaded: each slab accumulates its own
-    // PairMap (edges reaching into the previous slab only READ ids[], so
-    // no seam special-case is needed), merged into the global map in
-    // slab order for deterministic double sums. stats starts empty: at
-    // nt == 1 it is move-assigned from the single accumulator, and at
-    // nt > 1 it grows on merge — pre-sizing it here would just be a
-    // wasted multi-hundred-MB zero-fill on the worst cases.
-    PairMap stats;
-    {
-      std::vector<PairMap> local;
-      local.reserve(nt);
-      for (int t = 0; t < nt; ++t)
-        local.emplace_back(static_cast<size_t>(nseg / nt) * 3 + 16);
-      run_slabs(sz, nt, [&](int t, int64_t z0, int64_t z1) {
-        PairMap& m = local[t];
-        for (int64_t z = z0; z < z1; ++z)
-          for (int64_t y = 0; y < sy; ++y) {
-            const int64_t row = (z * sy + y) * sx;
-            for (int64_t x = 0; x < sx; ++x) {
-              const int64_t i = row + x;
-              const uint32_t a = ids[i];
-              if (z > 0) {
-                const uint32_t b = ids[i - strides[0]];
-                if (a && b && a != b) {
-                  PairStat& s = m.upsert(PairMap::make_key(a, b));
-                  s.sum += chan[0][i];
-                  s.cnt += 1;
-                }
-              }
-              if (y > 0) {
-                const uint32_t b = ids[i - strides[1]];
-                if (a && b && a != b) {
-                  PairStat& s = m.upsert(PairMap::make_key(a, b));
-                  s.sum += chan[1][i];
-                  s.cnt += 1;
-                }
-              }
-              if (x > 0) {
-                const uint32_t b = ids[i - strides[2]];
-                if (a && b && a != b) {
-                  PairStat& s = m.upsert(PairMap::make_key(a, b));
-                  s.sum += chan[2][i];
-                  s.cnt += 1;
-                }
-              }
-            }
-          }
-      });
-      if (nt == 1) {
-        stats = std::move(local[0]);
-      } else {
-        for (int t = 0; t < nt; ++t)
-          for (const auto& s : local[t].raw()) {
-            if (s.key == 0) continue;
-            PairStat& dst = stats.upsert(s.key);
-            dst.sum += s.sum;
-            dst.cnt += s.cnt;
-          }
-      }
-    }
-    timer.lap("phase3a rag");
+  return agglomerate_dispatch(chan, ids.data(), nseg, sz, sy, sx,
+                              merge_threshold, scoring, out, timer);
+}
 
-    // 3b. CSR neighbor lists from the initial pair set, plus a linked
-    // overflow chain for neighbors gained through merges (lazy deletion:
-    // stale entries are skipped when their pair stat no longer exists).
-    std::vector<int64_t> offsets(nseg + 2, 0);
-    std::vector<uint32_t> csr;
-    {
-      for (const auto& s : stats.raw()) {
-        if (s.key == 0) continue;
-        const uint32_t a = static_cast<uint32_t>(s.key >> 32);
-        const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
-        ++offsets[a + 1];
-        ++offsets[b + 1];
-      }
-      for (size_t r = 1; r < offsets.size(); ++r) offsets[r] += offsets[r - 1];
-      csr.resize(static_cast<size_t>(offsets[nseg + 1]));
-      std::vector<int64_t> cursor(offsets.begin(), offsets.end() - 1);
-      for (const auto& s : stats.raw()) {
-        if (s.key == 0) continue;
-        const uint32_t a = static_cast<uint32_t>(s.key >> 32);
-        const uint32_t b = static_cast<uint32_t>(s.key & 0xffffffffu);
-        csr[static_cast<size_t>(cursor[a]++)] = b;
-        csr[static_cast<size_t>(cursor[b]++)] = a;
-      }
-    }
-    struct ExtraNode {
-      uint32_t nb;
-      int64_t next;
-    };
-    std::vector<int64_t> extra_head(nseg + 1, -1);
-    std::vector<ExtraNode> extra;
-    auto for_each_neighbor = [&](uint32_t r, auto&& fn) {
-      for (int64_t k = offsets[r]; k < offsets[r + 1]; ++k)
-        fn(csr[static_cast<size_t>(k)]);
-      for (int64_t node = extra_head[r]; node != -1;
-           node = extra[static_cast<size_t>(node)].next)
-        fn(extra[static_cast<size_t>(node)].nb);
-    };
-    auto add_neighbor = [&](uint32_t r, uint32_t nb) {
-      extra.push_back({nb, extra_head[r]});
-      extra_head[r] = static_cast<int64_t>(extra.size()) - 1;
-    };
+// Backward-compatible spelling: mean-affinity scoring.
+uint32_t watershed_agglomerate(const float* aff, uint32_t* out, int64_t sz,
+                               int64_t sy, int64_t sx, float t_high,
+                               float t_low, float merge_threshold) {
+  return watershed_agglomerate_scored(aff, out, sz, sy, sx, t_high, t_low,
+                                      merge_threshold, kScoreMean);
+}
 
-    UnionFind ruf(nseg + 1);
-    using QItem = std::pair<float, std::pair<uint32_t, uint32_t>>;
-    std::priority_queue<QItem> queue;
-    for (const auto& s : stats.raw()) {
-      if (s.key == 0) continue;
-      const float score = static_cast<float>(s.sum / s.cnt);
-      if (score < merge_threshold) continue;  // can only go stale downward
-      queue.push({score,
-                  {static_cast<uint32_t>(s.key >> 32),
-                   static_cast<uint32_t>(s.key & 0xffffffffu)}});
-    }
-    while (!queue.empty()) {
-      const auto [score, pair] = queue.top();
-      queue.pop();
-      // entries only ever go stale downward-in-validity, never does a
-      // current score lack an entry, so the popped score bounds every
-      // remaining current score: stop here
-      if (score < merge_threshold) break;
-      const uint32_t a = pair.first, b = pair.second;
-      if (ruf.find(a) != a || ruf.find(b) != b) continue;  // merged away
-      PairStat* st = stats.find(PairMap::make_key(a, b));
-      if (st == nullptr) continue;
-      const float cur = static_cast<float>(st->sum / st->cnt);
-      if (cur != score) continue;  // stale; the fresh entry is queued
-      // merge the larger-id root into the smaller (matches UnionFind)
-      ruf.unite(a, b);
-      const uint32_t r = ruf.find(a);
-      const uint32_t o = (r == a) ? b : a;
-      stats.erase(PairMap::make_key(a, b));
-      // move the loser's boundaries onto the winner, rescoring each
-      // combined boundary against the grown region
-      for_each_neighbor(o, [&](uint32_t nb) {
-        if (nb == r || nb == o) return;
-        PairStat* src = stats.find(PairMap::make_key(o, nb));
-        if (src == nullptr) return;  // stale/lazy-deleted entry
-        const double sum = src->sum;
-        const int64_t cnt = src->cnt;
-        stats.erase(PairMap::make_key(o, nb));
-        PairStat& dst = stats.upsert(PairMap::make_key(r, nb));
-        dst.sum += sum;
-        dst.cnt += cnt;
-        add_neighbor(r, nb);
-        add_neighbor(nb, r);
-        const float rescored = static_cast<float>(dst.sum / dst.cnt);
-        if (rescored >= merge_threshold)
-          queue.push({rescored, {std::min(r, nb), std::max(r, nb)}});
-      });
-    }
-    timer.lap("phase3 agglomerate");
-    std::vector<uint32_t> remap(nseg + 1, 0);
-    uint32_t finalc = 0;
-    for (uint32_t s = 1; s <= nseg; ++s) {
-      const uint32_t root = ruf.find(s);
-      if (remap[root] == 0) remap[root] = ++finalc;
-      remap[s] = remap[root];
-    }
-    for (int64_t i = 0; i < n; ++i) out[i] = remap[ids[i]];
-    return finalc;
-  }
-
-  std::memcpy(out, ids.data(), n * sizeof(uint32_t));
-  return nseg;
+// Agglomerate PRECOMPUTED fragments (the reference plugin's
+// ``fragments=`` input, waterz agglomerate(affs, fragments=...)): skip
+// the seed/steepest-ascent phases, compact the caller's arbitrary
+// nonzero uint32 fragment labels to 1..nseg by first raster encounter,
+// and run the same hierarchical rescoring agglomeration. frags and out
+// may NOT alias.
+uint32_t agglomerate_fragments(const float* aff, const uint32_t* frags,
+                               uint32_t* out, int64_t sz, int64_t sy,
+                               int64_t sx, float merge_threshold,
+                               int scoring) {
+  PhaseTimer timer;
+  const int64_t n = sz * sy * sx;
+  const float* chan[3] = {aff, aff + n, aff + 2 * n};
+  // compact arbitrary labels -> 1..nseg by first raster encounter via
+  // the shared renumber kernel (remap.cpp). out[] is fully written even
+  // when the mapping export overflows max_pairs (we pass 0 and no
+  // buffers — the mapping itself is not needed), and |ret| is the
+  // distinct-label count either way.
+  std::vector<uint32_t> ids(n, 0);
+  const int64_t r =
+      cf_renumber_u32(frags, ids.data(), n, 1, nullptr, nullptr, 0);
+  const uint32_t nseg = static_cast<uint32_t>(r < 0 ? -r : r);
+  timer.lap("compact");
+  return agglomerate_dispatch(chan, ids.data(), nseg, sz, sy, sx,
+                              merge_threshold, scoring, out, timer);
 }
 
 }  // extern "C"

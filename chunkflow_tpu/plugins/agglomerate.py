@@ -1,25 +1,66 @@
-"""Affinity -> segmentation via native watershed + mean-affinity
-agglomeration (reference plugins/agglomerate.py, waterz equivalent)."""
+"""Affinity -> segmentation via native watershed + hierarchical
+agglomeration (reference plugins/agglomerate.py, waterz equivalent).
+
+Signature parity with the reference plugin: ``fragments`` (precomputed
+fragment segmentation — only the agglomeration phase runs),
+``scoring_function`` (waterz template spellings like
+``OneMinus<MeanAffinity<RegionGraphType, ScoreValue>>`` are parsed down
+to their aggregator: Mean/Max/MinAffinity; the short spellings
+``mean``/``max``/``min`` also work), and ``flip_channel`` (the
+reference's affinity channel order is x,y,z, so volumes it produced
+need the channel axis reversed to this framework's z,y,x convention —
+default False because chunks produced HERE are already zyx, where the
+reference defaults True for its own xyz volumes).
+"""
 import numpy as np
 
 from chunkflow_tpu import native
 from chunkflow_tpu.chunk import Segmentation
 
 
+def _parse_scoring(scoring_function: str) -> str:
+    s = scoring_function.strip().lower()
+    if s in ("mean", "max", "min"):
+        return s
+    for agg in ("mean", "max", "min"):
+        if f"{agg}affinity" in s:
+            return agg
+    raise ValueError(
+        f"unsupported scoring_function {scoring_function!r}: need "
+        "mean/max/min or a waterz spelling containing "
+        "Mean/Max/MinAffinity"
+    )
+
+
 def execute(
     affs,
+    fragments=None,
     threshold: float = 0.7,
     aff_threshold_low: float = 0.0001,
     aff_threshold_high: float = 0.9999,
+    scoring_function: str = "OneMinus<MeanAffinity<RegionGraphType, ScoreValue>>",
+    flip_channel: bool = False,
 ):
     arr = np.asarray(affs.array, dtype=np.float32)
     if arr.ndim != 4 or arr.shape[0] != 3:
         raise ValueError(f"need [3, z, y, x] affinity chunk, got {arr.shape}")
+    if flip_channel:
+        # reference-produced volumes store channels x,y,z
+        arr = np.ascontiguousarray(arr[::-1])
+    frags = None
+    if fragments is not None:
+        frags = np.asarray(
+            fragments.array if hasattr(fragments, "array") else fragments
+        )
+        if frags.ndim == 4 and frags.shape[0] == 1:
+            frags = frags[0]
     seg, count = native.watershed_agglomerate(
         arr,
         t_high=aff_threshold_high,
         t_low=aff_threshold_low,
         merge_threshold=threshold,
+        scoring=_parse_scoring(scoring_function),
+        fragments=frags,
     )
     print(f"agglomerate: {count} segments")
     return Segmentation(

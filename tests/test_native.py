@@ -524,3 +524,108 @@ class TestCC3DThreading:
         labels, count = native.connected_components(arr, connectivity=6)
         assert count == 4, count
         assert len(np.unique(labels[:, 4, 4])) == 1
+
+
+class TestScoringAndFragments:
+    """waterz-parity agglomeration options (reference
+    plugins/agglomerate.py: scoring_function, fragments)."""
+
+    def test_max_min_scoring_semantics(self):
+        # two blocks; boundary affinities mixed 0.9 / 0.1 -> mean 0.5
+        aff = np.ones((3, 2, 4, 8), np.float32)
+        aff[:, :, :, 4] = 0.1
+        aff[2, 0, 0, 4] = 0.9  # one strong edge on the boundary
+        # mean ~ 0.15-0.2 < 0.6: stays split
+        _, n_mean = native.watershed_agglomerate(
+            aff, 0.95, 0.01, 0.6, scoring="mean")
+        assert n_mean == 2
+        # max = 0.9 >= 0.6: merges
+        _, n_max = native.watershed_agglomerate(
+            aff, 0.95, 0.01, 0.6, scoring="max")
+        assert n_max == 1
+        # min = 0.1 < 0.6: stays split even with threshold below mean
+        aff2 = np.ones((3, 2, 4, 8), np.float32)
+        aff2[:, :, :, 4] = 0.7
+        aff2[2, 0, 0, 4] = 0.1
+        _, n_min = native.watershed_agglomerate(
+            aff2, 0.95, 0.01, 0.5, scoring="min")
+        assert n_min == 2
+        _, n_mean2 = native.watershed_agglomerate(
+            aff2, 0.95, 0.01, 0.5, scoring="mean")
+        assert n_mean2 == 1
+
+    def test_fragments_input_matches_full_run(self):
+        """merge_threshold=0 returns raw fragments; feeding them back via
+        fragments= must reproduce the full run bit-for-bit (the fragment
+        ids are already first-encounter-compact, so the RAG sums match)."""
+        rng = np.random.default_rng(21)
+        aff = np.clip(
+            rng.normal(0.6, 0.2, (3, 8, 32, 32)), 0, 1
+        ).astype(np.float32)
+        frag_seg, n_frag = native.watershed_agglomerate(aff, 0.9, 0.2, 0.0)
+        assert n_frag > 1
+        full, n_full = native.watershed_agglomerate(aff, 0.9, 0.2, 0.55)
+        via_frags, n_via = native.watershed_agglomerate(
+            aff, merge_threshold=0.55, fragments=frag_seg)
+        assert n_via == n_full
+        np.testing.assert_array_equal(via_frags, full)
+
+    def test_fragments_arbitrary_labels(self):
+        # non-compact labels (e.g. global supervoxel ids) compact by
+        # first raster encounter; background 0 stays 0
+        aff = np.ones((3, 2, 4, 8), np.float32)
+        aff[2, :, :, 4] = 0.9  # x-edges crossing the fragment boundary
+        frags = np.zeros((2, 4, 8), np.uint32)
+        frags[:, 1:, :4] = 7_000_001  # touching fragments at x=3|4,
+        frags[:, 1:, 4:] = 123        # row y=0 stays background
+        seg, count = native.watershed_agglomerate(
+            aff, merge_threshold=0.8, fragments=frags)
+        assert count == 1  # mean boundary 0.9 >= 0.8 merges them
+        assert (seg[:, 0, :] == 0).all()  # background preserved
+        seg2, count2 = native.watershed_agglomerate(
+            aff, merge_threshold=0.95, fragments=frags)
+        assert count2 == 2
+        assert seg2[0, 1, 0] == 1 and seg2[0, 1, 7] == 2  # raster order
+
+    def test_bad_scoring_rejected(self):
+        aff = np.ones((3, 2, 4, 4), np.float32)
+        with pytest.raises(ValueError, match="scoring"):
+            native.watershed_agglomerate(aff, scoring="median")
+
+    def test_fragments_label_overflow_rejected(self):
+        # int64 supervoxel ids beyond uint32 must be rejected, not
+        # silently wrapped onto each other (silent fusion)
+        aff = np.ones((3, 2, 4, 4), np.float32)
+        frags = np.zeros((2, 4, 4), np.int64)
+        frags[:, :, :2] = 5
+        frags[:, :, 2:] = (1 << 32) + 5
+        with pytest.raises(ValueError, match="uint32"):
+            native.watershed_agglomerate(
+                aff, merge_threshold=0.5, fragments=frags)
+        with pytest.raises(TypeError, match="integer"):
+            native.watershed_agglomerate(
+                aff, merge_threshold=0.5,
+                fragments=frags.astype(np.float32))
+
+    def test_plugin_scoring_function_and_flip(self):
+        from chunkflow_tpu.chunk.base import Chunk
+        from chunkflow_tpu.flow.plugin import load_plugin
+
+        execute = load_plugin("agglomerate")
+        aff_zyx = np.ones((3, 4, 8, 8), np.float32)
+        aff_zyx[:, :, :, 4] = 0.05
+        chunk = Chunk(aff_zyx.copy())
+        # waterz spelling parses to mean
+        seg = execute(
+            chunk, threshold=0.7,
+            scoring_function="OneMinus<MeanAffinity<RegionGraphType, ScoreValue>>",
+        )
+        assert np.unique(np.asarray(seg.array)).size == 2
+        # the reference's xyz channel order + flip_channel=True must
+        # match the zyx run
+        chunk_xyz = Chunk(np.ascontiguousarray(aff_zyx[::-1]))
+        seg_flip = execute(chunk_xyz, threshold=0.7, flip_channel=True)
+        np.testing.assert_array_equal(
+            np.asarray(seg_flip.array), np.asarray(seg.array))
+        with pytest.raises(ValueError, match="scoring_function"):
+            execute(chunk, scoring_function="Quantile<50>")
