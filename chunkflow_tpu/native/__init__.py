@@ -139,6 +139,24 @@ def connected_components(arr: np.ndarray, connectivity: int = 26) -> Tuple[np.nd
 SCORING = {"mean": 0, "max": 1, "min": 2}
 
 
+def _scoring_code(scoring: str) -> int:
+    """mean/max/min, or ``quantileN`` (0 <= N <= 100, e.g. quantile50 =
+    the waterz aff50 median config; 256-bin histogram approximation)."""
+    if scoring in SCORING:
+        return SCORING[scoring]
+    if scoring.startswith("quantile"):
+        try:
+            q = int(scoring[len("quantile"):])
+        except ValueError:
+            q = -1
+        if 0 <= q <= 100:
+            return 100 + q
+    raise ValueError(
+        f"scoring must be one of {sorted(SCORING)} or 'quantileN' "
+        f"(0<=N<=100), got {scoring!r}"
+    )
+
+
 def watershed_agglomerate(
     affinity: np.ndarray,
     t_high: float = 0.99,
@@ -151,7 +169,10 @@ def watershed_agglomerate(
 
     ``scoring`` selects the waterz-style boundary aggregator used for
     merge priority: ``mean`` (default — the reference plugin's
-    OneMinus<MeanAffinity<...>> spelling), ``max``, or ``min``. With
+    OneMinus<MeanAffinity<...>> spelling), ``max``, ``min``, or
+    ``quantileN`` (the QuantileAffinity<..., N, ...> spellings, e.g.
+    ``quantile50`` for the aff50 median config; 256-bin histogram, 1 KB
+    per boundary pair). With
     ``fragments`` (a [z, y, x] uint32 pre-segmentation, 0 = background)
     the seed/steepest-ascent phases are skipped and only hierarchical
     agglomeration runs on the given fragments — the reference plugin's
@@ -166,10 +187,7 @@ def watershed_agglomerate(
             f"volume of {affinity[0].size} voxels exceeds the native "
             f"kernel's 2^32 voxel addressing; split the chunk first"
         )
-    if scoring not in SCORING:
-        raise ValueError(
-            f"scoring must be one of {sorted(SCORING)}, got {scoring!r}"
-        )
+    scoring_code = _scoring_code(scoring)
     aff = np.ascontiguousarray(affinity, dtype=np.float32)
     out = np.empty(aff.shape[1:], dtype=np.uint32)
     if fragments is not None:
@@ -194,13 +212,13 @@ def watershed_agglomerate(
         frags = np.ascontiguousarray(frags, dtype=np.uint32)
         count = lib.agglomerate_fragments(
             aff.ctypes.data, frags.ctypes.data, out.ctypes.data,
-            *aff.shape[1:], float(merge_threshold), SCORING[scoring],
+            *aff.shape[1:], float(merge_threshold), scoring_code,
         )
         return out, int(count)
     count = lib.watershed_agglomerate_scored(
         aff.ctypes.data, out.ctypes.data, *aff.shape[1:],
         float(t_high), float(t_low), float(merge_threshold),
-        SCORING[scoring],
+        scoring_code,
     )
     return out, int(count)
 

@@ -97,6 +97,7 @@ struct PhaseTimer {
 // tracking was unconditionally in the one struct).
 struct PairStat {
   static constexpr bool kExtrema = false;
+  static constexpr bool kHistogram = false;
   uint64_t key = 0;  // 0 = empty
   double sum = 0.0;
   int64_t cnt = 0;
@@ -112,6 +113,7 @@ struct PairStat {
 
 struct PairStatEx {
   static constexpr bool kExtrema = true;
+  static constexpr bool kHistogram = false;
   uint64_t key = 0;  // 0 = empty
   double sum = 0.0;
   int64_t cnt = 0;
@@ -128,6 +130,47 @@ struct PairStatEx {
     cnt += o.cnt;
     if (o.mx > mx) mx = o.mx;
     if (o.mn < mn) mn = o.mn;
+  }
+};
+
+// Quantile scoring (the waterz QuantileAffinity<..., q, ...> spelling,
+// e.g. the common production aff50 median): a 256-bin histogram of the
+// boundary's edge affinities, exact under merging (bins add), with the
+// quantile read off as the midpoint of the bin holding the rank —
+// discretization error <= 1/512 on [0,1] affinities, matching waterz's
+// own discretized histogram provider. 1 KB per boundary pair: choose
+// this scoring for realistic fragment counts, not the multi-million-
+// fragment pathological regimes.
+struct PairStatQ {
+  static constexpr bool kExtrema = false;
+  static constexpr bool kHistogram = true;
+  static constexpr int kBins = 256;
+  uint64_t key = 0;  // 0 = empty
+  int64_t cnt = 0;  // no sum: dispatch guarantees quantile-only scoring
+  uint32_t hist[kBins] = {};
+  static int bin_of(float e) {
+    int b = static_cast<int>(e * kBins);
+    if (b < 0) b = 0;
+    if (b >= kBins) b = kBins - 1;
+    return b;
+  }
+  void absorb_edge(float e) {
+    cnt += 1;
+    hist[bin_of(e)] += 1;
+  }
+  void absorb(const PairStatQ& o) {
+    cnt += o.cnt;
+    for (int b = 0; b < kBins; ++b) hist[b] += o.hist[b];
+  }
+  float quantile(int q) const {
+    // rank of the q-th percentile under nearest-rank-with-midpoint
+    const double rank = (cnt - 1) * (q / 100.0);
+    int64_t cum = 0;
+    for (int b = 0; b < kBins; ++b) {
+      cum += hist[b];
+      if (cum > rank) return (b + 0.5f) / kBins;
+    }
+    return 1.0f;
   }
 };
 
@@ -231,18 +274,26 @@ class PairMap {
 // the waterz Max/MinAffinity aggregators. All three stay EXACT under
 // hierarchical rescoring: sums/counts add and max/min combine when
 // boundaries merge.
-enum Scoring { kScoreMean = 0, kScoreMax = 1, kScoreMin = 2 };
+// scoring encoding: 0 mean, 1 max, 2 min, 100+q = q-th percentile
+// (e.g. 150 = median / the waterz aff50 config)
+enum Scoring { kScoreMean = 0, kScoreMax = 1, kScoreMin = 2,
+               kScoreQuantileBase = 100 };
 
 template <class Stat>
 inline float score_of(const Stat& s, int scoring) {
-  if constexpr (Stat::kExtrema) {
-    switch (scoring) {
-      case kScoreMax: return s.mx;
-      case kScoreMin: return s.mn;
-      default: break;
+  if constexpr (Stat::kHistogram) {
+    // dispatch routes histogram stats only for scoring >= quantile base
+    return s.quantile(scoring - kScoreQuantileBase);
+  } else {
+    if constexpr (Stat::kExtrema) {
+      switch (scoring) {
+        case kScoreMax: return s.mx;
+        case kScoreMin: return s.mn;
+        default: break;
+      }
     }
+    return static_cast<float>(s.sum / s.cnt);
   }
-  return static_cast<float>(s.sum / s.cnt);
 }
 
 // Phase 3 (shared by the full watershed entry and the
@@ -274,7 +325,11 @@ uint32_t agglomerate_ids(const float* const chan[3], const uint32_t* ids,
     std::vector<PairMap<Stat>> local;
     local.reserve(nt);
     for (int t = 0; t < nt; ++t)
-      local.emplace_back(static_cast<size_t>(nseg / nt) * 3 + 16);
+      // histogram stats are ~1 KB/slot: let those maps grow on demand
+      // instead of zero-filling a multi-GB pre-size tuned for the
+      // 24-byte mean stat
+      local.emplace_back(
+          Stat::kHistogram ? 16 : static_cast<size_t>(nseg / nt) * 3 + 16);
     run_slabs(sz, nt, [&](int t, int64_t z0, int64_t z1) {
       PairMap<Stat>& m = local[t];
       auto add = [&](uint32_t a, uint32_t b, float e) {
@@ -412,6 +467,9 @@ uint32_t agglomerate_dispatch(const float* const chan[3],
                               int64_t sz, int64_t sy, int64_t sx,
                               float merge_threshold, int scoring,
                               uint32_t* out, PhaseTimer& timer) {
+  if (scoring >= kScoreQuantileBase)
+    return agglomerate_ids<PairStatQ>(chan, ids, nseg, sz, sy, sx,
+                                      merge_threshold, scoring, out, timer);
   if (scoring == kScoreMean)
     return agglomerate_ids<PairStat>(chan, ids, nseg, sz, sy, sx,
                                      merge_threshold, scoring, out, timer);

@@ -4,9 +4,11 @@ agglomeration (reference plugins/agglomerate.py, waterz equivalent).
 Signature parity with the reference plugin: ``fragments`` (precomputed
 fragment segmentation — only the agglomeration phase runs),
 ``scoring_function`` (waterz template spellings like
-``OneMinus<MeanAffinity<RegionGraphType, ScoreValue>>`` are parsed down
-to their aggregator: Mean/Max/MinAffinity; the short spellings
-``mean``/``max``/``min`` also work), and ``flip_channel`` (the
+``OneMinus<MeanAffinity<RegionGraphType, ScoreValue>>`` or
+``OneMinus<QuantileAffinity<RegionGraphType, ScoreValue, 50, false>>``
+are parsed down to their aggregator — Mean/Max/Min/QuantileAffinity;
+the short spellings ``mean``/``max``/``min``/``quantileN`` also work),
+and ``flip_channel`` (the
 reference's affinity channel order is x,y,z, so volumes it produced
 need the channel axis reversed to this framework's z,y,x convention —
 default False because chunks produced HERE are already zyx, where the
@@ -19,16 +21,21 @@ from chunkflow_tpu.chunk import Segmentation
 
 
 def _parse_scoring(scoring_function: str) -> str:
+    import re
+
     s = scoring_function.strip().lower()
-    if s in ("mean", "max", "min"):
+    if s in ("mean", "max", "min") or re.fullmatch(r"quantile\d{1,3}", s):
         return s
     for agg in ("mean", "max", "min"):
         if f"{agg}affinity" in s:
             return agg
+    m = re.search(r"quantileaffinity<[^,]+,[^,]+,\s*(\d{1,3})", s)
+    if m:
+        return f"quantile{m.group(1)}"
     raise ValueError(
         f"unsupported scoring_function {scoring_function!r}: need "
-        "mean/max/min or a waterz spelling containing "
-        "Mean/Max/MinAffinity"
+        "mean/max/min/quantileN or a waterz spelling containing "
+        "Mean/Max/Min/QuantileAffinity"
     )
 
 

@@ -629,3 +629,55 @@ class TestScoringAndFragments:
             np.asarray(seg_flip.array), np.asarray(seg.array))
         with pytest.raises(ValueError, match="scoring_function"):
             execute(chunk, scoring_function="Quantile<50>")
+
+
+class TestQuantileScoring:
+    def test_median_vs_mean_semantics(self):
+        # boundary: 3 weak edges (0.1) + 7 strong (0.9) -> mean 0.66,
+        # median ~0.9: a threshold of 0.8 merges only under quantile50
+        aff = np.ones((3, 2, 4, 8), np.float32)
+        aff[2, :, :, 4] = 0.9
+        aff[2, 0, :3, 4] = 0.1  # 3 of 8 boundary edges weak... 2*4=8 edges
+        _, n_mean = native.watershed_agglomerate(
+            aff, 0.95, 0.01, 0.8, scoring="mean")
+        assert n_mean == 2
+        _, n_q50 = native.watershed_agglomerate(
+            aff, 0.95, 0.01, 0.8, scoring="quantile50")
+        assert n_q50 == 1
+        # quantile0 ~ min: the weakest edge (0.1) governs
+        _, n_q0 = native.watershed_agglomerate(
+            aff, 0.95, 0.01, 0.5, scoring="quantile0")
+        assert n_q0 == 2
+
+    def test_quantile_matches_full_run_via_fragments(self):
+        rng = np.random.default_rng(33)
+        aff = np.clip(rng.normal(0.6, 0.2, (3, 8, 24, 24)), 0, 1
+                      ).astype(np.float32)
+        frag_seg, _ = native.watershed_agglomerate(aff, 0.9, 0.2, 0.0)
+        full, n_full = native.watershed_agglomerate(
+            aff, 0.9, 0.2, 0.6, scoring="quantile50")
+        via, n_via = native.watershed_agglomerate(
+            aff, merge_threshold=0.6, scoring="quantile50",
+            fragments=frag_seg)
+        assert n_via == n_full
+        np.testing.assert_array_equal(via, full)
+
+    def test_plugin_waterz_quantile_spelling(self):
+        from chunkflow_tpu.chunk.base import Chunk
+        from chunkflow_tpu.flow.plugin import load_plugin
+
+        execute = load_plugin("agglomerate")
+        aff = np.ones((3, 4, 8, 8), np.float32)
+        aff[:, :, :, 4] = 0.05
+        seg = execute(
+            Chunk(aff), threshold=0.7,
+            scoring_function=(
+                "OneMinus<QuantileAffinity<RegionGraphType, "
+                "ScoreValue, 50, false>>"),
+        )
+        assert np.unique(np.asarray(seg.array)).size == 2
+
+    def test_bad_quantile_rejected(self):
+        aff = np.ones((3, 2, 4, 4), np.float32)
+        with pytest.raises(ValueError, match="scoring"):
+            native.watershed_agglomerate(aff, scoring="quantile101")
