@@ -7,6 +7,8 @@ but vectorized numpy/jnp instead of cv2 histograms and Python while-loops
 is one ``np.bincount`` + ``searchsorted``). These run on the host pipeline
 path; the hot inference path normalizes on device inside the fused engine.
 """
+# Host-side grey-level statistics (histogram CDFs, mean/std) accumulate
+# in float64 on purpose.  # graftlint: disable-file=GL004
 from __future__ import annotations
 
 from typing import Optional, Sequence

@@ -68,7 +68,7 @@ class AffinityMap(Chunk):
         """
         arr = np.asarray(self.array)
         if mode == "xy":
-            gray = arr[1:3].mean(axis=0)
+            gray = arr[1:3].mean(axis=0, dtype=np.float32)
         elif mode == "z":
             gray = arr[0]
         else:

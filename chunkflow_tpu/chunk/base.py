@@ -103,13 +103,15 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
         size = tuple(to_cartesian(size))
         dtype = np.dtype(dtype)
         if pattern == "zero":
-            arr = np.zeros(size, dtype=np.float64)
+            arr = np.zeros(size, dtype=np.float32)
         elif pattern == "random":
             rng = np.random.default_rng(seed)
             arr = rng.random(size)
         elif pattern == "sin":
             z, y, x = np.meshgrid(
-                *[np.linspace(0, 4 * np.pi, s) for s in size], indexing="ij"
+                # float64 linspace keeps the sin fixture bit-stable
+                *[np.linspace(0, 4 * np.pi, s)  # graftlint: disable=GL004
+                  for s in size], indexing="ij"
             )
             arr = (np.sin(z) * np.sin(y) * np.sin(x) + 1.0) / 2.0
         else:

@@ -41,6 +41,7 @@ class ProbabilityMap(Chunk):
         threshold = threshold_rel * float(arr.max()) if arr.size else 0.0
         peaks = np.logical_and(arr == local_max, arr > threshold)
         coords = np.argwhere(peaks)
-        confidences = arr[tuple(coords.T)] if coords.size else np.zeros((0,))
+        confidences = (arr[tuple(coords.T)] if coords.size
+                       else np.zeros((0,), dtype=arr.dtype))
         coords = coords + self.voxel_offset.vec
         return coords.astype(np.int64), confidences

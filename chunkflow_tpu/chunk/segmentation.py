@@ -6,6 +6,9 @@ math gala/the reference use, implemented directly on scipy.sparse.
 Remap/renumber replace the fastremap C++ wheel with vectorized numpy
 (np.unique-based); see ops/remap.py.
 """
+# Rand/VOI evaluation metrics accumulate pair counts in float64 on
+# purpose (billions of voxel pairs overflow float32 precision).
+# graftlint: disable-file=GL004
 from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple

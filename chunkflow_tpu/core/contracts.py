@@ -1,0 +1,217 @@
+"""Lightweight runtime shape/dtype contracts for chunk-geometry APIs.
+
+The blending stack's correctness hinges on array-shape conventions (czyx
+channel-leading chunks, [N, 3] zyx start coordinates, float32
+accumulators) that Python can't express in signatures. ``@contract``
+declares them at the public entry points and validates every call:
+
+    @contract(out=Spec("co", "z", "y", "x", dtype="float32"),
+              weight=Spec("z", "y", "x", dtype="float32"))
+    def normalize_blend(out, weight, dtype="float32"): ...
+
+Dimension entries are exact ints, named symbols (equal names must match
+across all specs in one call — ``"z"`` above ties ``out`` and ``weight``
+to the same grid), or None for don't-care; a leading/trailing ``...``
+allows extra dims. Validation reads ONLY static trace-time facts
+(``x.shape``/``x.dtype``/``x.ndim``), so under ``jax.jit`` it runs once
+at trace time and costs nothing in the compiled program — and via
+``jax.eval_shape`` (see ``check_abstract``) a whole program's result
+contract can be validated without executing a single FLOP.
+
+Chunk objects participate too: anything exposing ``.shape``/``.dtype``
+(numpy arrays, jax arrays, tracers, ``Chunk``) is checkable; values
+without a shape are rejected unless the Spec says ``optional=True`` and
+the value is None. Set ``CHUNKFLOW_CONTRACTS=0`` to strip all checks
+(e.g. a production run that has already been validated).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+from typing import Any, Dict, Optional, Tuple
+
+
+class ContractError(TypeError):
+    """An argument or result violated a declared shape/dtype contract."""
+
+
+def contracts_enabled() -> bool:
+    return os.environ.get("CHUNKFLOW_CONTRACTS", "1").lower() not in (
+        "0", "off", "false",
+    )
+
+
+class Spec:
+    """Shape/dtype expectation for one array-like value.
+
+    ``Spec("co", "z", "y", "x")``: 4D with dims named for cross-argument
+    consistency. ``Spec(ndim=4)``: rank only. ``Spec(..., 3)``: any dims
+    then a final extent-3 axis. ``dtype=`` accepts one name or a tuple of
+    admissible names.
+    """
+
+    def __init__(self, *dims, ndim=None, dtype=None, optional=False):
+        self.dims: Optional[Tuple] = tuple(dims) if dims else None
+        if self.dims is not None and self.dims.count(Ellipsis) > 1:
+            raise ValueError("at most one ... per Spec")
+        self.ndim = ndim
+        self.dtypes: Optional[Tuple[str, ...]] = (
+            (dtype,) if isinstance(dtype, str) else tuple(dtype)
+        ) if dtype is not None else None
+        self.optional = optional
+
+    def __repr__(self):
+        parts = []
+        if self.dims is not None:
+            parts.append(
+                "(" + ", ".join(
+                    "..." if d is Ellipsis else repr(d) for d in self.dims
+                ) + ")"
+            )
+        if self.ndim is not None:
+            parts.append(f"ndim={self.ndim}")
+        if self.dtypes is not None:
+            parts.append(f"dtype={'|'.join(self.dtypes)}")
+        return f"Spec({', '.join(parts)})"
+
+    # ------------------------------------------------------------------
+    def validate(self, value: Any, where: str,
+                 bindings: Dict[str, int]) -> None:
+        if value is None:
+            if self.optional:
+                return
+            raise ContractError(f"{where}: required value is None")
+        shape = getattr(value, "shape", None)
+        if shape is None:
+            raise ContractError(
+                f"{where}: expected an array-like with .shape, got "
+                f"{type(value).__name__}"
+            )
+        shape = tuple(shape)
+        if self.ndim is not None:
+            allowed = (
+                self.ndim if isinstance(self.ndim, tuple) else (self.ndim,)
+            )
+            if len(shape) not in allowed:
+                raise ContractError(
+                    f"{where}: rank {len(shape)} (shape {shape}), "
+                    f"contract wants ndim {self.ndim}"
+                )
+        if self.dims is not None:
+            self._match_dims(shape, where, bindings)
+        if self.dtypes is not None:
+            dt = getattr(value, "dtype", None)
+            name = getattr(dt, "name", str(dt))
+            if name not in self.dtypes:
+                raise ContractError(
+                    f"{where}: dtype {name}, contract wants "
+                    f"{' or '.join(self.dtypes)}"
+                )
+
+    def _match_dims(self, shape: Tuple[int, ...], where: str,
+                    bindings: Dict[str, int]) -> None:
+        dims = self.dims
+        if Ellipsis in dims:
+            i = dims.index(Ellipsis)
+            head, tail = dims[:i], dims[i + 1:]
+            if len(shape) < len(head) + len(tail):
+                raise ContractError(
+                    f"{where}: shape {shape} too short for contract "
+                    f"{self!r}"
+                )
+            pairs = list(zip(head, shape[:len(head)]))
+            if tail:
+                pairs += list(zip(tail, shape[-len(tail):]))
+        else:
+            if len(shape) != len(dims):
+                raise ContractError(
+                    f"{where}: shape {shape} has rank {len(shape)}, "
+                    f"contract {self!r} wants {len(dims)}"
+                )
+            pairs = list(zip(dims, shape))
+        for dim, actual in pairs:
+            if dim is None:
+                continue
+            if isinstance(dim, int):
+                if actual != dim:
+                    raise ContractError(
+                        f"{where}: shape {shape} violates contract "
+                        f"{self!r} (expected extent {dim}, got {actual})"
+                    )
+            else:  # named symbol: must be consistent across the call
+                prev = bindings.setdefault(str(dim), actual)
+                if prev != actual:
+                    raise ContractError(
+                        f"{where}: dim '{dim}'={actual} conflicts with "
+                        f"'{dim}'={prev} bound earlier in this call"
+                    )
+
+
+def contract(_result=None, **arg_specs):
+    """Declare per-argument (by name) and result shape contracts.
+
+    ``_result`` is a Spec, or a tuple of Specs for tuple-returning
+    functions. Unknown argument names fail at decoration time, so a
+    contract can't silently drift off its signature.
+    """
+    for spec in list(arg_specs.values()) + (
+        list(_result) if isinstance(_result, tuple) else
+        [_result] if _result is not None else []
+    ):
+        if not isinstance(spec, Spec):
+            raise TypeError(f"contract specs must be Spec, got {spec!r}")
+
+    def decorate(fn):
+        sig = inspect.signature(fn)
+        unknown = set(arg_specs) - set(sig.parameters)
+        if unknown:
+            raise TypeError(
+                f"@contract on {fn.__qualname__}: no such parameter(s) "
+                f"{sorted(unknown)}"
+            )
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not contracts_enabled():
+                return fn(*args, **kwargs)
+            bound = sig.bind(*args, **kwargs)
+            bindings: Dict[str, int] = {}
+            for name, spec in arg_specs.items():
+                if name in bound.arguments:
+                    spec.validate(
+                        bound.arguments[name],
+                        f"{fn.__qualname__}(..{name}..)", bindings,
+                    )
+            result = fn(*args, **kwargs)
+            if _result is not None:
+                _validate_result(fn.__qualname__, _result, result, bindings)
+            return result
+
+        wrapper.__contract__ = {"args": dict(arg_specs), "result": _result}
+        return wrapper
+
+    return decorate
+
+
+def _validate_result(qualname, result_spec, result, bindings):
+    if isinstance(result_spec, tuple):
+        if not isinstance(result, tuple) or len(result) != len(result_spec):
+            raise ContractError(
+                f"{qualname}: result contract wants a {len(result_spec)}-"
+                f"tuple, got {type(result).__name__}"
+            )
+        for i, (spec, value) in enumerate(zip(result_spec, result)):
+            spec.validate(value, f"{qualname} -> result[{i}]", bindings)
+    else:
+        result_spec.validate(result, f"{qualname} -> result", bindings)
+
+
+def check_abstract(fn, *args, **kwargs):
+    """Validate ``fn``'s contract — including the RESULT — without running
+    it: ``jax.eval_shape`` traces the function over ShapeDtypeStructs, so
+    a malformed program fails in microseconds instead of after a chunk's
+    worth of TPU time. Returns the abstract result."""
+    import jax
+
+    return jax.eval_shape(fn, *args, **kwargs)

@@ -105,7 +105,7 @@ def _append_image_layer(ng, txn, name, chunk, voxel_size):
         txn.layers.append(
             name=name,
             layer=ng.LocalVolume(
-                data=arr.transpose(),
+                data=arr.transpose(),  # zyx -> xyz
                 dimensions=dimensions,
                 voxel_offset=tuple(chunk.voxel_offset)[::-1],
             ),
@@ -120,7 +120,7 @@ def _append_image_layer(ng, txn, name, chunk, voxel_size):
         txn.layers.append(
             name=name,
             layer=ng.LocalVolume(
-                data=arr.transpose(),
+                data=arr.transpose(),  # czyx -> xyzc
                 dimensions=dimensions,
                 voxel_offset=(*tuple(chunk.voxel_offset)[::-1], 0),
             ),
@@ -146,7 +146,7 @@ def _append_segmentation_layer(ng, txn, name, chunk, voxel_size):
     txn.layers.append(
         name=name,
         layer=ng.LocalVolume(
-            data=arr.transpose(),
+            data=arr.transpose(),  # zyx -> xyz
             dimensions=dimensions,
             voxel_offset=tuple(chunk.voxel_offset)[::-1],
         ),
@@ -169,7 +169,7 @@ def _append_probability_map_layer(ng, txn, name, chunk, voxel_size,
     txn.layers.append(
         name=name,
         layer=ng.LocalVolume(
-            data=arr.transpose(),
+            data=arr.transpose(),  # czyx -> xyzc
             dimensions=dimensions,
             voxel_offset=(*tuple(chunk.voxel_offset)[::-1], 0),
         ),

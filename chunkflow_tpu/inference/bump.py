@@ -18,11 +18,17 @@ from typing import Tuple
 
 import numpy as np
 
+from chunkflow_tpu.core.contracts import Spec, contract
+
 
 @functools.lru_cache(maxsize=None)
+@contract(_result=Spec("z", "y", "x", dtype="float32"))
 def bump_map(patch_size: Tuple[int, int, int]) -> np.ndarray:
     """Raw bump weights, float32, conditioned to [1, 1e6]."""
-    coords = [np.linspace(-1.0, 1.0, s + 2)[1:-1] for s in patch_size]
+    # float64 on purpose: the raw bump underflows float32 long before the
+    # conditioning rescale (module docstring)
+    coords = [np.linspace(-1.0, 1.0, s + 2)[1:-1]  # graftlint: disable=GL004
+              for s in patch_size]
     zz, yy, xx = np.meshgrid(*coords, indexing="ij")
     with np.errstate(under="ignore"):
         bump = np.exp(
@@ -38,6 +44,7 @@ def bump_map(patch_size: Tuple[int, int, int]) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
+@contract(_result=Spec("z", "y", "x", dtype="float32"))
 def normalized_patch_mask(
     patch_size: Tuple[int, int, int], overlap: Tuple[int, int, int]
 ) -> np.ndarray:
@@ -52,10 +59,12 @@ def normalized_patch_mask(
     patch_size = tuple(patch_size)
     overlap = tuple(overlap)
     stride = tuple(p - o for p, o in zip(patch_size, overlap))
-    bump = bump_map(patch_size).astype(np.float64)
+    # float64 on purpose: 27 overlapping adds of ~1e6-range weights need
+    # the headroom before the final normalize
+    bump = bump_map(patch_size).astype(np.float64)  # graftlint: disable=GL004
     # accumulate 27 shifted copies around the center patch
     buf_shape = tuple(p + 2 * s for p, s in zip(patch_size, stride))
-    buf = np.zeros(buf_shape, dtype=np.float64)
+    buf = np.zeros(buf_shape, dtype=np.float64)  # graftlint: disable=GL004
     for dz in range(3):
         for dy in range(3):
             for dx in range(3):

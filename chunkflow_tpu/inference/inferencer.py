@@ -35,6 +35,7 @@ import numpy as np
 
 from chunkflow_tpu.chunk.base import Chunk, LayerType
 from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+from chunkflow_tpu.core.contracts import Spec, contract
 from chunkflow_tpu.inference import engines
 from chunkflow_tpu.inference.bump import bump_map
 from chunkflow_tpu.inference.patching import enumerate_patches, pad_to_batch
@@ -334,6 +335,7 @@ class Inferencer:
         fixed = 8 * (co + 1) * int(np.prod(padded))
         return n * per_patch + fixed <= budget
 
+    @contract(arr=Spec(None, "z", "y", "x", dtype="float32"))
     def _run_fold(self, arr):
         """Static-geometry scatter-free path (ops/fold_blend.py): pad to
         a uniform patch grid, run the cached per-shape fold program, crop
@@ -607,6 +609,7 @@ class Inferencer:
             arr.copy_to_host_async()
         return out
 
+    @contract(chunk=Spec(ndim=(3, 4)))
     def _infer(self, chunk: Chunk, block: bool) -> Chunk:
         import jax
         import jax.numpy as jnp

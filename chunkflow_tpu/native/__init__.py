@@ -276,7 +276,7 @@ def renumber(arr: np.ndarray, start_id: int = 1):
             f"{n} labels)"
         )
     mapping = dict(zip(keys[:n].tolist(), vals[:n].tolist()))
-    return out.reshape(arr.shape), mapping
+    return out.reshape(arr.shape), mapping  # flat -> original zyx
 
 
 def remap(arr: np.ndarray, mapping, preserve_missing: bool = True) -> np.ndarray:
@@ -302,7 +302,7 @@ def remap(arr: np.ndarray, mapping, preserve_missing: bool = True) -> np.ndarray
         keys.ctypes.data, vals.ctypes.data, keys.size,
         1 if preserve_missing else 0,
     )
-    return out.reshape(arr.shape)
+    return out.reshape(arr.shape)  # flat -> original zyx
 
 
 def available() -> bool:

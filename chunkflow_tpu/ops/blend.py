@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Callable, Tuple
 
+from chunkflow_tpu.core.contracts import Spec, contract
+
 
 def stack_budget_bytes() -> int:
     """Byte budget for patch stacks kept alive at once — a memory-fit
@@ -114,6 +116,12 @@ def build_local_blend(
         py_pad, px_pad = pallas_blend.padded_patch_shape(pout[1], pout[2])
         patch_bytes += (co + 1) * pout[0] * py_pad * px_pad * 4
 
+    @contract(
+        chunk=Spec(None, "z", "y", "x"),
+        in_starts=Spec("n", 3, dtype="int32"),
+        out_starts=Spec("n", 3, dtype="int32"),
+        valid=Spec("n", dtype="float32"),
+    )
     def local_blend(chunk, in_starts, out_starts, valid, params):
         zyx = chunk.shape[1:]
         zyx_buf = (zyx[0], zyx[1] + pad_y, zyx[2] + pad_x)
@@ -169,6 +177,10 @@ def build_local_blend(
     return local_blend
 
 
+@contract(
+    out=Spec("co", "z", "y", "x", dtype="float32"),
+    weight=Spec("z", "y", "x", dtype="float32"),
+)
 def normalize_blend(out, weight, dtype="float32"):
     """Reciprocal weight normalization; zero where nothing was predicted.
     ``dtype`` narrows the result inside the program (accumulation inputs

@@ -90,6 +90,7 @@ def mode_pool_device(arr, factor):
         arr.shape[2] // factor.y,
         arr.shape[3] // factor.x,
     )
+    # czyx -> block axes (c, z', fz, y', fy, x', fx)
     blocks = arr.reshape(c, zp, factor.z, yp, factor.y, xp, factor.x)
     # [n_corners, c, z', y', x'] in z-major corner order (dz, dy, dx)
     stacked = blocks.transpose(2, 4, 6, 0, 1, 3, 5).reshape(

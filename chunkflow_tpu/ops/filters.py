@@ -31,7 +31,7 @@ def _gaussian_filter_2d_device(chunk: Chunk, sigma: float) -> Chunk:
     radius = int(4.0 * sigma + 0.5)
     x = np.arange(-radius, radius + 1, dtype=np.float32)
     kernel = np.exp(-0.5 * (x / sigma) ** 2)
-    kernel /= kernel.sum()
+    kernel /= kernel.sum(dtype=np.float32)
     k = jnp.asarray(kernel)
 
     arr = jnp.asarray(chunk.array).astype(jnp.float32)

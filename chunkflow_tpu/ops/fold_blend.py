@@ -37,6 +37,8 @@ from typing import Tuple
 
 import numpy as np
 
+from chunkflow_tpu.core.contracts import Spec, contract
+
 Triple = Tuple[int, int, int]
 
 
@@ -68,6 +70,10 @@ def _class_counts(g: int, k: int) -> list:
     return [len(range(c, g, k)) for c in range(k)]
 
 
+@contract(
+    stack=Spec("n", "co", "pz", "py", "px", dtype="float32"),
+    _result=Spec("co", None, None, None),
+)
 def fold_accumulate(stack, grid: Triple, stride: Triple, pout: Triple,
                     offset: Triple, out_zyx: Triple):
     """Dense parity-class overlap-add.
@@ -80,7 +86,8 @@ def fold_accumulate(stack, grid: Triple, stride: Triple, pout: Triple,
 
     gz, gy, gx = grid
     n, co = stack.shape[0], stack.shape[1]
-    assert n == gz * gy * gx, (n, grid)
+    # grid/stride are static trace-time ints, not tracers
+    assert n == gz * gy * gx, (n, grid)  # graftlint: disable=GL003
     k = tuple(max(1, math.ceil(p / s)) for p, s in zip(pout, stride))
     tile = tuple(ki * si for ki, si in zip(k, stride))
     # headroom: a class's dense block may extend past the true output
@@ -180,8 +187,10 @@ def build_fold_program(
             )
         preds = lax.map(
             lambda xb: forward(params, xb),
+            # split patch axis n -> (nb, batch)
             patches.reshape((nb, batch_size, ci) + pin),
         )
+        # merge (nb, batch) -> flat patch axis, drop padding
         preds = preds.reshape((nb * batch_size, co) + pout)[:n]
         weighted = preds.astype(jnp.float32) * bump[None, None]
         out = fold_accumulate(weighted, grid, stride, pout, margin, zyx)

@@ -27,6 +27,8 @@ from __future__ import annotations
 import os
 from typing import Tuple
 
+from chunkflow_tpu.core.contracts import Spec, contract
+
 Triple = Tuple[int, int, int]
 
 
@@ -82,6 +84,13 @@ def buffer_padding(pout: Triple) -> Tuple[int, int]:
     return (py_pad - pout[1], px_pad - pout[2])
 
 
+@contract(
+    out=Spec("co", "z", "y", "x", dtype="float32"),
+    weight=Spec("z", "y", "x", dtype="float32"),
+    preds=Spec("b", "co", "pz", "py", "px", dtype="float32"),
+    wpatches=Spec("b", "pz", "py", "px", dtype="float32"),
+    out_starts=Spec("b", 3, dtype="int32"),
+)
 def accumulate_patches(out, weight, preds, wpatches, out_starts,
                        interpret: bool = False):
     """out[:, s:s+p] += preds[b]; weight[s:s+p] += wpatches[b] for every b.

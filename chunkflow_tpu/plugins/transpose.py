@@ -2,4 +2,4 @@
 
 
 def execute(chunk):
-    return chunk.transpose()
+    return chunk.transpose()  # reverse spatial axes: zyx -> xyz

@@ -210,7 +210,7 @@ class PrecomputedVolume:
             # normalize_blend's uint8 quantization.
             arr = np.clip(arr, 0.0, 1.0) * 255.0
         arr = arr.astype(self.dtype, copy=False)
-        arr_xyzc = np.transpose(arr, (3, 2, 1, 0))
+        arr_xyzc = np.transpose(arr, (3, 2, 1, 0))  # czyx -> xyzc
         sl_xyz = tuple(reversed(chunk.bbox.slices))
         future = store[sl_xyz + (slice(None),)].write(arr_xyzc)
         if wait:

@@ -1,8 +1,22 @@
 #!/bin/bash
-# Run the test suite on a clean 8-device virtual CPU mesh.
+# CI entry point: graftlint gate, then the test suite on a clean 8-device
+# virtual CPU mesh.
 # PALLAS_AXON_POOL_IPS must be unset: with it set, the TPU-tunnel site hook
 # intercepts every jax init, slowing CPU tests ~20x and wedging the
 # single-client tunnel if tests run concurrently with TPU work.
+set -u
+cd "$(dirname "$0")"
+
+# --- static analysis gate -------------------------------------------------
+# graftlint (tools/graftlint, docs/linting.md) fails only on findings NOT
+# grandfathered in tools/graftlint/baseline.json. Skip with
+# CHUNKFLOW_SKIP_LINT=1 (e.g. when iterating on a single test).
+if [ "${CHUNKFLOW_SKIP_LINT:-0}" != "1" ]; then
+    echo "== graftlint gate =="
+    python -m tools.graftlint || exit 1
+fi
+
+# --- tests ----------------------------------------------------------------
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/ "$@"

@@ -65,6 +65,48 @@ def test_blend_stacked_budget_fallback(monkeypatch, mode):
     np.testing.assert_allclose(got, ref, atol=1e-5)
 
 
+def test_pallas_matches_xla_blend_on_overlapping_patches(monkeypatch):
+    """Dense-overlap parity: the pallas DMA kernel (interpret mode) and
+    the ops/blend.py scatter-add path must agree on a fixture where every
+    patch overlaps several neighbours (stride = half patch per axis)."""
+    _, ref = _run_identity(monkeypatch, "0", (10, 40, 40))
+    _, got = _run_identity(monkeypatch, "interpret", (10, 40, 40))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_accumulate_patches_overlapping_windows_vs_numpy():
+    """Direct kernel check with overlapping windows: sequential-grid
+    accumulation order must reproduce numpy's += semantics exactly."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.ops import pallas_blend
+
+    rng = np.random.default_rng(7)
+    co, Z, Y, X = 3, 5, 32, 40
+    B, pz, py, px = 4, 3, 12, 16
+    pad_y, pad_x = pallas_blend.buffer_padding((pz, py, px))
+    out = np.zeros((co, Z, Y + pad_y, X + pad_x), np.float32)
+    weight = np.zeros((Z, Y + pad_y, X + pad_x), np.float32)
+    preds = rng.random((B, co, pz, py, px)).astype(np.float32)
+    wpatches = rng.random((B, pz, py, px)).astype(np.float32)
+    # stride ~ half patch: every window overlaps its neighbours in all axes
+    starts = np.array(
+        [[0, 0, 0], [1, 6, 8], [2, 12, 16], [1, 6, 8]], np.int32
+    )
+
+    got_out, got_w = pallas_blend.accumulate_patches(
+        jnp.asarray(out), jnp.asarray(weight), jnp.asarray(preds),
+        jnp.asarray(wpatches), jnp.asarray(starts), interpret=True,
+    )
+    exp_out, exp_w = out.copy(), weight.copy()
+    for b in range(B):
+        z, y, x = starts[b]
+        exp_out[:, z:z + pz, y:y + py, x:x + px] += preds[b]
+        exp_w[z:z + pz, y:y + py, x:x + px] += wpatches[b]
+    np.testing.assert_allclose(np.asarray(got_out), exp_out, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_w), exp_w, atol=1e-5)
+
+
 def test_accumulate_patches_unaligned_offsets_vs_numpy():
     """Direct kernel check: arbitrary (not 8/128-divisible) corners."""
     import jax.numpy as jnp

@@ -1,0 +1,150 @@
+"""Shape-contract decorator: validation semantics + zero-cost-under-jit."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.core.contracts import (
+    ContractError,
+    Spec,
+    check_abstract,
+    contract,
+)
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@contract(
+    out=Spec("co", "z", "y", "x", dtype="float32"),
+    weight=Spec("z", "y", "x", dtype="float32"),
+)
+def fake_normalize(out, weight):
+    return out / weight[None]
+
+
+def test_contract_accepts_matching_shapes():
+    out = np.ones((2, 4, 5, 6), np.float32)
+    weight = np.ones((4, 5, 6), np.float32)
+    assert fake_normalize(out, weight).shape == (2, 4, 5, 6)
+
+
+def test_contract_rejects_rank_mismatch():
+    with pytest.raises(ContractError, match="rank"):
+        fake_normalize(np.ones((4, 5, 6), np.float32),
+                       np.ones((4, 5, 6), np.float32))
+
+
+def test_contract_rejects_inconsistent_named_dims():
+    # weight's grid disagrees with out's: 'z' bound twice
+    with pytest.raises(ContractError, match="'z'"):
+        fake_normalize(np.ones((2, 4, 5, 6), np.float32),
+                       np.ones((9, 5, 6), np.float32))
+
+
+def test_contract_rejects_wrong_dtype():
+    with pytest.raises(ContractError, match="dtype"):
+        fake_normalize(np.ones((2, 4, 5, 6), np.float64),
+                       np.ones((4, 5, 6), np.float64))
+
+
+def test_contract_exact_extent_and_result():
+    @contract(starts=Spec("n", 3, dtype="int32"),
+              _result=(Spec("n",), Spec("n",)))
+    def split(starts):
+        return starts[:, 0], starts[:, 1]
+
+    a, b = split(np.zeros((7, 3), np.int32))
+    assert a.shape == (7,)
+    with pytest.raises(ContractError, match="extent 3"):
+        split(np.zeros((7, 2), np.int32))
+
+    @contract(starts=Spec("n", 3, dtype="int32"), _result=Spec("n",))
+    def bad_result(starts):
+        return starts  # wrong rank on purpose
+
+    with pytest.raises(ContractError, match="result"):
+        bad_result(np.zeros((7, 3), np.int32))
+
+
+def test_contract_ellipsis_and_ndim_tuple():
+    @contract(x=Spec(..., 3), y=Spec(ndim=(3, 4)))
+    def f(x, y):
+        return x
+
+    f(np.zeros((5, 3)), np.zeros((1, 2, 3)))
+    f(np.zeros((2, 9, 3)), np.zeros((1, 2, 3, 4)))
+    with pytest.raises(ContractError):
+        f(np.zeros((5, 4)), np.zeros((1, 2, 3)))
+    with pytest.raises(ContractError, match="ndim"):
+        f(np.zeros((5, 3)), np.zeros((2, 3)))
+
+
+def test_contract_checks_under_jit_at_trace_time():
+    calls = []
+
+    @contract(x=Spec("a", "a", dtype="float32"))
+    def square_only(x):
+        calls.append(1)
+        return x * 2
+
+    jitted = jax.jit(square_only)
+    jitted(jnp.ones((3, 3), jnp.float32))
+    with pytest.raises(ContractError, match="'a'"):
+        jitted(jnp.ones((3, 4), jnp.float32))  # non-square: new trace fails
+
+
+def test_check_abstract_validates_without_execution():
+    @contract(x=Spec("n", 3, dtype="int32"))
+    def f(x):
+        return x.sum(axis=1)
+
+    out = check_abstract(
+        f, jax.ShapeDtypeStruct((5, 3), jnp.int32)
+    )
+    assert out.shape == (5,)
+    with pytest.raises(ContractError):
+        check_abstract(f, jax.ShapeDtypeStruct((5, 2), jnp.int32))
+
+
+def test_contracts_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_CONTRACTS", "0")
+    # violations pass through when disabled
+    fake_normalize(np.ones((4, 5, 6), np.float32),
+                   np.ones((4, 5, 6), np.float32))
+
+
+def test_contract_unknown_param_fails_at_decoration():
+    with pytest.raises(TypeError, match="no such parameter"):
+        @contract(nope=Spec(ndim=1))
+        def f(x):
+            return x
+
+
+def test_contract_accepts_chunk_objects():
+    from chunkflow_tpu.chunk.base import Chunk
+
+    @contract(chunk=Spec(ndim=(3, 4)))
+    def f(chunk):
+        return chunk
+
+    f(Chunk(np.zeros((2, 3, 4), np.float32)))
+    with pytest.raises(ContractError):
+        f(np.zeros((2, 2)))
+
+
+def test_real_entry_point_contract_fires():
+    # pallas accumulate_patches declares int32 starts; float starts are the
+    # classic silent-cast bug this contract exists to catch
+    from chunkflow_tpu.ops.pallas_blend import (
+        accumulate_patches, buffer_padding,
+    )
+
+    co, Z, Y, X = 1, 2, 8, 16
+    pz, py, px = 1, 4, 8
+    pad_y, pad_x = buffer_padding((pz, py, px))
+    out = jnp.zeros((co, Z, Y + pad_y, X + pad_x), jnp.float32)
+    weight = jnp.zeros((Z, Y + pad_y, X + pad_x), jnp.float32)
+    preds = jnp.ones((1, co, pz, py, px), jnp.float32)
+    wpatches = jnp.ones((1, pz, py, px), jnp.float32)
+    with pytest.raises(ContractError, match="int32"):
+        accumulate_patches(out, weight, preds, wpatches,
+                           jnp.zeros((1, 3), jnp.float32), interpret=True)

@@ -1,0 +1,408 @@
+"""graftlint rule tests: one positive and one suppressed case per rule,
+plus the traced-context analysis and baseline machinery the rules rest on.
+"""
+import textwrap
+
+import pytest
+
+from tools.graftlint.config import Config
+from tools.graftlint.engine import lint_file
+
+
+def run(src, path="chunkflow_tpu/ops/example.py", config=None):
+    findings, suppressed = lint_file(
+        path, textwrap.dedent(src), config or Config()
+    )
+    return findings, suppressed
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- GL001
+GL001_POSITIVE = """\
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        y = np.asarray(x)
+        return y.item()
+"""
+
+
+def test_gl001_detects_host_sync_in_jit():
+    findings, _ = run(GL001_POSITIVE)
+    assert codes(findings).count("GL001") == 2  # np.asarray AND .item()
+    assert all(f.context == "f" for f in findings)
+
+
+def test_gl001_suppressed():
+    src = GL001_POSITIVE.replace(
+        "y = np.asarray(x)", "y = np.asarray(x)  # graftlint: disable=GL001"
+    ).replace(
+        "return y.item()", "return y.item()  # graftlint: disable=GL001"
+    )
+    findings, suppressed = run(src)
+    assert "GL001" not in codes(findings)
+    assert suppressed == 2
+
+
+def test_gl001_ignores_host_code():
+    # same calls OUTSIDE jit are legitimate chunk-boundary host syncs
+    findings, _ = run("""\
+        import numpy as np
+
+        def host(x):
+            return np.asarray(x).item()
+    """)
+    assert "GL001" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL002
+GL002_POSITIVE = """\
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x):
+        return np.exp(x) + np.sum(x)
+"""
+
+
+def test_gl002_detects_numpy_op_on_tracer():
+    findings, _ = run(GL002_POSITIVE)
+    assert codes(findings).count("GL002") == 2
+
+
+def test_gl002_suppressed():
+    src = GL002_POSITIVE.replace(
+        "return np.exp(x) + np.sum(x)",
+        "return np.exp(x) + np.sum(x)  # graftlint: disable=GL002",
+    )
+    findings, suppressed = run(src)
+    assert "GL002" not in codes(findings)
+    assert suppressed == 2
+
+
+def test_gl002_allows_static_numpy():
+    # dtype metadata and scalar constructors are trace-safe
+    findings, _ = run("""\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            scale = np.float32(1.0 / np.iinfo(np.uint8).max)
+            return x * scale
+    """)
+    assert "GL002" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL003
+GL003_POSITIVE = """\
+    import jax
+
+    @jax.jit
+    def f(x):
+        y = x + 1
+        if y > 0:
+            return y
+        return -y
+"""
+
+
+def test_gl003_detects_tracer_branch():
+    findings, _ = run(GL003_POSITIVE)
+    assert "GL003" in codes(findings)
+
+
+def test_gl003_suppressed():
+    src = GL003_POSITIVE.replace(
+        "if y > 0:", "if y > 0:  # graftlint: disable=GL003"
+    )
+    findings, _ = run(src)
+    assert "GL003" not in codes(findings)
+
+
+def test_gl003_allows_static_shape_branch():
+    findings, _ = run("""\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.ndim == 3:
+                x = x[None]
+            if x.shape[0] > 4:
+                return x[:4]
+            n = x.shape[1]
+            while n > 8:
+                n //= 2
+            return x
+    """)
+    assert "GL003" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL004
+GL004_POSITIVE = """\
+    import numpy as np
+
+    def make_weights(n):
+        acc = np.zeros((n, n))
+        return acc.mean()
+"""
+
+
+def test_gl004_detects_implicit_float64_in_scoped_path():
+    findings, _ = run(GL004_POSITIVE)
+    assert codes(findings).count("GL004") == 2  # zeros w/o dtype + bare mean
+
+
+def test_gl004_suppressed():
+    src = GL004_POSITIVE.replace(
+        "acc = np.zeros((n, n))",
+        "acc = np.zeros((n, n))  # graftlint: disable=GL004",
+    ).replace(
+        "return acc.mean()",
+        "return acc.mean()  # graftlint: disable=GL004",
+    )
+    findings, _ = run(src)
+    assert "GL004" not in codes(findings)
+
+
+def test_gl004_out_of_scope_path_not_checked():
+    findings, _ = run(GL004_POSITIVE, path="chunkflow_tpu/flow/example.py")
+    assert "GL004" not in codes(findings)
+
+
+def test_gl004_positional_dtype_accepted():
+    findings, _ = run("""\
+        import numpy as np
+
+        def f(shape):
+            return np.full(shape, 0.5, np.float32)
+    """)
+    assert "GL004" not in codes(findings)
+
+
+def test_gl004_file_wide_disable():
+    findings, suppressed = run(
+        "# metrics accumulate in float64  # graftlint: disable-file=GL004\n"
+        + textwrap.dedent(GL004_POSITIVE)
+    )
+    assert "GL004" not in codes(findings)
+    assert suppressed == 2
+
+
+# ---------------------------------------------------------------- GL005
+GL005_POSITIVE = """\
+    import jax
+
+    def build_program():
+        def program(chunk, params):
+            return chunk * 2
+        return jax.jit(program)
+"""
+
+
+def test_gl005_detects_missing_donation():
+    findings, _ = run(GL005_POSITIVE)
+    assert "GL005" in codes(findings)
+
+
+def test_gl005_suppressed():
+    src = GL005_POSITIVE.replace(
+        "return jax.jit(program)",
+        "return jax.jit(program)  # graftlint: disable=GL005",
+    )
+    findings, _ = run(src)
+    assert "GL005" not in codes(findings)
+
+
+def test_gl005_donation_satisfies():
+    findings, _ = run("""\
+        import jax
+
+        def build_program():
+            def program(chunk, params):
+                return chunk * 2
+        return_value = None
+
+        @jax.jit
+        def other(params):
+            return params
+    """)
+    assert "GL005" not in codes(findings)
+    findings, _ = run("""\
+        import jax
+
+        def build_program():
+            def program(chunk, params):
+                return chunk * 2
+            return jax.jit(program, donate_argnums=(0,))
+    """)
+    assert "GL005" not in codes(findings)
+
+
+def test_gl005_decorator_form():
+    findings, _ = run("""\
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(chunk, n):
+            return chunk * n
+    """)
+    assert "GL005" in codes(findings)
+
+
+# ---------------------------------------------------------------- GL006
+GL006_POSITIVE = """\
+    import numpy as np
+
+    def save(chunk):
+        arr = np.transpose(chunk, (3, 2, 1, 0))
+        return arr
+"""
+
+
+def test_gl006_detects_unannotated_shuffle():
+    findings, _ = run(GL006_POSITIVE)
+    assert "GL006" in codes(findings)
+
+
+def test_gl006_suppressed():
+    src = GL006_POSITIVE.replace(
+        "arr = np.transpose(chunk, (3, 2, 1, 0))",
+        "arr = np.transpose(chunk, (3, 2, 1, 0))  "
+        "# graftlint: disable=GL006",
+    )
+    findings, _ = run(src)
+    assert "GL006" not in codes(findings)
+
+
+def test_gl006_axis_comment_satisfies():
+    src = GL006_POSITIVE.replace(
+        "arr = np.transpose(chunk, (3, 2, 1, 0))",
+        "arr = np.transpose(chunk, (3, 2, 1, 0))  # czyx -> xyzc",
+    )
+    findings, _ = run(src)
+    assert "GL006" not in codes(findings)
+
+
+def test_gl006_named_helper_satisfies():
+    findings, _ = run("""\
+        def transpose_to_xyzc(chunk):
+            return chunk.transpose(3, 2, 1, 0)
+    """)
+    assert "GL006" not in codes(findings)
+
+
+# ------------------------------------------------- traced-context engine
+def test_traced_via_lax_scan_callback():
+    findings, _ = run("""\
+        import numpy as np
+        from jax import lax
+
+        def step(carry, x):
+            return carry, np.exp(x)
+
+        def outer(xs):
+            return lax.scan(step, None, xs)
+    """)
+    assert "GL002" in codes(findings)
+
+
+def test_traced_via_build_closure_and_callee_propagation():
+    # helper() is traced because the build_* closure calls it
+    findings, _ = run("""\
+        import numpy as np
+
+        def build_blend():
+            def helper(x):
+                return np.square(x)
+
+            def blend(chunk):
+                return helper(chunk)
+            return blend
+    """)
+    assert "GL002" in codes(findings)
+
+
+def test_syntax_error_reports_gl000():
+    findings, _ = run("def broken(:\n    pass\n")
+    assert codes(findings) == ["GL000"]
+
+
+def test_select_limits_rules():
+    findings, _ = run(GL001_POSITIVE, config=Config(select=["GL003"]))
+    assert "GL001" not in codes(findings)
+    with pytest.raises(ValueError):
+        run(GL001_POSITIVE, config=Config(select=["GL999"]))
+
+
+# ------------------------------------------------------------- baseline
+def test_baseline_roundtrip_and_diff(tmp_path):
+    from tools.graftlint.baseline import (
+        diff_baseline, load_baseline, write_baseline,
+    )
+
+    findings, _ = run(GL001_POSITIVE)
+    assert len(findings) == 2
+    path = tmp_path / "baseline.json"
+    write_baseline(path, findings[:1])
+    baseline = load_baseline(path)
+
+    new, grandfathered, stale = diff_baseline(findings, baseline)
+    assert grandfathered == 1 and len(new) == 1 and stale == 0
+
+    # all fixed -> the baselined entry goes stale, nothing new
+    new, grandfathered, stale = diff_baseline([], baseline)
+    assert new == [] and grandfathered == 0 and stale == 1
+
+
+def test_baseline_key_survives_line_shift():
+    findings_a, _ = run(GL001_POSITIVE)
+    findings_b, _ = run("# a new leading comment\n"
+                        + textwrap.dedent(GL001_POSITIVE))
+    assert [f.baseline_key for f in findings_a] == \
+        [f.baseline_key for f in findings_b]
+    assert [f.line for f in findings_a] != [f.line for f in findings_b]
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    from tools.graftlint.baseline import load_baseline
+
+    assert load_baseline(tmp_path / "nope.json") == {}
+
+
+# ------------------------------------------------------------------ CLI
+def test_cli_end_to_end(tmp_path, monkeypatch, capsys):
+    from tools.graftlint.cli import main
+
+    pkg = tmp_path / "chunkflow_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(textwrap.dedent(GL001_POSITIVE))
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.graftlint]\ninclude = ["chunkflow_tpu"]\n'
+        'baseline = "baseline.json"\n'
+    )
+    monkeypatch.chdir(tmp_path)
+
+    assert main([]) == 1  # new findings, no baseline yet
+    assert main(["--write-baseline"]) == 0
+    assert main([]) == 0  # grandfathered now
+    out = capsys.readouterr().out
+    assert "0 new findings" in out and "2 grandfathered" in out
+
+    assert main(["--json", "--no-baseline"]) == 1
+    import json
+
+    payload = json.loads(capsys.readouterr().out)
+    assert len(payload["new"]) == 2
+    assert {f["code"] for f in payload["new"]} == {"GL001"}
+
+    assert main(["--explain", "GL003"]) == 0
+    assert "tracer" in capsys.readouterr().out.lower()

@@ -1,0 +1,35 @@
+"""The CI gate itself, as a tier-1 test: graftlint over chunkflow_tpu/
+must be clean against the checked-in baseline. A failure here means a NEW
+GL violation entered the codebase — fix it or (deliberately) regenerate
+the baseline with `python -m tools.graftlint --write-baseline`.
+"""
+from pathlib import Path
+
+from tools.graftlint.baseline import diff_baseline, load_baseline
+from tools.graftlint.config import load_config
+from tools.graftlint.engine import lint_paths
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_graftlint_clean_against_baseline():
+    config = load_config(REPO_ROOT / "pyproject.toml")
+    findings, _ = lint_paths(config.include, config, repo_root=REPO_ROOT)
+    baseline = load_baseline(REPO_ROOT / config.baseline)
+    new, _, _ = diff_baseline(findings, baseline)
+    assert not new, (
+        "new graftlint findings (see docs/linting.md):\n"
+        + "\n".join(f"{f.path}:{f.line}: {f.code} {f.message}" for f in new)
+    )
+
+
+def test_baseline_has_no_stale_entries():
+    # keep the grandfather list honest: fixed findings must leave the file
+    config = load_config(REPO_ROOT / "pyproject.toml")
+    findings, _ = lint_paths(config.include, config, repo_root=REPO_ROOT)
+    baseline = load_baseline(REPO_ROOT / config.baseline)
+    _, _, stale = diff_baseline(findings, baseline)
+    assert stale == 0, (
+        f"{stale} baseline entries no longer match any finding; run "
+        f"`python -m tools.graftlint --write-baseline` to shrink the file"
+    )

@@ -1,0 +1,33 @@
+"""graftlint: a JAX/TPU correctness linter purpose-built for chunkflow-tpu.
+
+Chunkflow's throughput rests on invariants the compiler cannot see: jitted
+hot paths must stay free of host syncs, numpy ops must not touch traced
+values, Python control flow must not branch on tracers, accumulators must
+stay float32, big chunk buffers should be donated, and every axis shuffle
+on a zyx chunk needs its order spelled out. graftlint checks those
+statically, with a per-rule baseline so CI only fails on NEW violations.
+
+Rules
+-----
+GL001  host-sync call inside a jit-traced function
+GL002  numpy op applied inside a jit-traced function (np/jnp mixing)
+GL003  Python control flow on a tracer-derived value (recompile/leak)
+GL004  implicit float64 literal or dtype promotion in ops/ and inference/
+GL005  chunk-sized array passed to jax.jit without donate_argnums
+GL006  axis shuffle on a chunk array without an axis-order comment/helper
+
+Usage
+-----
+    python -m tools.graftlint chunkflow_tpu/            # human output
+    python -m tools.graftlint --json chunkflow_tpu/     # machine output
+    python -m tools.graftlint --write-baseline          # grandfather all
+    python -m tools.graftlint --explain GL003           # rule docs
+
+Suppress a single line with ``# graftlint: disable=GL001`` (comma-separate
+several codes; bare ``disable`` silences every rule on that line) or a
+whole file with ``# graftlint: disable-file=GL004``.
+"""
+from tools.graftlint.model import Finding  # noqa: F401
+from tools.graftlint.engine import lint_file, lint_paths  # noqa: F401
+
+__version__ = "0.1.0"

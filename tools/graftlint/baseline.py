@@ -1,0 +1,67 @@
+"""Baseline file: grandfathered findings the CI gate tolerates.
+
+The baseline is a checked-in JSON multiset of finding keys
+(path::code::function::line-text — line-number independent, so unrelated
+edits don't resurface old findings). The gate fails only on findings whose
+key count EXCEEDS the baselined count; fixing a grandfathered finding
+just leaves a stale entry, reported as a note so the file gets re-shrunk
+with ``--write-baseline``.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import List, Tuple
+
+from tools.graftlint.model import Finding
+
+FORMAT_VERSION = 1
+
+
+def load_baseline(path: Path) -> Counter:
+    if not path.exists():
+        return Counter()
+    data = json.loads(path.read_text())
+    if data.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"baseline {path} has format version {data.get('version')!r}, "
+            f"expected {FORMAT_VERSION}; regenerate with --write-baseline"
+        )
+    return Counter(data.get("findings", {}))
+
+
+def write_baseline(path: Path, findings: List[Finding]) -> None:
+    counts = Counter(f.baseline_key for f in findings)
+    data = {
+        "version": FORMAT_VERSION,
+        "comment": (
+            "grandfathered graftlint findings; regenerate with "
+            "`python -m tools.graftlint --write-baseline` after fixing "
+            "or deliberately adding entries"
+        ),
+        "findings": {k: counts[k] for k in sorted(counts)},
+    }
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(data, indent=2, sort_keys=False) + "\n")
+
+
+def diff_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], int, int]:
+    """(new_findings, grandfathered_count, stale_entry_count).
+
+    Findings are matched to baseline slots per key, oldest-line first, so
+    the surplus (new) ones are deterministic.
+    """
+    budget = Counter(baseline)
+    new: List[Finding] = []
+    grandfathered = 0
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        if budget[f.baseline_key] > 0:
+            budget[f.baseline_key] -= 1
+            grandfathered += 1
+        else:
+            new.append(f)
+    stale = sum(budget.values())
+    return new, grandfathered, stale

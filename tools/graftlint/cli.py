@@ -1,0 +1,120 @@
+"""graftlint command line: human/JSON output, baseline gate, --explain.
+
+Exit codes: 0 clean (all findings grandfathered), 1 new findings (or a
+parse failure), 2 usage/config error.
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import json
+import sys
+from pathlib import Path
+from typing import List
+
+from tools.graftlint.baseline import (
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tools.graftlint.config import load_config
+from tools.graftlint.engine import lint_paths
+from tools.graftlint.model import Finding
+from tools.graftlint.rules import RULES_BY_CODE
+
+
+def _print_human(new: List[Finding], grandfathered: int, stale: int,
+                 suppressed: int, gate: bool) -> None:
+    for f in new:
+        print(f"{f.path}:{f.line}:{f.col}: {f.code} [{f.context}] "
+              f"{f.message}")
+        if f.text:
+            print(f"    {f.text}")
+    bits = [f"{len(new)} new finding{'s' if len(new) != 1 else ''}"]
+    if gate:
+        bits.append(f"{grandfathered} grandfathered")
+        if stale:
+            bits.append(
+                f"{stale} stale baseline entr"
+                f"{'ies' if stale != 1 else 'y'} (run --write-baseline)"
+            )
+    if suppressed:
+        bits.append(f"{suppressed} suppressed inline")
+    print("graftlint: " + ", ".join(bits))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX/TPU correctness linter for chunkflow-tpu "
+                    "(rules GL001..GL006; see docs/linting.md)",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: config include)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="machine-readable output")
+    parser.add_argument("--select", metavar="GL001,GL002",
+                        help="comma-separated rule codes to run")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="baseline file (default from [tool.graftlint])")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="grandfather all current findings and exit 0")
+    parser.add_argument("--config", metavar="PYPROJECT",
+                        help="pyproject.toml to read [tool.graftlint] from")
+    parser.add_argument("--explain", metavar="GLXXX",
+                        help="print a rule's documentation and exit")
+    args = parser.parse_args(argv)
+
+    if args.explain:
+        rule = RULES_BY_CODE.get(args.explain.upper())
+        if rule is None:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{', '.join(sorted(RULES_BY_CODE))}", file=sys.stderr)
+            return 2
+        print(f"{rule.code} ({rule.name})\n")
+        print(inspect.cleandoc(rule.__doc__ or "(no documentation)"))
+        return 0
+
+    try:
+        config = load_config(Path(args.config) if args.config else None)
+        if args.select:
+            config.select = [c.strip().upper()
+                             for c in args.select.split(",") if c.strip()]
+        if args.baseline:
+            config.baseline = args.baseline
+        roots = args.paths or config.include
+        findings, suppressed = lint_paths(roots, config)
+    except (ValueError, OSError) as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = Path(config.baseline)
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"graftlint: wrote {len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''} to {baseline_path}")
+        return 0
+
+    gate = not args.no_baseline
+    if gate:
+        try:
+            baseline = load_baseline(baseline_path)
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        new, grandfathered, stale = diff_baseline(findings, baseline)
+    else:
+        new, grandfathered, stale = findings, 0, 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.as_dict() for f in new],
+            "grandfathered": grandfathered,
+            "stale_baseline_entries": stale,
+            "suppressed": suppressed,
+        }, indent=2))
+    else:
+        _print_human(new, grandfathered, stale, suppressed, gate)
+    return 1 if new else 0

@@ -1,0 +1,70 @@
+"""[tool.graftlint] configuration from pyproject.toml.
+
+Recognized keys (all optional)::
+
+    [tool.graftlint]
+    include = ["chunkflow_tpu"]            # default lint roots
+    exclude = ["chunkflow_tpu/native/*"]   # fnmatch globs, repo-relative
+    select = ["GL001", "GL002", ...]       # enabled rules (default: all)
+    baseline = "tools/graftlint/baseline.json"
+    float64_paths = ["chunkflow_tpu/ops", "chunkflow_tpu/inference"]
+
+CLI flags override file config. Python 3.10 has no tomllib, so parsing
+uses the already-vendored ``tomli`` when present and degrades to defaults
+(with a warning) when neither is importable — graftlint must never be the
+thing that breaks CI bootstrap.
+"""
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+from fnmatch import fnmatch
+from pathlib import Path
+from typing import List, Optional
+
+
+@dataclass
+class Config:
+    include: List[str] = field(default_factory=lambda: ["chunkflow_tpu"])
+    exclude: List[str] = field(default_factory=list)
+    select: Optional[List[str]] = None  # None -> all rules
+    baseline: str = "tools/graftlint/baseline.json"
+    float64_paths: List[str] = field(
+        default_factory=lambda: [
+            "chunkflow_tpu/ops", "chunkflow_tpu/inference",
+        ]
+    )
+
+    def is_excluded(self, relpath: str) -> bool:
+        return any(fnmatch(relpath, pat) for pat in self.exclude)
+
+
+def _load_toml(path: Path) -> dict:
+    try:
+        import tomllib  # Python >= 3.11
+    except ModuleNotFoundError:
+        try:
+            import tomli as tomllib  # type: ignore[no-redef]
+        except ModuleNotFoundError:
+            print(
+                f"graftlint: no tomllib/tomli available; ignoring {path} "
+                f"and using built-in defaults",
+                file=sys.stderr,
+            )
+            return {}
+    with open(path, "rb") as f:
+        return tomllib.load(f)
+
+
+def load_config(pyproject: Optional[Path] = None) -> Config:
+    cfg = Config()
+    path = pyproject if pyproject is not None else Path("pyproject.toml")
+    if not path.exists():
+        return cfg
+    section = _load_toml(path).get("tool", {}).get("graftlint", {})
+    for key in ("include", "exclude", "select", "float64_paths"):
+        if key in section:
+            setattr(cfg, key, list(section[key]))
+    if "baseline" in section:
+        cfg.baseline = str(section["baseline"])
+    return cfg

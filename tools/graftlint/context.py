@@ -1,0 +1,317 @@
+"""Per-file static analysis context: imports, scopes, traced functions, taint.
+
+The heart of graftlint is knowing which functions execute UNDER A JAX TRACE
+— that is where a host sync or a numpy op silently wrecks the compiled
+program. A function is considered traced when any of these hold:
+
+1. it is decorated with ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``;
+2. it is passed to ``jax.jit(...)`` / a traced-callback wrapper
+   (``lax.scan``, ``lax.map``, ``lax.cond``, ``jax.vmap``, ``shard_map``,
+   ...) anywhere in the module;
+3. it is returned by a ``build_*`` program-builder function (this repo's
+   idiom: ``build_fold_program`` et al. return a closure that the caller
+   jits or embeds in a jitted program);
+4. it is defined inside, or called by name from, a traced function
+   (propagated to a fixpoint over the module-local call graph).
+
+This is module-local and name-based on purpose: cross-module dataflow is
+out of scope for a purpose-built linter, and the baseline absorbs the
+residual blind spots.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+from tools.graftlint.model import extract_comments, parse_suppressions
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+#: callables whose function argument runs under trace
+JIT_CALLABLES = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+}
+TRACED_WRAPPERS = {
+    "jax.lax.scan",
+    "jax.lax.map",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.associative_scan",
+    "jax.lax.custom_root",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.experimental.shard_map.shard_map",
+}
+
+#: attribute reads that are static under trace (no tracer value involved)
+STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "aval", "weak_type",
+    "itemsize",
+}
+
+
+def attach_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+class ImportMap:
+    """Alias -> dotted module path, collected over the WHOLE file.
+
+    This codebase imports jax inside functions (deferred imports keep CLI
+    startup fast), so alias collection ignores scope; a per-file alias
+    colliding across scopes with different targets would be its own smell.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.aliases[alias.asname] = alias.name
+                    else:
+                        # ``import jax.numpy`` binds the TOP name
+                        top = alias.name.split(".")[0]
+                        self.aliases.setdefault(top, top)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:
+                    continue  # relative imports: not stdlib/jax/numpy
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    self.aliases[bound] = f"{node.module}.{alias.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path of a Name/Attribute chain, e.g. ``np.asarray`` ->
+        ``numpy.asarray``; None when the root is not an import alias."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+def enclosing_function(node: ast.AST) -> Optional[FuncNode]:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, FUNC_TYPES):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def func_name(node: FuncNode) -> str:
+    return node.name if not isinstance(node, ast.Lambda) else "<lambda>"
+
+
+def walk_local(func: FuncNode) -> Iterator[ast.AST]:
+    """Every node in ``func``'s own body, NOT descending into nested
+    functions (each traced function is analyzed exactly once)."""
+    body = func.body if not isinstance(func, ast.Lambda) else [func.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FUNC_TYPES):
+                continue
+            stack.append(child)
+
+
+class FileContext:
+    """Everything the rules need to know about one source file."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        attach_parents(self.tree)
+        self.comments = extract_comments(source)
+        self.suppressions = parse_suppressions(self.comments)
+        self.imports = ImportMap(self.tree)
+        self.functions: List[FuncNode] = [
+            n for n in ast.walk(self.tree) if isinstance(n, FUNC_TYPES)
+        ]
+        # (enclosing scope node, name) -> def node; module scope key None
+        self._defs: Dict[Tuple[Optional[ast.AST], str], FuncNode] = {}
+        for fn in self.functions:
+            if not isinstance(fn, ast.Lambda):
+                self._defs[(enclosing_function(fn), fn.name)] = fn
+        self.traced: Set[FuncNode] = set()
+        self._compute_traced()
+
+    # -- traced-function analysis ------------------------------------
+    def resolve_local(
+        self, name: str, from_node: ast.AST
+    ) -> Optional[FuncNode]:
+        """A function def visible from ``from_node`` via lexical scoping."""
+        scope: Optional[ast.AST] = enclosing_function(from_node)
+        while True:
+            hit = self._defs.get((scope, name))
+            if hit is not None:
+                return hit
+            if scope is None:
+                return None
+            scope = enclosing_function(scope)
+
+    def _callee_func(self, arg: ast.AST, site: ast.AST) -> Optional[FuncNode]:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return self.resolve_local(arg.id, site)
+        return None
+
+    def is_jit_ref(self, node: ast.AST) -> bool:
+        return self.imports.resolve(node) in JIT_CALLABLES
+
+    def jit_decorator_info(self, dec: ast.AST) -> Optional[ast.AST]:
+        """The decorator expression if ``dec`` applies jit (plain ref,
+        ``jax.jit(...)`` factory, or ``partial(jax.jit, ...)``), else
+        None. The returned node is where GL005 inspects kwargs."""
+        if self.is_jit_ref(dec):
+            return dec
+        if isinstance(dec, ast.Call):
+            if self.is_jit_ref(dec.func):
+                return dec
+            if self.imports.resolve(dec.func) == "functools.partial" and \
+                    dec.args and self.is_jit_ref(dec.args[0]):
+                return dec
+        return None
+
+    def _compute_traced(self) -> None:
+        seeds: Set[FuncNode] = set()
+        for fn in self.functions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            if any(self.jit_decorator_info(d) for d in fn.decorator_list):
+                seeds.add(fn)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self.imports.resolve(node.func)
+            if target in JIT_CALLABLES or target in TRACED_WRAPPERS:
+                for arg in node.args:
+                    callee = self._callee_func(arg, node)
+                    if callee is not None:
+                        seeds.add(callee)
+        # build_* builders: the closure they return ends up jitted (or
+        # embedded in a jitted program) by the caller
+        for fn in self.functions:
+            if isinstance(fn, ast.Lambda) or not fn.name.startswith("build_"):
+                continue
+            for node in walk_local(fn):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    callee = self._callee_func(node.value, node)
+                    if callee is not None:
+                        seeds.add(callee)
+
+        # fixpoint: nested defs of traced funcs + module-local callees
+        worklist = list(seeds)
+        traced = set(seeds)
+        children: Dict[FuncNode, List[FuncNode]] = {}
+        for fn in self.functions:
+            parent = enclosing_function(fn)
+            if parent is not None:
+                children.setdefault(parent, []).append(fn)
+        while worklist:
+            fn = worklist.pop()
+            for nested in children.get(fn, ()):  # defined under trace
+                if nested not in traced:
+                    traced.add(nested)
+                    worklist.append(nested)
+            for node in walk_local(fn):  # called under trace
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    callee = self.resolve_local(node.func.id, node)
+                    if callee is not None and callee not in traced:
+                        traced.add(callee)
+                        worklist.append(callee)
+        self.traced = traced
+
+    # -- taint: does an expression carry a tracer value? ---------------
+    @staticmethod
+    def _is_static_use(name_node: ast.Name) -> bool:
+        """x.shape / x.ndim / len(x) / isinstance(x, T) / ``x is None``
+        read only static trace-time facts, never a tracer value."""
+        parent = getattr(name_node, "parent", None)
+        if isinstance(parent, ast.Attribute) and parent.attr in STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call) and isinstance(parent.func, ast.Name)\
+                and parent.func.id in ("len", "isinstance", "type", "id") \
+                and name_node in parent.args:
+            return True
+        if isinstance(parent, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops
+        ):
+            return True
+        return False
+
+    def expr_is_tainted(self, expr: ast.AST, tainted: Set[str]) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in tainted \
+                    and not self._is_static_use(node):
+                return True
+        return False
+
+    def tainted_names(self, func: FuncNode) -> Set[str]:
+        """Names carrying tracer values inside a traced function: the
+        parameters, plus anything assigned from a tainted expression
+        (propagated to a fixpoint; static-fact reads don't propagate)."""
+        args = func.args
+        tainted: Set[str] = {
+            a.arg
+            for a in (
+                args.posonlyargs + args.args + args.kwonlyargs
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            )
+        }
+        changed = True
+        while changed:
+            changed = False
+            for node in walk_local(func):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                if value is None or not self.expr_is_tainted(value, tainted):
+                    continue
+                for target in targets:
+                    for leaf in ast.walk(target):
+                        if isinstance(leaf, ast.Name) and \
+                                leaf.id not in tainted:
+                            tainted.add(leaf.id)
+                            changed = True
+        return tainted
+
+    # -- reporting helpers --------------------------------------------
+    def qualname_at(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        fn = node if isinstance(node, FUNC_TYPES) else None
+        if fn is None:
+            fn = enclosing_function(node)
+        while fn is not None:
+            parts.append(func_name(fn))
+            fn = enclosing_function(fn)
+        return ".".join(reversed(parts)) if parts else "<module>"

@@ -1,0 +1,85 @@
+"""File/tree runners: parse, run rules, apply suppressions."""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Tuple
+
+from tools.graftlint.config import Config
+from tools.graftlint.context import FileContext
+from tools.graftlint.model import Finding
+from tools.graftlint.rules import RULES, RULES_BY_CODE
+
+
+def _selected_rules(config: Config):
+    if config.select is None:
+        return RULES
+    unknown = [c for c in config.select if c not in RULES_BY_CODE]
+    if unknown:
+        raise ValueError(
+            f"unknown rule code(s) {unknown}; known: "
+            f"{sorted(RULES_BY_CODE)}"
+        )
+    return [RULES_BY_CODE[c] for c in config.select]
+
+
+def lint_file(
+    path: str, source: str, config: Optional[Config] = None
+) -> Tuple[List[Finding], int]:
+    """(findings, suppressed_count) for one file's source text.
+
+    ``path`` should be repo-relative posix (it becomes the Finding path
+    and feeds baseline keys + GL004 path scoping). Syntax errors surface
+    as a single GL000 finding rather than crashing the whole run.
+    """
+    config = config or Config()
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path=path, line=e.lineno or 1, col=e.offset or 0,
+                code="GL000", message=f"file does not parse: {e.msg}",
+                context="<module>", text=(e.text or "").strip(),
+            )
+        ], 0
+    findings: List[Finding] = []
+    suppressed = 0
+    for rule in _selected_rules(config):
+        for f in rule.run(ctx, config):
+            if ctx.suppressions.is_suppressed(f.line, f.code):
+                suppressed += 1
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings, suppressed
+
+
+def iter_python_files(
+    roots: Iterable[str], config: Config, repo_root: Path
+) -> Iterable[Path]:
+    for root in roots:
+        p = (repo_root / root).resolve()
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in candidates:
+            rel = f.relative_to(repo_root).as_posix()
+            if not config.is_excluded(rel):
+                yield f
+
+
+def lint_paths(
+    roots: Iterable[str],
+    config: Optional[Config] = None,
+    repo_root: Optional[Path] = None,
+) -> Tuple[List[Finding], int]:
+    """Lint every .py under the given roots; (findings, suppressed)."""
+    config = config or Config()
+    repo_root = (repo_root or Path.cwd()).resolve()
+    all_findings: List[Finding] = []
+    suppressed = 0
+    for f in iter_python_files(roots, config, repo_root):
+        rel = f.relative_to(repo_root).as_posix()
+        found, sup = lint_file(rel, f.read_text(), config)
+        all_findings.extend(found)
+        suppressed += sup
+    all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return all_findings, suppressed

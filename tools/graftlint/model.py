@@ -1,0 +1,126 @@
+"""Finding/suppression primitives shared by the engine, rules and CLI."""
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+#: ``# graftlint: disable=GL001,GL002`` / ``# graftlint: disable`` /
+#: ``# graftlint: disable-file=GL004``
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*(?:=\s*([A-Z0-9,\s]+))?"
+)
+
+#: sentinel meaning "every rule code"
+ALL_CODES = "*"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation at a specific source location."""
+
+    path: str       # repo-relative posix path
+    line: int       # 1-based
+    col: int        # 0-based
+    code: str       # "GL001"
+    message: str
+    context: str    # qualname of the enclosing function, or "<module>"
+    text: str       # stripped source line (for baseline matching + display)
+
+    @property
+    def baseline_key(self) -> str:
+        """Line-number-independent identity: survives unrelated edits that
+        shift the file, so grandfathered findings don't resurface when a
+        docstring above them grows."""
+        return f"{self.path}::{self.code}::{self.context}::{self.text}"
+
+    def as_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "code": self.code,
+            "message": self.message,
+            "context": self.context,
+            "text": self.text,
+        }
+
+
+@dataclass
+class Suppressions:
+    """Per-line and per-file rule suppressions parsed from comments."""
+
+    by_line: Dict[int, Set[str]] = field(default_factory=dict)
+    file_wide: Set[str] = field(default_factory=set)
+
+    def is_suppressed(self, line: int, code: str) -> bool:
+        for scope in (self.file_wide, self.by_line.get(line, ())):
+            if code in scope or ALL_CODES in scope:
+                return True
+        return False
+
+
+def extract_comments(source: str) -> Dict[int, str]:
+    """{lineno: comment text} via tokenize — immune to '#' inside strings."""
+    comments: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the AST parse reports the real error; comments best-effort
+    return comments
+
+
+def parse_suppressions(comments: Dict[int, str]) -> Suppressions:
+    sup = Suppressions()
+    for lineno, text in comments.items():
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, codes_raw = m.group(1), m.group(2)
+        codes = (
+            {c.strip() for c in codes_raw.split(",") if c.strip()}
+            if codes_raw
+            else {ALL_CODES}
+        )
+        if kind == "disable-file":
+            sup.file_wide |= codes
+        else:
+            sup.by_line.setdefault(lineno, set()).update(codes)
+    return sup
+
+
+def comment_matches(
+    comments: Dict[int, str], line: int, pattern: re.Pattern,
+    lines_back: int = 1,
+) -> bool:
+    """True if the comment on ``line`` or up to ``lines_back`` lines above
+    matches ``pattern`` (GL006's axis-order annotation check)."""
+    for ln in range(line, line - lines_back - 1, -1):
+        text = comments.get(ln)
+        if text is not None and pattern.search(text):
+            return True
+    return False
+
+
+def make_finding(
+    ctx, node, code: str, message: str, context: Optional[str] = None
+) -> Finding:
+    """Build a Finding anchored at ``node`` within file context ``ctx``."""
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    text = ""
+    if 1 <= line <= len(ctx.lines):
+        text = ctx.lines[line - 1].strip()
+    return Finding(
+        path=ctx.path,
+        line=line,
+        col=col,
+        code=code,
+        message=message,
+        context=context if context is not None else ctx.qualname_at(node),
+        text=text,
+    )

@@ -1,0 +1,385 @@
+"""The graftlint rule registry: GL001..GL006.
+
+Each rule is a class with ``code``, ``name`` and ``run(ctx, config)``
+yielding Findings. Register new rules by appending to ``RULES`` (see
+docs/linting.md for the recipe); codes must be unique and stable — the
+baseline file and suppression comments key on them.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List
+
+from tools.graftlint.context import FileContext, func_name, walk_local
+from tools.graftlint.model import Finding, comment_matches, make_finding
+
+#: parameter names that (heuristically) hold chunk-scale arrays
+CHUNK_PARAM_NAMES = {
+    "chunk", "chunks", "arr", "array", "vol", "volume", "img", "image",
+    "out", "weight", "buf", "buffer", "stack", "patches",
+}
+
+#: receiver roots GL006 treats as chunk arrays (superset of the above)
+CHUNK_VALUE_NAMES = CHUNK_PARAM_NAMES | {
+    "patch", "preds", "pred", "tiles", "dense", "sub", "result", "chunk_arr",
+    "weighted", "wstack", "slab",
+}
+
+_AXIS_COMMENT_RE = re.compile(r"(?i)\b(zyx|xyz|[bc]?[zyx]{3}|axis|axes|order)\b")
+_AXIS_HELPER_RE = re.compile(
+    r"(transpose|reorder|reshape|fold|place|axes|axis|to_[zyx]{3}|layout)"
+)
+
+
+class Rule:
+    code = "GL000"
+    name = "abstract"
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class HostSyncInJit(Rule):
+    """Host-synchronizing call inside a jit-traced function.
+
+    ``.item()``, ``.tolist()``, ``np.asarray``/``np.array``,
+    ``jax.device_get`` and ``(jax.)block_until_ready`` force the tracer to
+    materialize a concrete value: under ``jax.jit`` that is either a
+    ConcretizationTypeError or — worse — a silent device->host round trip
+    per call that serializes the TPU pipeline. Keep host syncs at chunk
+    boundaries, outside the compiled program.
+    """
+
+    code = "GL001"
+    name = "host-sync-in-jit"
+
+    SYNC_METHODS = {"item", "tolist", "block_until_ready",
+                    "copy_to_host_async"}
+    SYNC_FUNCS = {"numpy.asarray", "numpy.array", "jax.device_get",
+                  "jax.block_until_ready"}
+
+    def run(self, ctx, config):
+        for fn in ctx.traced:
+            for node in walk_local(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.imports.resolve(node.func)
+                if resolved in self.SYNC_FUNCS:
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"host sync `{resolved}` inside jit-traced "
+                        f"`{func_name(fn)}` — forces a device->host round "
+                        f"trip; hoist it out of the compiled program",
+                    )
+                elif isinstance(node.func, ast.Attribute) and resolved is \
+                        None and node.func.attr in self.SYNC_METHODS:
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"host sync `.{node.func.attr}()` inside jit-traced "
+                        f"`{func_name(fn)}` — keep host syncs at chunk "
+                        f"boundaries, outside jit",
+                    )
+
+
+class NumpyOnTracer(Rule):
+    """numpy op inside a jit-traced function (np/jnp namespace mixing).
+
+    ``np.*`` array ops applied to traced values either crash
+    (ConcretizationTypeError) or silently fall back to host execution,
+    breaking the fused XLA program. Inside traced code use ``jnp.*`` /
+    ``jax.lax``; numpy belongs to host-side geometry (patch grids, bump
+    tables) computed before the program is staged.
+    """
+
+    code = "GL002"
+    name = "numpy-on-tracer"
+
+    #: numpy attributes that are trace-safe: dtype metadata, scalar type
+    #: constructors, and static shape arithmetic on Python ints
+    SAFE = {
+        "dtype", "iinfo", "finfo", "errstate", "promote_types",
+        "result_type", "can_cast", "isscalar", "ndim", "prod",
+        "issubdtype", "broadcast_shapes", "index_exp", "s_", "newaxis",
+        "pi", "e", "inf", "nan",
+        "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+        "uint8", "uint16", "uint32", "uint64", "bool_", "intp",
+    }
+
+    def run(self, ctx, config):
+        for fn in ctx.traced:
+            for node in walk_local(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.imports.resolve(node.func)
+                if resolved is None or not resolved.startswith("numpy."):
+                    continue
+                attr = resolved.split(".")[1]
+                if attr in self.SAFE or resolved in HostSyncInJit.SYNC_FUNCS:
+                    continue  # GL001 owns asarray/array
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"numpy op `{resolved}` inside jit-traced "
+                    f"`{func_name(fn)}` — use jnp/lax so the op stays in "
+                    f"the compiled program",
+                )
+
+
+class TracerControlFlow(Rule):
+    """Python control flow on a tracer-derived value.
+
+    ``if``/``while``/``bool()``/``assert`` on a traced value concretizes
+    the tracer: at best a ConcretizationTypeError, at worst a silent
+    per-value recompilation every time the branch flips. Use ``lax.cond``
+    / ``lax.while_loop`` / ``jnp.where``, or branch on static facts
+    (``x.shape``, ``x.ndim``, ``len(...)``) which this rule ignores.
+    """
+
+    code = "GL003"
+    name = "tracer-control-flow"
+
+    def run(self, ctx, config):
+        for fn in ctx.traced:
+            tainted = ctx.tainted_names(fn)
+            for node in walk_local(fn):
+                test = None
+                kind = None
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == "bool" and node.args:
+                    test, kind = node.args[0], "bool()"
+                if test is not None and ctx.expr_is_tainted(test, tainted):
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"Python `{kind}` on a tracer-derived value inside "
+                        f"jit-traced `{func_name(fn)}` — recompilation/"
+                        f"concretization hazard; use lax.cond/jnp.where or "
+                        f"branch on static shape facts",
+                    )
+
+
+class ImplicitFloat64(Rule):
+    """Implicit float64 literal or dtype promotion in blending-critical code.
+
+    numpy defaults to float64: a dtype-less ``np.zeros``/``np.linspace``,
+    a ``.mean()``/``.sum()`` accumulator without ``dtype=``, or an
+    explicit ``np.float64`` doubles memory traffic and silently promotes
+    downstream math. Blending accumulators in ``ops/`` and ``inference/``
+    must be explicit float32 (scoped via ``float64_paths`` in
+    ``[tool.graftlint]``). Deliberate float64 (e.g. the host-side bump
+    table) gets an inline ``# graftlint: disable=GL004``.
+    """
+
+    code = "GL004"
+    name = "implicit-float64"
+
+    #: constructor -> positional index at which dtype may be passed
+    #: (None: keyword-only in practice)
+    CONSTRUCTORS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2,
+                    "identity": 1, "linspace": None, "arange": None,
+                    "eye": None}
+    ACCUMULATORS = {"mean", "sum", "cumsum", "var", "std"}
+    F64_REFS = {"numpy.float64", "numpy.double", "jax.numpy.float64"}
+
+    def _in_scope(self, ctx, config) -> bool:
+        return any(ctx.path.startswith(p) for p in config.float64_paths)
+
+    def run(self, ctx, config):
+        if not self._in_scope(ctx, config):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                resolved = ctx.imports.resolve(node)
+                parent = getattr(node, "parent", None)
+                # report the ref itself once (not again as parent pieces)
+                if resolved in self.F64_REFS and not (
+                    isinstance(parent, ast.Attribute)
+                    and ctx.imports.resolve(parent) in self.F64_REFS
+                ):
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"explicit float64 (`{resolved}`) — blending "
+                        f"accumulators are float32; if this float64 is "
+                        f"deliberate, add `# graftlint: disable=GL004`",
+                    )
+
+    def _has_dtype_kwarg(self, call: ast.Call) -> bool:
+        return any(kw.arg == "dtype" for kw in call.keywords)
+
+    def _check_call(self, ctx, node: ast.Call):
+        resolved = ctx.imports.resolve(node.func)
+        if resolved and resolved.startswith("numpy."):
+            attr = resolved.split(".")[1]
+            dtype_pos = self.CONSTRUCTORS.get(attr)
+            has_positional_dtype = (
+                dtype_pos is not None and len(node.args) > dtype_pos
+            )
+            if attr in self.CONSTRUCTORS and not has_positional_dtype \
+                    and not self._has_dtype_kwarg(node):
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"`{resolved}` without dtype= defaults to float64 "
+                    f"(or int64) — pass dtype=np.float32/int32 explicitly",
+                )
+        elif isinstance(node.func, ast.Attribute) and resolved is None:
+            attr = node.func.attr
+            if attr in self.ACCUMULATORS and not self._has_dtype_kwarg(node):
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"`.{attr}()` accumulator without dtype= — promotes "
+                    f"integer inputs to float64; pass dtype=np.float32",
+                )
+            elif attr == "astype" and node.args:
+                arg = node.args[0]
+                target = ctx.imports.resolve(arg)
+                if target in self.F64_REFS or (
+                    isinstance(arg, ast.Name) and arg.id == "float"
+                ) or (
+                    isinstance(arg, ast.Constant)
+                    and arg.value in ("float64", "double")
+                ):
+                    yield make_finding(
+                        ctx, node, self.code,
+                        "`.astype(float64)` — blending data stays float32",
+                    )
+
+
+class JitWithoutDonation(Rule):
+    """Chunk-sized array passed to jax.jit without donate_argnums.
+
+    A jitted program whose parameters include a chunk-scale buffer
+    (``chunk``, ``arr``, ``out``, ``weight``, ...) copies that buffer on
+    every call unless it is donated; at production chunk sizes that is
+    hundreds of MB of HBM traffic per task. Either donate
+    (``donate_argnums``/``donate_argnames``) or suppress with a comment
+    explaining why the caller still needs the buffer.
+    """
+
+    code = "GL005"
+    name = "jit-without-donation"
+
+    DONATE_KWARGS = {"donate_argnums", "donate_argnames"}
+
+    def _chunk_params(self, fn) -> List[str]:
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        return [n for n in names if n in CHUNK_PARAM_NAMES]
+
+    def _has_donation(self, call_like) -> bool:
+        if not isinstance(call_like, ast.Call):
+            return False  # bare @jax.jit: no kwargs at all
+        return any(
+            kw.arg in self.DONATE_KWARGS for kw in call_like.keywords
+        )
+
+    def run(self, ctx, config):
+        seen = set()
+        for fn in ctx.functions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            for dec in fn.decorator_list:
+                info = ctx.jit_decorator_info(dec)
+                if info is None or self._has_donation(info):
+                    continue
+                chunky = self._chunk_params(fn)
+                if chunky:
+                    seen.add(id(fn))
+                    yield make_finding(
+                        ctx, dec, self.code,
+                        f"`@jit` on `{fn.name}` takes chunk-sized "
+                        f"`{chunky[0]}` but no donate_argnums — the buffer "
+                        f"is copied every call", context=ctx.qualname_at(fn),
+                    )
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and ctx.is_jit_ref(node.func)
+                    and node.args):
+                continue
+            callee = ctx._callee_func(node.args[0], node)
+            if callee is None or isinstance(callee, ast.Lambda) or \
+                    id(callee) in seen:
+                continue
+            if self._has_donation(node):
+                continue
+            chunky = self._chunk_params(callee)
+            if chunky:
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"`jax.jit({func_name(callee)})` takes chunk-sized "
+                    f"`{chunky[0]}` but no donate_argnums — the buffer is "
+                    f"copied every call",
+                )
+
+
+class AxisOrderHazard(Rule):
+    """Axis shuffle on a chunk array without an axis-order annotation.
+
+    Chunkflow is zyx everywhere (channel-leading czyx on device); a bare
+    ``transpose``/``swapaxes``/``moveaxis``/``reshape`` on a chunk array
+    is where xyz/zyx bugs are born. Annotate the line (or the one above)
+    with a comment naming the order (``# czyx -> cxyz``, ``# axis 0=z``),
+    or do the shuffle inside a helper whose NAME declares it
+    (``transpose_*``, ``fold_*``, ``place``...).
+    """
+
+    code = "GL006"
+    name = "axis-order-hazard"
+
+    SHUFFLES = {"transpose", "swapaxes", "moveaxis", "reshape"}
+
+    @staticmethod
+    def _root_name(node: ast.AST):
+        while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def run(self, ctx, config):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = None
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in self.SHUFFLES:
+                resolved = ctx.imports.resolve(node.func)
+                if resolved is None:  # method on an array value
+                    target = node.func.value
+                elif resolved.split(".")[-1] in self.SHUFFLES and (
+                    resolved.startswith("numpy.")
+                    or resolved.startswith("jax.numpy.")
+                ):
+                    target = node.args[0] if node.args else None
+            if target is None:
+                continue
+            root = self._root_name(target)
+            if root not in CHUNK_VALUE_NAMES:
+                continue
+            if comment_matches(ctx.comments, node.lineno, _AXIS_COMMENT_RE):
+                continue
+            qual = ctx.qualname_at(node)
+            if _AXIS_HELPER_RE.search(qual.split(".")[-1]):
+                continue
+            yield make_finding(
+                ctx, node, self.code,
+                f"`{node.func.attr}` on chunk array `{root}` without an "
+                f"axis-order comment — annotate the zyx/xyz order on this "
+                f"line or move it into a named axis helper",
+            )
+
+
+RULES: List[Rule] = [
+    HostSyncInJit(),
+    NumpyOnTracer(),
+    TracerControlFlow(),
+    ImplicitFloat64(),
+    JitWithoutDonation(),
+    AxisOrderHazard(),
+]
+
+RULES_BY_CODE = {r.code: r for r in RULES}
