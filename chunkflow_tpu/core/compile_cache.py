@@ -1,0 +1,153 @@
+"""Compile-cache layer: persistent XLA artifacts + in-process programs.
+
+Two tiers, attacking two different retrace costs:
+
+1. **Persistent compilation cache** (:func:`enable_persistent_cache`):
+   points ``jax.config`` at an on-disk cache directory so a process
+   restart (or the driver's bench invocation after tools/tpu_validation.py
+   warmed the cache) skips the multi-minute UNet compile. Directory comes
+   from ``CHUNKFLOW_JAX_CACHE`` (``0``/``off`` disables); default
+   ``~/.cache/chunkflow_tpu/jax_cache``. Entries below
+   ``min_compile_time_secs`` are not persisted, so CPU test-suite
+   micro-programs never churn the disk.
+
+2. **In-process keyed program cache** (:class:`ProgramCache`): one bounded
+   FIFO map from geometry key -> built (jit-wrapped) program, shared by
+   every program family the :class:`~chunkflow_tpu.inference.inferencer.
+   Inferencer` builds (scatter, fold, patch-sharded, spatial, spatial2d).
+   The key is derived from the *bucketed* run shape (``shape_bucket``), so
+   ragged edge chunks that pad into the same bucket hit the same entry and
+   never retrace. ``builds``/``hits`` counters make trace counts a
+   testable invariant (tests/inference/test_compile_cache.py).
+
+Donation note: programs cached here donate their chunk buffer
+(``donate_argnums=(0,)``, GL005) — see docs/performance.md for the
+buffer-lifetime contract. When XLA cannot alias the donated input to the
+output (e.g. 1 input channel, 3 affinity output channels) it emits a
+"donated buffers were not usable" warning on every compile; that is the
+expected, harmless half of the donation bargain, so it is silenced
+process-wide on import of this module.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Callable, Hashable, Optional
+
+# Donation is best-effort by design: a chunk buffer that cannot alias the
+# program's output is simply dropped, and the warning would otherwise fire
+# once per compiled geometry (ops/fold_blend.py, parallel/*, inferencer).
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
+_LOCK = threading.Lock()
+_PERSISTENT_DIR: Optional[str] = None
+
+
+def default_cache_dir() -> str:
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "chunkflow_tpu", "jax_cache"
+    )
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Enable jax's on-disk compilation cache; returns the directory in
+    effect, or None when disabled/unavailable.
+
+    Idempotent and never raises: the cache is an optimization, not a
+    dependency. Precedence: explicit ``cache_dir`` argument, then
+    ``CHUNKFLOW_JAX_CACHE`` (``0``/``off``/``false`` disables), then
+    :func:`default_cache_dir`.
+    """
+    global _PERSISTENT_DIR
+    env = os.environ.get("CHUNKFLOW_JAX_CACHE", "")
+    if cache_dir is None:
+        if env.lower() in ("0", "off", "false"):
+            return None
+        cache_dir = env or default_cache_dir()
+    with _LOCK:
+        if _PERSISTENT_DIR == cache_dir:
+            return _PERSISTENT_DIR
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            # persist everything that took real compile time; tiny CPU
+            # test programs stay in-memory only
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", -1
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+            _PERSISTENT_DIR = cache_dir
+        except Exception as e:
+            import sys
+
+            print(f"compilation cache unavailable: {e}", file=sys.stderr)
+            return None
+    return _PERSISTENT_DIR
+
+
+class ProgramCache:
+    """Bounded FIFO cache of built programs keyed on trace geometry.
+
+    Each entry's closure pins its engine (and params) alive, so the cache
+    is bounded: past ``maxsize`` the oldest entry is dropped (same policy
+    as parallel/distributed._PROGRAM_CACHE). ``builds`` counts builder
+    invocations — i.e. traces of new program geometry — and ``hits``
+    counts reuses, so tests can assert "two same-bucket chunks, one
+    trace" as an invariant instead of a benchmark.
+    """
+
+    def __init__(self, maxsize: int = 16):
+        if maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+        self.maxsize = maxsize
+        self.builds = 0
+        self.hits = 0
+        self._entries: dict = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._entries
+
+    def items(self):
+        """Snapshot of (key, program) pairs (debugging, tests)."""
+        with self._lock:
+            return list(self._entries.items())
+
+    def peek(self, key: Hashable, default=None):
+        """The cached program for ``key`` without building or counting."""
+        return self._entries.get(key, default)
+
+    def get(self, key: Hashable, build: Callable[[], object]):
+        """Return the cached program for ``key``, building (and counting a
+        trace) on first sight. Eviction is FIFO by insertion order."""
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                return self._entries[key]
+        # build outside the lock: builders jit-trace, which can re-enter
+        # (a fold program build may consult the same Inferencer)
+        program = build()
+        with self._lock:
+            if key not in self._entries:
+                self.builds += 1
+                self._entries[key] = program
+                while len(self._entries) > self.maxsize:
+                    self._entries.pop(next(iter(self._entries)))
+            else:
+                # lost a race: keep the first-published program so every
+                # caller shares one compiled executable
+                self.hits += 1
+            return self._entries[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

@@ -1662,59 +1662,20 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
 
         return stage(_name=op_name)
 
-    # pipelined: hold up to async_depth dispatched tasks in flight so
-    # task i+1's fused program runs while task i's result rides D2H
-    # (Inferencer.stream's trick, threaded through the task dicts)
-    def pipelined_stage(stream):
-        import collections
-        import time
+    # pipelined: the double-buffered executor (flow/pipeline.py) threads
+    # the task dicts through a staging ring + async dispatch so task i+1
+    # stages H2D while task i computes and task i-1's result rides D2H
+    from chunkflow_tpu.flow.pipeline import pipelined_inference_stage
 
-        pending = collections.deque()  # (task, device_out, t_dispatch)
-
-        def finalize(entry):
-            task, out, t0 = entry
-            out = out.host()  # crop already applied on device
-            task[output_chunk_name] = out
-            # dispatch-to-materialize wall time; overlapping tasks share
-            # wall clock, so these timers sum to more than elapsed time
-            task["log"]["timer"][op_name] = time.time() - t0
-            task["log"]["compute_device"] = inferencer.compute_device
-            return task
-
-        try:
-            for task in stream:
-                if task is None:
-                    # preserve order: flush in-flight work before passing
-                    # the skip marker downstream
-                    while pending:
-                        yield finalize(pending.popleft())
-                    yield task
-                    continue
-                chunk = task[input_chunk_name]
-                check_grid(chunk)
-                # drain BEFORE dispatching so at most async_depth tasks
-                # are ever device-resident (the documented memory bound)
-                while len(pending) >= async_depth:
-                    yield finalize(pending.popleft())
-                t0 = time.time()
-                pending.append((
-                    task,
-                    inferencer.infer_async(chunk, crop=explicit_crop),
-                    t0,
-                ))
-        except Exception:
-            # a mid-stream failure (bad grid, upstream error) must not
-            # drop already-dispatched tasks the synchronous path would
-            # have saved; push what completed downstream, then re-raise.
-            # (except, not finally: a yield in finally would break
-            # generator close(), which raises GeneratorExit here.)
-            while pending:
-                yield finalize(pending.popleft())
-            raise
-        while pending:
-            yield finalize(pending.popleft())
-
-    return pipelined_stage
+    return pipelined_inference_stage(
+        inferencer,
+        depth=async_depth,
+        input_name=input_chunk_name,
+        output_name=output_chunk_name,
+        op_name=op_name,
+        crop=explicit_crop,
+        check=check_grid,
+    )
 
 
 @main.command("crop-margin")

@@ -158,12 +158,9 @@ def prefetch_stage(depth: int = 2, to_device: bool = False) -> Callable:
     import queue
     import threading
 
-    def _stage_chunks(task):
-        for key, value in list(task.items()):
-            if hasattr(value, "device") and hasattr(value, "is_on_device"):
-                if not value.is_on_device:
-                    task[key] = value.device()
-        return task
+    # one definition of "stage a task's chunks H2D", shared with the
+    # double-buffered inference executor (flow/pipeline.py)
+    from chunkflow_tpu.flow.pipeline import stage_task_chunks as _stage_chunks
 
     def stage(stream: Iterator[Optional[dict]]):
         q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))

@@ -35,6 +35,10 @@ import numpy as np
 
 from chunkflow_tpu.chunk.base import Chunk, LayerType
 from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+from chunkflow_tpu.core.compile_cache import (
+    ProgramCache,
+    enable_persistent_cache,
+)
 from chunkflow_tpu.core.contracts import Spec, contract
 from chunkflow_tpu.inference import engines
 from chunkflow_tpu.inference.bump import bump_map
@@ -141,11 +145,15 @@ class Inferencer:
             )
         self.blend_mode = blend
         self._mesh = None
-        self._sharded_program = None
-        self._spatial_programs = {}
-        self._spatial2d_programs = {}
-        self._fold_programs = {}
         self._mesh2d = None
+        # one keyed cache for every program family this inferencer builds
+        # (scatter/fold/patch/spatial/spatial2d); keys derive from the
+        # BUCKETED run shape, so ragged edge chunks that pad into the
+        # same bucket share one compiled program and never retrace
+        self._programs = ProgramCache()
+        # persistent on-disk XLA cache: a worker restart skips the
+        # multi-minute UNet compile (CHUNKFLOW_JAX_CACHE=0 disables)
+        enable_persistent_cache()
         if bump != "wu":
             raise ValueError(f"only the 'wu' bump is implemented, got {bump!r}")
         if augment and (
@@ -168,8 +176,22 @@ class Inferencer:
             dtype=dtype,
             model_variant=model_variant,
         )
-        self._program = None
         self._device_params = None
+
+    # ------------------------------------------------------------------
+    @property
+    def _program(self):
+        """The compiled single-device scatter program, if built (tests)."""
+        return self._programs.peek(("scatter",))
+
+    @property
+    def _fold_programs(self) -> dict:
+        """padded-shape -> program view of the fold family (tests)."""
+        return {
+            key[1]: prog
+            for key, prog in self._programs.items()
+            if key[0] == "fold"
+        }
 
     # ------------------------------------------------------------------
     def _bucketed_shape(self, zyx) -> Cartesian:
@@ -292,7 +314,10 @@ class Inferencer:
             out, weight = local_blend(chunk, in_starts, out_starts, valid, params)
             return normalize_blend(out, weight, out_dtype)
 
-        return jax.jit(program)
+        # the chunk buffer is dead after the call (GL005): XLA may alias
+        # it into the blend accumulator/output instead of allocating per
+        # chunk — _infer guarantees the buffer is program-owned
+        return jax.jit(program, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     def _fold_geometry(self, zyx):
@@ -360,8 +385,9 @@ class Inferencer:
             # reference's edge-snapped patch starts,
             # inferencer.py:404-455); padded voxels are cropped below
             arr = jnp.pad(arr, pad, mode="edge")
-        if padded not in self._fold_programs:
-            self._fold_programs[padded] = build_fold_program(
+        program = self._programs.get(
+            ("fold", padded),
+            lambda: build_fold_program(
                 self._forward,
                 self.num_input_channels,
                 self.num_output_channels,
@@ -372,8 +398,9 @@ class Inferencer:
                 bump_map(pout),
                 padded,
                 out_dtype=self.output_dtype,
-            )
-        result = self._fold_programs[padded](arr, self._device_params)
+            ),
+        )
+        result = program(arr, self._device_params)
         return result[:, : zyx[0], : zyx[1], : zyx[2]]
 
     # ------------------------------------------------------------------
@@ -404,8 +431,9 @@ class Inferencer:
                 build_sharded_program,
             )
 
-            if self._sharded_program is None:
-                self._sharded_program = build_sharded_program(
+            sharded_program = self._programs.get(
+                ("patch",),
+                lambda: build_sharded_program(
                     self._forward,
                     self.num_input_channels,
                     self.num_output_channels,
@@ -415,7 +443,8 @@ class Inferencer:
                     mesh,
                     bump_map(tuple(self.output_patch_size)),
                     out_dtype=self.output_dtype,
-                )
+                ),
+            )
             in_starts, out_starts, valid = pad_to_batch(
                 grid, self.batch_size * n_dev
             )
@@ -429,11 +458,11 @@ class Inferencer:
                 from chunkflow_tpu.parallel.multihost import run_global
 
                 out = run_global(
-                    self._sharded_program, np.asarray(arr), in_starts,
+                    sharded_program, np.asarray(arr), in_starts,
                     out_starts, valid, self.engine.params, mesh,
                 )
                 return jnp.asarray(out)
-            return self._sharded_program(
+            return sharded_program(
                 arr,
                 jnp.asarray(in_starts),
                 jnp.asarray(out_starts),
@@ -460,12 +489,12 @@ class Inferencer:
             (yslab, hl_y, _, _, padded_y), (xslab, hl_x, _, _, padded_x) = (
                 geometry
             )
-            key = (yslab, xslab)
-            if key not in self._spatial2d_programs:
-                # routed through self._forward so TTA applies like every
-                # other sharding mode; cached per slab geometry so
-                # same-shaped chunks reuse one compiled program
-                self._spatial2d_programs[key] = build_spatial2d_program(
+            # routed through self._forward so TTA applies like every
+            # other sharding mode; cached per slab geometry so
+            # same-shaped chunks reuse one compiled program
+            program = self._programs.get(
+                ("spatial2d", yslab, xslab),
+                lambda: build_spatial2d_program(
                     self._forward,
                     self.num_input_channels,
                     self.num_output_channels,
@@ -476,12 +505,13 @@ class Inferencer:
                     bump_map(pout2),
                     geometry,
                     out_dtype=self.output_dtype,
-                )
+                ),
+            )
             dev_in, dev_out, dev_valid = partition_patches_2d(
                 grid, mesh2d, yslab, xslab, self.batch_size, hl_y, hl_x
             )
             padded = pad_chunk_yx(arr, padded_y, padded_x)
-            result = self._spatial2d_programs[key](
+            result = program(
                 padded,
                 jnp.asarray(dev_in),
                 jnp.asarray(dev_out),
@@ -503,8 +533,9 @@ class Inferencer:
         slab, halo_left, halo_right, spill, padded_y = spatial_geometry(
             y, n_dev, pin, pout
         )
-        if slab not in self._spatial_programs:
-            self._spatial_programs[slab] = build_spatial_program(
+        program = self._programs.get(
+            ("spatial", slab),
+            lambda: build_spatial_program(
                 self._forward,
                 self.num_input_channels,
                 self.num_output_channels,
@@ -518,12 +549,13 @@ class Inferencer:
                 halo_right,
                 spill,
                 out_dtype=self.output_dtype,
-            )
+            ),
+        )
         dev_in, dev_out, dev_valid = partition_patches(
             grid, n_dev, slab, self.batch_size, halo_left
         )
         arr = pad_chunk_y(arr, padded_y)
-        result = self._spatial_programs[slab](
+        result = program(
             arr,
             jnp.asarray(dev_in),
             jnp.asarray(dev_out),
@@ -536,17 +568,17 @@ class Inferencer:
     def __call__(self, chunk: Chunk) -> Chunk:
         return self._infer(chunk, block=True)
 
-    def stream(self, chunks, postprocess=None, post_depth: int = 2):
-        """Pipelined inference over an iterable of chunks (2-deep).
+    def stream(self, chunks, postprocess=None, post_depth: int = 2,
+               ring: int = 2):
+        """Pipelined inference over an iterable of chunks.
 
-        The reference's production loop is strictly sequential per task —
-        load, forward, blend, save, repeat (SURVEY §3.2). On TPU the
-        dispatch model is asynchronous, so this generator keeps the chip
-        busy across chunk boundaries: chunk i+1's fused program is
-        enqueued while chunk i's result rides the device→host DMA
-        (``copy_to_host_async``), hiding transfer latency behind compute.
-        Yields host-resident output chunks in input order. Same-shape
-        chunks reuse one compiled program.
+        Thin wrapper over the double-buffered executor
+        (:func:`chunkflow_tpu.flow.pipeline.pipeline_chunks`): while chunk
+        *k* computes on device, chunk *k+1* is staged host→device into a
+        ``ring``-slot staging ring and chunk *k−1*'s output drains
+        device→host asynchronously. Yields host-resident output chunks in
+        input order. Same-shape (or same-bucket) chunks reuse one
+        compiled program.
 
         ``postprocess`` (optional callable ``Chunk -> T``) runs the host
         post-processing stage — e.g. watershed agglomeration, the stage
@@ -554,54 +586,40 @@ class Inferencer:
         (plugins/agglomerate.py:35-43) — in a background thread while the
         NEXT chunk's program executes on device, so host work hides
         behind chip time instead of serializing after it (VERDICT r4 #3).
-        The native kernels release the GIL for the duration of the C
-        call, so one worker thread overlaps fully. Yields
-        ``postprocess(chunk)`` results in input order, at most
-        ``post_depth`` tasks in flight. Abandoning the generator early
-        cancels queued (not-yet-started) postprocess tasks; the one
-        already running completes (a C call cannot be interrupted).
+        At most ``post_depth`` tasks in flight; abandoning the generator
+        early cancels queued (not-yet-started) postprocess tasks.
         """
-        if postprocess is None:
-            pending = None
-            for chunk in chunks:
-                out = self.infer_async(chunk)
-                if pending is not None:
-                    yield pending.host()
-                pending = out
-            if pending is not None:
-                yield pending.host()
-            return
+        from chunkflow_tpu.flow.pipeline import pipeline_chunks
 
-        from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
+        return pipeline_chunks(
+            self, chunks, ring=ring, postprocess=postprocess,
+            post_depth=post_depth,
+        )
 
-        with ThreadPoolExecutor(max_workers=1) as pool:
-            in_flight: deque = deque()
-            try:
-                for chunk in chunks:
-                    out = self.infer_async(chunk)  # dispatch device first
-                    while len(in_flight) >= post_depth:
-                        yield in_flight.popleft().result()
-                    # .host() inside the worker: the block-until-ready
-                    # wait ALSO moves off the dispatch thread
-                    in_flight.append(
-                        pool.submit(lambda c=out: postprocess(c.host()))
-                    )
-                while in_flight:
-                    yield in_flight.popleft().result()
-            finally:
-                # early close / error: don't run (or silently swallow)
-                # abandoned host stages during executor shutdown
-                for f in in_flight:
-                    f.cancel()
+    def stage(self, chunk: Chunk) -> Chunk:
+        """Start the chunk's async H2D transfer; returns a device-backed
+        chunk whose payload buffer is OWNED BY THE PIPELINE — hand it to
+        ``infer_async(..., consume=True)`` and drop the reference (the
+        program donates and invalidates it). ``jax.device_put`` is async,
+        so staging chunk k+1 overlaps chunk k's compute; narrow int
+        dtypes ride the wire narrow (float conversion happens on device
+        at infer time)."""
+        if chunk.is_on_device:
+            return chunk
+        return chunk.device()
 
-    def infer_async(self, chunk: Chunk, crop=None) -> Chunk:
+    def infer_async(self, chunk: Chunk, crop=None, consume: bool = False
+                    ) -> Chunk:
         """Dispatch the fused program and start the result's D2H copy
         without blocking; materialize later with ``.host()``. Building
-        block for pipelined drivers (``stream``, CLI --async-depth).
-        ``crop`` applies an explicit margin crop ON DEVICE before the
-        copy starts, so discarded margin voxels never ride D2H."""
-        out = self._infer(chunk, block=False)
+        block for pipelined drivers (``stream``, flow/pipeline.py, CLI
+        --async-depth). ``crop`` applies an explicit margin crop ON
+        DEVICE before the copy starts, so discarded margin voxels never
+        ride D2H. ``consume`` transfers ownership of a device-resident
+        input buffer to the program (donation: the caller's array is
+        dead after the call) — only pass it for buffers you staged
+        yourself and will not touch again."""
+        out = self._infer(chunk, block=False, consume=consume)
         if crop is not None:
             out = out.crop_margin(crop)
         arr = out.array
@@ -610,7 +628,7 @@ class Inferencer:
         return out
 
     @contract(chunk=Spec(ndim=(3, 4)))
-    def _infer(self, chunk: Chunk, block: bool) -> Chunk:
+    def _infer(self, chunk: Chunk, block: bool, consume: bool = False) -> Chunk:
         import jax
         import jax.numpy as jnp
 
@@ -690,6 +708,14 @@ class Inferencer:
                 arr = jnp.asarray(np.asarray(arr, dtype=np.float32)) * scale
         else:
             arr = jnp.asarray(arr, dtype=jnp.float32)
+        if arr is chunk.array and not consume:
+            # every inference program donates its chunk argument (GL005):
+            # the buffer is dead after the call. A device-resident float32
+            # chunk passes through jnp.asarray unchanged, so donating it
+            # would invalidate the CALLER's array mid-flight — copy unless
+            # the caller declared ownership transfer (consume=True, the
+            # pipelined executor's staged ring slots).
+            arr = arr.copy()
         if arr.ndim == 3:
             arr = arr[None]
         if run_zyx != orig_zyx:
@@ -707,9 +733,8 @@ class Inferencer:
             result = self._run_fold(arr)
         elif self.sharding == "none":
             in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
-            if self._program is None:
-                self._program = self._build_program()
-            result = self._program(
+            program = self._programs.get(("scatter",), self._build_program)
+            result = program(
                 arr,
                 jnp.asarray(in_starts),
                 jnp.asarray(out_starts),

@@ -198,4 +198,7 @@ def build_fold_program(
         weight = fold_accumulate(wstack, grid, stride, pout, margin, zyx)[0]
         return normalize_blend(out, weight, out_dtype)
 
-    return jax.jit(program)
+    # the chunk buffer is dead after the call (GL005): XLA may reuse it
+    # for the accumulation/output instead of allocating per chunk —
+    # callers must hand over a buffer they own (docs/performance.md)
+    return jax.jit(program, donate_argnums=(0,))

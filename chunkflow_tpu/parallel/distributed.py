@@ -14,6 +14,7 @@ scales the single-task hot loop across the chips of one slice.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import numpy as np
@@ -80,7 +81,9 @@ def build_sharded_program(
         check_rep=False,
     )
 
-    @jax.jit
+    # chunk is donated (GL005): dead after the call, may be aliased into
+    # the psum-merged output buffer — callers hand over a buffer they own
+    @partial(jax.jit, donate_argnums=(0,))
     def program(chunk, in_starts, out_starts, valid, params):
         out, weight = sharded(chunk, in_starts, out_starts, valid, params)
         return normalize_blend(out, weight, out_dtype)
@@ -168,6 +171,10 @@ def sharded_inference(
     arr = jnp.asarray(chunk_array, dtype=jnp.float32)
     if arr.ndim == 3:
         arr = arr[None]
+    if arr is chunk_array:
+        # the program donates its chunk argument; never hand it the
+        # caller's own (already float32, already device) buffer
+        arr = arr.copy()
     return program(
         arr,
         jnp.asarray(in_starts),

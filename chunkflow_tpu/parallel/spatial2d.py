@@ -22,6 +22,7 @@ test (tests/parallel/test_spatial2d.py).
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Tuple
 
 import numpy as np
@@ -204,7 +205,9 @@ def build_spatial2d_program(
         check_rep=False,
     )
 
-    @jax.jit
+    # chunk is donated (GL005): dead after the call, may be aliased
+    # into the output slab buffers — callers hand over a buffer they own
+    @partial(jax.jit, donate_argnums=(0,))
     def program(chunk, dev_in, dev_out, dev_valid, params):
         out, weight = sharded(chunk, dev_in, dev_out, dev_valid, params)
         return normalize_blend(out, weight, out_dtype)

@@ -1,22 +1,51 @@
 #!/bin/bash
 # CI entry point: graftlint gate, then the test suite on a clean 8-device
-# virtual CPU mesh.
+# virtual CPU mesh. Prints per-stage wall time so tier-1 latency creep is
+# visible in every CI log.
 # PALLAS_AXON_POOL_IPS must be unset: with it set, the TPU-tunnel site hook
 # intercepts every jax init, slowing CPU tests ~20x and wedging the
 # single-client tunnel if tests run concurrently with TPU work.
 set -u
 cd "$(dirname "$0")"
 
+stage_start=$SECONDS
+stage_time() {
+    echo "== stage '$1' took $((SECONDS - stage_start))s =="
+    stage_start=$SECONDS
+}
+
+# --- baseline guard -------------------------------------------------------
+# The graftlint baseline was emptied in PR 2 (all GL005 donate_argnums
+# findings fixed); any entry reappearing means someone re-grandfathered a
+# finding instead of fixing it — fail loudly (docs/linting.md).
+python - <<'EOF' || exit 1
+import json, sys
+with open("tools/graftlint/baseline.json") as f:
+    findings = json.load(f).get("findings", {})
+if findings:
+    print(
+        f"graftlint baseline is not empty ({len(findings)} grandfathered "
+        "finding(s)); fix the findings instead of re-grandfathering them "
+        "(docs/linting.md)", file=sys.stderr,
+    )
+    sys.exit(1)
+EOF
+stage_time "baseline guard"
+
 # --- static analysis gate -------------------------------------------------
-# graftlint (tools/graftlint, docs/linting.md) fails only on findings NOT
-# grandfathered in tools/graftlint/baseline.json. Skip with
-# CHUNKFLOW_SKIP_LINT=1 (e.g. when iterating on a single test).
+# graftlint (tools/graftlint, docs/linting.md) fails on any finding not in
+# the (empty) baseline. Skip with CHUNKFLOW_SKIP_LINT=1 (e.g. when
+# iterating on a single test).
 if [ "${CHUNKFLOW_SKIP_LINT:-0}" != "1" ]; then
     echo "== graftlint gate =="
     python -m tools.graftlint || exit 1
+    stage_time "graftlint"
 fi
 
 # --- tests ----------------------------------------------------------------
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     XLA_FLAGS="--xla_force_host_platform_device_count=8" \
     python -m pytest tests/ "$@"
+rc=$?
+stage_time "pytest"
+exit $rc

@@ -1,0 +1,177 @@
+"""Double-buffered chunk executor (flow/pipeline.py): the pipelined path
+must be a pure wall-time optimization — bit-identical outputs, input
+order, same failure semantics as the serial loop — with the donation
+ownership contract honored at every boundary (staged ring slots are
+consumed; caller-owned buffers never are)."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.flow.pipeline import (
+    pipeline_chunks,
+    pipelined_inference_stage,
+)
+from chunkflow_tpu.inference import Inferencer
+
+
+def _inferencer(**kwargs):
+    defaults = dict(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    defaults.update(kwargs)
+    return Inferencer(**defaults)
+
+
+def _chunks(shapes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        Chunk(rng.random(s).astype(np.float32), voxel_offset=(8 * i, 0, 0))
+        for i, s in enumerate(shapes)
+    ]
+
+
+# mixed aligned + ragged-edge shapes: the regime where retrace/donation
+# bugs hide (a ragged chunk pads, runs a different geometry, crops back)
+RAGGED_SHAPES = [(8, 32, 32), (5, 17, 18), (8, 32, 32), (7, 30, 20)]
+
+
+@pytest.mark.parametrize("ring", [1, 2, 3])
+def test_pipeline_bit_identical_to_serial(ring):
+    inferencer = _inferencer(shape_bucket=(8, 16, 16))
+    chunks = _chunks(RAGGED_SHAPES)
+    serial = [np.asarray(inferencer(c).array) for c in chunks]
+    piped = list(pipeline_chunks(inferencer, iter(chunks), ring=ring))
+    assert len(piped) == len(chunks)
+    for src, ref, out in zip(chunks, serial, piped):
+        assert not out.is_on_device
+        assert tuple(out.voxel_offset) == tuple(src.voxel_offset)
+        # bit-identical, not allclose: both paths run the SAME compiled
+        # program; donation must not perturb a single ulp
+        np.testing.assert_array_equal(np.asarray(out.array), ref)
+
+
+def test_pipeline_bit_identical_uint8_output():
+    inferencer = _inferencer(output_dtype="uint8")
+    chunks = _chunks(RAGGED_SHAPES, seed=3)
+    serial = [np.asarray(inferencer(c).array) for c in chunks]
+    piped = list(pipeline_chunks(inferencer, iter(chunks)))
+    for ref, out in zip(serial, piped):
+        assert np.asarray(out.array).dtype == np.uint8
+        np.testing.assert_array_equal(np.asarray(out.array), ref)
+
+
+def test_donation_back_to_back_same_program():
+    """The same donating program invoked back-to-back (same shape, fresh
+    buffers) must not corrupt results: XLA recycles the donated input
+    into the output, so a stale aliasing bug would show as run-to-run
+    divergence."""
+    inferencer = _inferencer()
+    chunk = _chunks([(8, 32, 32)])[0]
+    first = np.asarray(inferencer(chunk).array)
+    for _ in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(inferencer(chunk).array), first
+        )
+
+
+def test_caller_device_chunk_survives_inference():
+    """A device-resident chunk the CALLER staged is not pipeline-owned:
+    inference must copy rather than donate it, leaving the caller's
+    buffer alive (prefetch --to-device hands such chunks to the
+    inference stage, which may re-read them under another name)."""
+    inferencer = _inferencer()
+    host = _chunks([(8, 32, 32)])[0]
+    dev = host.device()
+    out1 = list(pipeline_chunks(inferencer, iter([dev])))[0]
+    # the caller's buffer must still be readable after the program ran
+    np.testing.assert_array_equal(
+        np.asarray(dev.array), np.asarray(host.array)
+    )
+    out2 = np.asarray(inferencer(host).array)
+    np.testing.assert_array_equal(np.asarray(out1.array), out2)
+
+
+def test_pipeline_postprocess_order_and_results():
+    inferencer = _inferencer()
+    chunks = _chunks(RAGGED_SHAPES[:3], seed=5)
+    serial = [float(np.asarray(inferencer(c).array).sum()) for c in chunks]
+    piped = list(
+        pipeline_chunks(
+            inferencer, iter(chunks),
+            postprocess=lambda c: float(np.asarray(c.array).sum()),
+        )
+    )
+    assert piped == pytest.approx(serial)
+
+
+def _task(chunk, i):
+    return {"log": {"timer": {}, "compute_device": ""}, "i": i,
+            "chunk": chunk}
+
+
+def test_pipelined_stage_order_skip_markers_and_timers():
+    inferencer = _inferencer()
+    chunks = _chunks(RAGGED_SHAPES, seed=7)
+    serial = [np.asarray(inferencer(c).array) for c in chunks]
+    tasks = [_task(c, i) for i, c in enumerate(chunks)]
+    tasks.insert(2, None)  # skip marker mid-stream
+    stage = pipelined_inference_stage(inferencer, depth=2, op_name="inf")
+    out = list(stage(iter(tasks)))
+    assert [t["i"] if t else None for t in out] == [0, 1, None, 2, 3]
+    for task in out:
+        if task is None:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(task["chunk"].array), serial[task["i"]]
+        )
+        assert not task["chunk"].is_on_device
+        assert task["log"]["timer"]["inf"] >= 0
+        assert task["log"]["compute_device"]
+
+
+def test_pipelined_stage_flushes_dispatched_on_error():
+    """A mid-stream failure must not drop tasks that were already
+    dispatched — the synchronous path would have completed them."""
+    inferencer = _inferencer()
+    chunks = _chunks([(8, 32, 32)] * 3, seed=9)
+
+    def check(chunk):
+        if tuple(chunk.voxel_offset)[0] == 16:  # third task
+            raise RuntimeError("bad grid")
+
+    stage = pipelined_inference_stage(
+        inferencer, depth=2, op_name="inf", check=check
+    )
+    got = []
+    with pytest.raises(RuntimeError, match="bad grid"):
+        for task in stage(iter(_task(c, i) for i, c in enumerate(chunks))):
+            got.append(task["i"])
+    assert got == [0, 1]
+
+
+def test_prefetch_then_pipelined_inference_compose():
+    """The full streaming sandwich: prefetch --to-device staging feeding
+    the double-buffered inference stage (the production worker wiring)."""
+    from chunkflow_tpu.flow.runtime import prefetch_stage
+
+    inferencer = _inferencer()
+    chunks = _chunks(RAGGED_SHAPES, seed=11)
+    serial = [np.asarray(inferencer(c).array) for c in chunks]
+    stages = [
+        prefetch_stage(depth=2, to_device=True),
+        pipelined_inference_stage(inferencer, depth=2, op_name="inf"),
+    ]
+    stream = iter([_task(c, i) for i, c in enumerate(chunks)])
+    for s in stages:
+        stream = s(stream)
+    out = list(stream)
+    assert [t["i"] for t in out] == [0, 1, 2, 3]
+    for task in out:
+        np.testing.assert_array_equal(
+            np.asarray(task["chunk"].array), serial[task["i"]]
+        )

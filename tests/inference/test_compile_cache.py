@@ -1,0 +1,120 @@
+"""Compile-cache layer (core/compile_cache.py): ragged-edge chunks that
+shape-bucket into the same run geometry must trigger exactly one trace,
+and the keyed program cache must count builds/hits as invariants a test
+can assert (not a benchmark)."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core.compile_cache import (
+    ProgramCache,
+    enable_persistent_cache,
+)
+from chunkflow_tpu.inference import Inferencer
+from chunkflow_tpu.inference.engines import Engine, create_identity_engine
+
+
+def test_program_cache_counts_and_eviction():
+    cache = ProgramCache(maxsize=2)
+    built = []
+
+    def make(tag):
+        def build():
+            built.append(tag)
+            return tag
+        return build
+
+    assert cache.get("a", make("a")) == "a"
+    assert cache.get("a", make("a2")) == "a"  # hit: builder not invoked
+    assert cache.get("b", make("b")) == "b"
+    assert (cache.builds, cache.hits) == (2, 1)
+    assert built == ["a", "b"]
+    cache.get("c", make("c"))  # evicts "a" (FIFO)
+    assert "a" not in cache and "b" in cache and "c" in cache
+    assert cache.peek("a") is None
+    with pytest.raises(ValueError):
+        ProgramCache(maxsize=0)
+
+
+def _counting_engine(input_patch, num_output_channels):
+    """Identity engine whose apply counts TRACES: the body runs under
+    jit tracing only, so the counter advances once per program
+    compilation and never on cached executions."""
+    inner = create_identity_engine(
+        input_patch, input_patch,
+        num_output_channels=num_output_channels,
+    )
+    traces = []
+
+    def apply(params, batch):
+        traces.append(batch.shape)
+        return inner.apply(params, batch)
+
+    return Engine(
+        params=(),
+        apply=apply,
+        num_input_channels=1,
+        num_output_channels=num_output_channels,
+    ), traces
+
+
+@pytest.mark.parametrize("blend", ["scatter", "fold"])
+def test_same_bucket_chunks_trace_once(blend):
+    """Two ragged chunks in the same shape bucket run ONE compiled
+    program: the second chunk is a pure cache hit (zero traces)."""
+    engine, traces = _counting_engine((4, 16, 16), 1)
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="prebuilt",
+        engine=engine,
+        batch_size=2,
+        shape_bucket=(8, 16, 16),
+        blend=blend,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    first = rng.random((5, 17, 18)).astype(np.float32)
+    np.asarray(inferencer(Chunk(first)).array)
+    n_traces = len(traces)
+    assert n_traces >= 1
+    # same bucket (8, 32, 32): bit-for-bit program reuse, no retrace
+    second = rng.random((7, 30, 20)).astype(np.float32)
+    out = np.asarray(inferencer(Chunk(second)).array)
+    assert len(traces) == n_traces, "same-bucket chunk retraced"
+    np.testing.assert_allclose(out[0], second, atol=1e-5)
+    # a different bucket is a genuine new geometry: exactly one more trace
+    third = rng.random((8, 40, 40)).astype(np.float32)
+    np.asarray(inferencer(Chunk(third)).array)
+    assert len(traces) == 2 * n_traces
+
+
+def test_fold_family_shares_program_cache():
+    """The fold path keys per padded shape in the shared ProgramCache:
+    three ragged shapes, one bucket, one build."""
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=1,
+        framework="identity",
+        batch_size=2,
+        blend="fold",
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(2)
+    for shape in ((8, 30, 30), (7, 27, 32), (8, 32, 32)):
+        np.asarray(inferencer(Chunk(rng.random(shape, dtype=np.float32))).array)
+    assert inferencer._programs.builds == 1
+    assert inferencer._programs.hits == 2
+
+
+def test_persistent_cache_enable_idempotent(tmp_path, monkeypatch):
+    target = str(tmp_path / "xla_cache")
+    assert enable_persistent_cache(target) == target
+    assert enable_persistent_cache(target) == target  # idempotent
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == target
+    monkeypatch.setenv("CHUNKFLOW_JAX_CACHE", "0")
+    assert enable_persistent_cache() is None  # env kill switch
