@@ -35,6 +35,8 @@ import threading
 import warnings
 from typing import Callable, Hashable, Optional
 
+from chunkflow_tpu.core import telemetry
+
 # Donation is best-effort by design: a chunk buffer that cannot alias the
 # program's output is simply dropped, and the warning would otherwise fire
 # once per compiled geometry (ops/fold_blend.py, parallel/*, inferencer).
@@ -44,6 +46,17 @@ warnings.filterwarnings(
 
 _LOCK = threading.Lock()
 _PERSISTENT_DIR: Optional[str] = None
+
+
+class RetraceWarning(UserWarning):
+    """More program builds than the planned bucket count (see
+    :class:`ProgramCache`)."""
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The on-disk XLA cache directory in effect, or None when the
+    persistent cache is disabled/unavailable (CLI end-of-run summary)."""
+    return _PERSISTENT_DIR
 
 
 def default_cache_dir() -> str:
@@ -99,15 +112,30 @@ class ProgramCache:
     as parallel/distributed._PROGRAM_CACHE). ``builds`` counts builder
     invocations — i.e. traces of new program geometry — and ``hits``
     counts reuses, so tests can assert "two same-bucket chunks, one
-    trace" as an invariant instead of a benchmark.
+    trace" as an invariant instead of a benchmark. Both also feed the
+    process-global telemetry counters (``compile_cache/builds``,
+    ``compile_cache/hits``) the CLI surfaces at end of run.
+
+    Retrace watchdog: ``expected_builds`` is the bucket count the owner
+    planned for (with shape bucketing, ragged chunks collapse into a
+    handful of buckets). The first build past it raises a
+    ``RetraceWarning`` — the signature of a silent retrace-per-chunk
+    (e.g. bucketing misconfigured, a key deriving from the RAW rather
+    than bucketed shape) that would otherwise only show up as an
+    unexplained N-minute compile stall per task.
     """
 
-    def __init__(self, maxsize: int = 16):
+    def __init__(self, maxsize: int = 16,
+                 expected_builds: Optional[int] = None,
+                 label: str = "programs"):
         if maxsize < 1:
             raise ValueError(f"maxsize must be >= 1, got {maxsize}")
         self.maxsize = maxsize
+        self.expected_builds = expected_builds
+        self.label = label
         self.builds = 0
         self.hits = 0
+        self._warned = False
         self._entries: dict = {}
         self._lock = threading.Lock()
 
@@ -132,10 +160,17 @@ class ProgramCache:
         with self._lock:
             if key in self._entries:
                 self.hits += 1
-                return self._entries[key]
+                hit = self._entries[key]
+            else:
+                hit = None
+        if hit is not None:
+            telemetry.inc("compile_cache/hits")
+            return hit
         # build outside the lock: builders jit-trace, which can re-enter
         # (a fold program build may consult the same Inferencer)
-        program = build()
+        with telemetry.span("compile_cache/build", label=self.label):
+            program = build()
+        raced = False
         with self._lock:
             if key not in self._entries:
                 self.builds += 1
@@ -146,7 +181,31 @@ class ProgramCache:
                 # lost a race: keep the first-published program so every
                 # caller shares one compiled executable
                 self.hits += 1
-            return self._entries[key]
+                raced = True
+            result = self._entries[key]
+        telemetry.inc("compile_cache/hits" if raced else
+                      "compile_cache/builds")
+        if not raced:
+            self._watchdog()
+        return result
+
+    def _watchdog(self) -> None:
+        """Warn (once per cache) when builds exceed the planned bucket
+        count — the retrace-per-chunk signature."""
+        if (self.expected_builds is None or self._warned
+                or self.builds <= self.expected_builds):
+            return
+        self._warned = True
+        telemetry.inc("compile_cache/retrace_warnings")
+        warnings.warn(
+            f"ProgramCache[{self.label}]: {self.builds} program builds "
+            f"exceed the expected bucket count "
+            f"({self.expected_builds}) — likely a retrace per chunk "
+            f"(check --shape-bucket / key derivation); every extra "
+            f"build pays a full XLA compile",
+            RetraceWarning,
+            stacklevel=3,
+        )
 
     def clear(self) -> None:
         with self._lock:

@@ -1,0 +1,318 @@
+"""Unified telemetry: counters, gauges, histograms, spans, JSONL events.
+
+The paper's fleet story (3600 GPU nodes, 18 PB of output) rests on
+knowing, per task and per operator, where wall-clock goes. The reference
+ships only coarse per-task ``log['timer']`` dicts aggregated offline by
+``log_summary``; our pipelined TPU port has far more internal state —
+ring occupancy, stage/compute/drain stall time, program-cache builds vs.
+hits — and none of it was visible anywhere. This module is the one
+substrate every perf-sensitive layer reports into:
+
+* a process-global registry of **counters** (:func:`inc`), **gauges**
+  (:func:`gauge`) and **histograms** (:func:`observe`), aggregated
+  in-process and snapshot-able at any time (:func:`snapshot`);
+* a **span** tracer (``with span("inference/fold"):``) that both feeds
+  the histogram registry and, when a metrics dir is configured
+  (:func:`configure`, CLI ``--metrics-dir``), appends one JSONL event
+  per span so offline tooling (``flow/log_summary.py``) can attribute
+  pipeline stalls after the fact;
+* an end-of-run :func:`summary_table` the CLI prints under ``-v``.
+
+Design rules, in priority order:
+
+1. **Never inside jit.** Telemetry is host-side bookkeeping; a
+   ``time.perf_counter`` or counter increment inside a traced function
+   would either concretize tracers or silently stop measuring (trace
+   time is not run time). graftlint rule GL007 enforces this statically.
+2. **Near-zero overhead, zero when off.** ``CHUNKFLOW_TELEMETRY=0``
+   turns every entry point into an early-out: no locks, no allocation,
+   no file IO, nothing emitted. Enabled-path span cost is two
+   ``perf_counter`` calls plus one locked dict update.
+3. **Zero dependencies.** Events are plain JSON lines; aggregation
+   needs nothing beyond the stdlib (pandas enters only in
+   ``log_summary``'s optional pretty printing).
+
+Event schema (one JSON object per line; see docs/observability.md):
+
+    {"kind": "span",    "name": "...", "t": <epoch end>, "dur_s": ...,
+     "pid": ..., ...attrs}
+    {"kind": "gauge",   "name": "...", "t": <epoch>, "value": ...}
+    {"kind": "snapshot", "t": <epoch>, "counters": {...}, "gauges": {...},
+     "hists": {name: {count,total,min,max}}}
+
+Span naming convention: ``<layer>/<phase>`` — ``pipeline/stage``,
+``pipeline/compute``, ``pipeline/drain``, ``op/<operator-name>``,
+``inference/<family>``. Counters likewise: ``compile_cache/builds``,
+``pipeline/tasks``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = [
+    "enabled", "configure", "configured_path", "inc", "gauge", "observe",
+    "span", "event", "snapshot", "flush", "reset", "summary_table",
+]
+
+_OFF_VALUES = ("0", "off", "false", "no")
+
+
+def enabled() -> bool:
+    """The kill switch, re-read per call so tests (and long-lived workers
+    reacting to a config push) can flip it at runtime."""
+    return os.environ.get("CHUNKFLOW_TELEMETRY", "1").lower() \
+        not in _OFF_VALUES
+
+
+class _Registry:
+    """Process-global metric state + optional JSONL sink. All mutation is
+    behind one lock; the disabled path never takes it."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        # name -> [count, total, min, max]
+        self.hists: Dict[str, list] = {}
+        self.sink = None
+        self.sink_path: Optional[str] = None
+
+    # -- metric updates (caller holds no lock) -------------------------
+    def add_counter(self, name: str, n: float) -> None:
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self.lock:
+            self.gauges[name] = value
+
+    def add_hist(self, name: str, value: float) -> None:
+        with self.lock:
+            h = self.hists.get(name)
+            if h is None:
+                self.hists[name] = [1, value, value, value]
+            else:
+                h[0] += 1
+                h[1] += value
+                h[2] = min(h[2], value)
+                h[3] = max(h[3], value)
+
+    # -- sink ----------------------------------------------------------
+    def emit(self, payload: dict) -> None:
+        with self.lock:
+            if self.sink is None:
+                return
+            try:
+                self.sink.write(json.dumps(payload) + "\n")
+            except (OSError, ValueError):
+                # a full disk / closed sink must never take the pipeline
+                # down; drop the event and keep computing
+                self.sink = None
+
+
+_REG = _Registry()
+
+
+def configure(metrics_dir: Optional[str]) -> Optional[str]:
+    """Open (or close, with None) the per-process JSONL sink under
+    ``metrics_dir``. Returns the file path in effect, or None when
+    disabled — with ``CHUNKFLOW_TELEMETRY=0`` nothing is created, so an
+    off run leaves no trace on disk."""
+    with _REG.lock:
+        if _REG.sink is not None:
+            try:
+                _REG.sink.close()
+            except OSError:
+                pass
+            _REG.sink, _REG.sink_path = None, None
+    if metrics_dir is None or not enabled():
+        return None
+    os.makedirs(metrics_dir, exist_ok=True)
+    path = os.path.join(metrics_dir, f"telemetry-{os.getpid()}.jsonl")
+    sink = open(path, "a")
+    with _REG.lock:
+        _REG.sink, _REG.sink_path = sink, path
+    return path
+
+
+def configured_path() -> Optional[str]:
+    return _REG.sink_path
+
+
+def inc(name: str, n: float = 1) -> None:
+    """Increment a counter. Counters are aggregate-only: they ride the
+    end-of-run snapshot event, not one line per increment."""
+    if not enabled():
+        return
+    _REG.add_counter(name, n)
+
+
+def gauge(name: str, value: float) -> None:
+    """Record an instantaneous level (ring occupancy, queue depth). Kept
+    as last-value in the registry AND folded into the histogram of the
+    same name so mean occupancy is queryable offline; emits one event
+    when a sink is configured."""
+    if not enabled():
+        return
+    _REG.set_gauge(name, value)
+    _REG.add_hist(name, value)
+    if _REG.sink is not None:
+        _REG.emit({"kind": "gauge", "name": name, "t": time.time(),
+                   "value": value})
+
+
+def observe(name: str, value: float) -> None:
+    """Fold a sample into a histogram without emitting an event."""
+    if not enabled():
+        return
+    _REG.add_hist(name, value)
+
+
+def event(kind: str, name: str, **attrs) -> None:
+    """Emit a free-form event line (sink configured and telemetry on)."""
+    if not enabled() or _REG.sink is None:
+        return
+    payload = {"kind": kind, "name": name, "t": time.time()}
+    payload.update(attrs)
+    _REG.emit(payload)
+
+
+class _NullSpan:
+    """The disabled span: a shared, stateless context manager."""
+
+    __slots__ = ()
+    duration = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "duration")
+
+    def __init__(self, name: str, attrs):
+        self.name = name
+        self.attrs = attrs
+        self.duration = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.duration = time.perf_counter() - self.t0
+        _REG.add_hist(self.name, self.duration)
+        if _REG.sink is not None:
+            payload = {"kind": "span", "name": self.name, "t": time.time(),
+                       "dur_s": self.duration, "pid": os.getpid()}
+            if self.attrs:
+                payload.update(self.attrs)
+            _REG.emit(payload)
+        return False
+
+
+def span(name: str, **attrs):
+    """Time a block: ``with span("pipeline/drain"): ...``. Feeds the
+    histogram registry and (sink configured) emits one JSONL event. The
+    span object exposes ``.duration`` after exit for callers that keep a
+    legacy timer view."""
+    if not enabled():
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def snapshot() -> dict:
+    """Copy of all aggregated metrics:
+    ``{"counters": {...}, "gauges": {...}, "hists": {name:
+    {"count", "total", "min", "max", "mean"}}}``."""
+    with _REG.lock:
+        hists = {
+            name: {
+                "count": h[0],
+                "total": h[1],
+                "min": h[2],
+                "max": h[3],
+                "mean": h[1] / h[0] if h[0] else 0.0,
+            }
+            for name, h in _REG.hists.items()
+        }
+        return {
+            "counters": dict(_REG.counters),
+            "gauges": dict(_REG.gauges),
+            "hists": hists,
+        }
+
+
+def flush() -> None:
+    """Write the aggregate snapshot as a final event and flush the sink.
+    Counters (builds/hits, task counts) reach the JSONL stream here —
+    they are aggregate-only during the run."""
+    if not enabled():
+        return
+    snap = snapshot()
+    if _REG.sink is not None:
+        _REG.emit({"kind": "snapshot", "t": time.time(),
+                   "pid": os.getpid(), **snap})
+        with _REG.lock:
+            if _REG.sink is not None:
+                try:
+                    _REG.sink.flush()
+                except OSError:
+                    pass
+
+
+def reset() -> None:
+    """Clear all metrics and close the sink (tests; each CLI invocation
+    is one process, so production never needs this)."""
+    with _REG.lock:
+        _REG.counters.clear()
+        _REG.gauges.clear()
+        _REG.hists.clear()
+        if _REG.sink is not None:
+            try:
+                _REG.sink.close()
+            except OSError:
+                pass
+        _REG.sink, _REG.sink_path = None, None
+
+
+# -- end-of-run reporting ----------------------------------------------
+def summary_table() -> str:
+    """Fixed-width end-of-run table of spans (count/total/mean/max),
+    counters and last-value gauges — the CLI prints this under ``-v``.
+    Empty string when nothing was recorded."""
+    snap = snapshot()
+    lines = []
+    if snap["hists"]:
+        lines.append(
+            f"  {'span':<28} {'count':>7} {'total_s':>9} {'mean_s':>9} "
+            f"{'max_s':>9}"
+        )
+        for name in sorted(snap["hists"]):
+            h = snap["hists"][name]
+            lines.append(
+                f"  {name:<28} {h['count']:>7} {h['total']:>9.3f} "
+                f"{h['mean']:>9.4f} {h['max']:>9.4f}"
+            )
+    if snap["counters"]:
+        lines.append(f"  {'counter':<28} {'value':>7}")
+        for name in sorted(snap["counters"]):
+            value = snap["counters"][name]
+            lines.append(f"  {name:<28} {value:>7g}")
+    if snap["gauges"]:
+        lines.append(f"  {'gauge (last)':<28} {'value':>7}")
+        for name in sorted(snap["gauges"]):
+            lines.append(f"  {name:<28} {snap['gauges'][name]:>7g}")
+    if not lines:
+        return ""
+    return "\n".join(["telemetry summary:"] + lines)

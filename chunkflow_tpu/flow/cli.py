@@ -75,15 +75,66 @@ def name_option(default):
 @click.option("--profile-dir", type=str, default=None,
               help="write a jax profiler trace of the whole pipeline here "
                    "(view with tensorboard or xprof)")
-def main(mip, dry_run, verbose, profile_dir):
+@click.option("--metrics-dir", type=str, default=None,
+              help="append structured telemetry JSONL (spans, stall "
+                   "attribution, cache counters) here; aggregate with "
+                   "log-summary --metrics-dir (docs/observability.md). "
+                   "CHUNKFLOW_TELEMETRY=0 disables all telemetry")
+def main(mip, dry_run, verbose, profile_dir, metrics_dir):
     """chunkflow-tpu: compose chunk operators into a pipeline."""
+    from chunkflow_tpu.core import telemetry
+
     state.mip = mip
     state.dry_run = dry_run
     state.verbose = verbose
+    # one CLI invocation = one telemetry run: drop metrics (and any open
+    # sink) left by a previous invocation in this process (tests,
+    # notebooks drive several per process)
+    telemetry.reset()
+    if metrics_dir:
+        # configure BEFORE any stage runs so operator construction
+        # (engine load, program cache) is visible in the stream too
+        telemetry.configure(metrics_dir)
+
+
+def _print_run_telemetry(verbose: int) -> None:
+    """End-of-run observability report: the span/counter summary table,
+    ProgramCache builds vs. hits, and persistent-XLA-cache status.
+    Everything here reads process-global state, so it covers every
+    Inferencer/cache the pipeline created."""
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.core.compile_cache import persistent_cache_dir
+
+    if not telemetry.enabled():
+        return
+    table = telemetry.summary_table()
+    if verbose and table:
+        print(table)
+    if verbose:
+        snap = telemetry.snapshot()
+        builds = snap["counters"].get("compile_cache/builds", 0)
+        hits = snap["counters"].get("compile_cache/hits", 0)
+        retraces = snap["counters"].get("compile_cache/retrace_warnings", 0)
+        if builds or hits:
+            line = (
+                f"program cache: {builds:g} build(s), {hits:g} hit(s)"
+            )
+            if retraces:
+                line += f", {retraces:g} RETRACE WARNING(S)"
+            print(line)
+        cache_dir = persistent_cache_dir()
+        print(
+            f"persistent XLA cache: "
+            f"{cache_dir if cache_dir else 'disabled'}"
+        )
+    if telemetry.configured_path():
+        telemetry.flush()
+        if verbose:
+            print(f"telemetry events: {telemetry.configured_path()}")
 
 
 @main.result_callback()
-def run_pipeline(stages, mip, dry_run, verbose, profile_dir):
+def run_pipeline(stages, mip, dry_run, verbose, profile_dir, metrics_dir):
     if profile_dir:
         import jax
 
@@ -95,6 +146,7 @@ def run_pipeline(stages, mip, dry_run, verbose, profile_dir):
             import jax
 
             jax.profiler.stop_trace()
+        _print_run_telemetry(verbose)
     if verbose:
         print(f"pipeline drained {count} task(s)")
 
@@ -972,18 +1024,36 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
 
 
 @main.command("log-summary")
-@click.option("--log-dir", "-l", type=str, required=True)
+@click.option("--log-dir", "-l", type=str, default=None,
+              help="legacy per-task JSON logs (save-precomputed sidecars)")
+@click.option("--metrics-dir", "summary_metrics_dir", type=str, default=None,
+              help="telemetry JSONL dir (--metrics-dir of a previous run): "
+                   "per-phase stall breakdown, ring occupancy, cache "
+                   "builds/hits")
 @cartesian_option("--output-size", default=None)
-def log_summary_cmd(log_dir, output_size):
-    """Aggregate per-task timing logs into a throughput report."""
-    from chunkflow_tpu.flow.log_summary import print_summary
+def log_summary_cmd(log_dir, summary_metrics_dir, output_size):
+    """Aggregate per-task timing logs and/or telemetry JSONL into a
+    throughput + stall-attribution report."""
+    from chunkflow_tpu.flow.log_summary import (
+        print_summary,
+        print_telemetry_summary,
+    )
+
+    if log_dir is None and summary_metrics_dir is None:
+        raise click.UsageError(
+            "log-summary needs --log-dir and/or --metrics-dir"
+        )
 
     @generator
     def stage(task):
-        print_summary(
-            log_dir,
-            output_size=output_size if output_size and any(output_size) else None,
-        )
+        if log_dir is not None:
+            print_summary(
+                log_dir,
+                output_size=output_size if output_size and any(output_size)
+                else None,
+            )
+        if summary_metrics_dir is not None:
+            print_telemetry_summary(summary_metrics_dir)
         return
         yield  # pragma: no cover
 

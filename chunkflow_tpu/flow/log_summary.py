@@ -1,23 +1,41 @@
-"""Fleet-wide timing log aggregation.
+"""Fleet-wide timing log aggregation: legacy per-task JSON + telemetry JSONL.
 
 Parity: reference flow/log_summary.py — parse per-task JSON logs into a
 pandas frame, report mean/max/min/sum seconds per operator grouped by
 compute device, and the canonical throughput number in Mvoxel/s
 (voxels of output per mean task-second).
+
+Beyond parity, this module also aggregates the structured telemetry
+stream (``--metrics-dir`` JSONL, ``core/telemetry.py``): per-span phase
+totals, the pipeline stall breakdown (how much host wall-clock went to
+H2D staging vs. device compute vs. D2H drain), mean ring occupancy, and
+program-cache builds vs. hits — so "the pipeline is drain-bound" is a
+queryable fact instead of a jax.profiler session.
 """
 from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import List, Optional
 
 import numpy as np
 
 from chunkflow_tpu.core.bbox import BoundingBox
 
+#: the pipeline phases whose spans make up the stall breakdown, in
+#: pipeline order (flow/pipeline.py span names)
+STALL_PHASES = (
+    "pipeline/stage", "pipeline/dispatch", "pipeline/compute",
+    "pipeline/drain",
+)
+
 
 def load_log_dir(log_dir: str) -> List[dict]:
     records = []
+    if not os.path.isdir(log_dir):
+        print(f"log-summary: no such log dir {log_dir}", file=sys.stderr)
+        return records
     for name in sorted(os.listdir(log_dir)):
         if not name.endswith(".json"):
             continue
@@ -55,6 +73,16 @@ def summarize(records: List[dict], output_size=None) -> "object":
             row["_mvoxel_per_s"] = row["_voxels"] / row["_total"] / 1e6
         rows.append(row)
     frame = pd.DataFrame(rows)
+    if len(frame) == 0 or "compute_device" not in frame.columns:
+        # an empty log dir (no tasks ran yet / wrong path) or records
+        # without a compute_device column must produce an empty report,
+        # not a pandas KeyError mid-aggregation
+        print(
+            "log-summary: no usable task records "
+            f"({len(records)} loaded); returning an empty summary",
+            file=sys.stderr,
+        )
+        return pd.DataFrame()
     grouped = frame.groupby("compute_device")
     summary = grouped.agg(["mean", "max", "min", "sum", "count"])
     return summary
@@ -96,6 +124,162 @@ def print_summary(log_dir: str, output_size=None) -> None:
                 f"{voxels / mean_time / 1e6:.2f} Mvoxel/s "
                 f"({len(group)} tasks)"
             )
+
+
+# ---------------------------------------------------------------------------
+# telemetry JSONL aggregation (core/telemetry.py event stream)
+# ---------------------------------------------------------------------------
+def load_telemetry_dir(metrics_dir: str) -> List[dict]:
+    """Parse every ``telemetry-*.jsonl`` under ``metrics_dir`` into a
+    flat event list (multi-process runs append one file per pid; the
+    aggregate is the fleet view). Torn trailing lines (a worker killed
+    mid-write) are skipped, not fatal."""
+    events: List[dict] = []
+    if not os.path.isdir(metrics_dir):
+        return events
+    for name in sorted(os.listdir(metrics_dir)):
+        if not name.endswith(".jsonl"):
+            continue
+        with open(os.path.join(metrics_dir, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(record, dict):
+                    events.append(record)
+    return events
+
+
+def summarize_telemetry(events: List[dict]) -> dict:
+    """Aggregate a telemetry event stream into::
+
+        {"spans":    {name: {count, total_s, mean_s, max_s}},
+         "counters": {name: value},          # summed over snapshots/pids
+         "gauges":   {name: {last, mean}},   # ring occupancy etc.
+         "stall":    {phase: {total_s, share}}}  # stage/dispatch/compute/drain
+
+    ``stall`` shares are fractions of the summed pipeline-phase time, so
+    "drain-bound" is literally ``stall['pipeline/drain']['share'] >
+    0.5``. Span events are the ground truth; per-pid snapshot events
+    contribute counters (each pid's final snapshot only) and fill in
+    span stats for streams recorded without span-level events."""
+    spans: dict = {}
+    gauge_stats: dict = {}
+    gauge_last: dict = {}
+    snapshots_by_pid: dict = {}
+    for record in events:
+        kind = record.get("kind")
+        if kind == "span":
+            name = record.get("name", "")
+            dur = float(record.get("dur_s", 0.0))
+            s = spans.setdefault(
+                name, {"count": 0, "total_s": 0.0, "max_s": 0.0}
+            )
+            s["count"] += 1
+            s["total_s"] += dur
+            s["max_s"] = max(s["max_s"], dur)
+        elif kind == "gauge":
+            name = record.get("name", "")
+            value = float(record.get("value", 0.0))
+            g = gauge_stats.setdefault(name, [0, 0.0])
+            g[0] += 1
+            g[1] += value
+            gauge_last[name] = value
+        elif kind == "snapshot":
+            # last snapshot per pid wins (a run may flush more than once)
+            snapshots_by_pid[record.get("pid", 0)] = record
+
+    counters: dict = {}
+    for snap in snapshots_by_pid.values():
+        for name, value in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + value
+        for name, h in (snap.get("hists") or {}).items():
+            # snapshot hists cover spans recorded while no sink was
+            # configured yet; only fill holes, never double-count (and a
+            # gauge's histogram is occupancy, not a span)
+            if name not in spans and name not in gauge_stats \
+                    and name not in (snap.get("gauges") or {}):
+                spans[name] = {
+                    "count": h.get("count", 0),
+                    "total_s": h.get("total", 0.0),
+                    "max_s": h.get("max", 0.0),
+                }
+    for s in spans.values():
+        s["mean_s"] = s["total_s"] / s["count"] if s["count"] else 0.0
+
+    gauges = {
+        name: {"last": gauge_last.get(name, 0.0),
+               "mean": g[1] / g[0] if g[0] else 0.0}
+        for name, g in gauge_stats.items()
+    }
+
+    stall_total = sum(
+        spans[p]["total_s"] for p in STALL_PHASES if p in spans
+    )
+    stall = {
+        p: {
+            "total_s": spans[p]["total_s"],
+            "share": (spans[p]["total_s"] / stall_total
+                      if stall_total > 0 else 0.0),
+        }
+        for p in STALL_PHASES if p in spans
+    }
+    return {"spans": spans, "counters": counters, "gauges": gauges,
+            "stall": stall}
+
+
+def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
+    """Human report over a metrics dir; returns the aggregate (None when
+    the dir holds no events — e.g. the run had CHUNKFLOW_TELEMETRY=0)."""
+    events = load_telemetry_dir(metrics_dir)
+    if not events:
+        print(f"no telemetry events found in {metrics_dir}")
+        return None
+    agg = summarize_telemetry(events)
+    print(f"telemetry: {len(events)} events from {metrics_dir}")
+    if agg["stall"]:
+        print("pipeline stall attribution (host wall-clock per phase):")
+        for phase in STALL_PHASES:
+            if phase in agg["stall"]:
+                s = agg["stall"][phase]
+                print(
+                    f"  {phase:<20} {s['total_s']:>9.3f}s "
+                    f"{100 * s['share']:>5.1f}%"
+                )
+        bound = max(agg["stall"], key=lambda p: agg["stall"][p]["share"])
+        print(f"  -> dominant phase: {bound}")
+    occupancy = agg["gauges"].get("pipeline/ring_occupancy")
+    if occupancy:
+        print(
+            f"ring occupancy: mean {occupancy['mean']:.2f}, "
+            f"last {occupancy['last']:g}"
+        )
+    builds = agg["counters"].get("compile_cache/builds")
+    hits = agg["counters"].get("compile_cache/hits")
+    if builds is not None or hits is not None:
+        print(
+            f"program cache: {builds or 0:g} build(s), {hits or 0:g} "
+            f"hit(s)"
+        )
+    if agg["counters"].get("compile_cache/retrace_warnings"):
+        print(
+            f"RETRACE WARNINGS: "
+            f"{agg['counters']['compile_cache/retrace_warnings']:g} "
+            f"(builds exceeded the expected bucket count)"
+        )
+    if agg["spans"]:
+        print(f"  {'span':<28} {'count':>7} {'total_s':>9} {'mean_s':>9}")
+        for name in sorted(agg["spans"]):
+            s = agg["spans"][name]
+            print(
+                f"  {name:<28} {s['count']:>7} {s['total_s']:>9.3f} "
+                f"{s['mean_s']:>9.4f}"
+            )
+    return agg
 
 
 # reference spellings (flow/log_summary.py:16,57)

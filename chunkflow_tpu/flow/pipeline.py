@@ -45,6 +45,23 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Iterator, Optional
 
+from chunkflow_tpu.core import telemetry
+
+
+def _drain_host(out):
+    """Materialize a dispatched output on the host, attributing the wait:
+    ``pipeline/compute`` is the block-until-the-program-finished portion
+    (device still busy when the host arrived — a compute-bound pipeline
+    accumulates its stall here), ``pipeline/drain`` the remaining D2H
+    copy wait. Both are HOST-side waits around, never inside, the
+    compiled program (GL007)."""
+    arr = getattr(out, "array", None)
+    if hasattr(arr, "block_until_ready"):
+        with telemetry.span("pipeline/compute"):
+            arr.block_until_ready()
+    with telemetry.span("pipeline/drain"):
+        return out.host()
+
 
 def _device_pipeline(inferencer, chunks: Iterable, ring: int, crop=None):
     """Yield DEVICE-resident output chunks (D2H already riding) in input
@@ -61,19 +78,22 @@ def _device_pipeline(inferencer, chunks: Iterable, ring: int, crop=None):
             except StopIteration:
                 exhausted = True
                 break
-            slot = inferencer.stage(chunk)
+            with telemetry.span("pipeline/stage"):
+                slot = inferencer.stage(chunk)
             # donate only buffers this pipeline staged itself; a chunk
             # that arrived already device-resident (e.g. prefetch
             # --to-device) still belongs to the caller's task
             staged.append((slot, slot is not chunk))
+            telemetry.gauge("pipeline/ring_occupancy", len(staged))
         if not staged:
             break
         # dispatch the oldest staged slot; an owned buffer is donated
         # into the program, freeing the ring slot in the same breath
         slot, owned = staged.popleft()
-        draining.append(
-            inferencer.infer_async(slot, crop=crop, consume=owned)
-        )
+        with telemetry.span("pipeline/dispatch"):
+            out = inferencer.infer_async(slot, crop=crop, consume=owned)
+        draining.append(out)
+        telemetry.gauge("pipeline/inflight", len(draining))
         while len(draining) >= ring:
             yield draining.popleft()
     while draining:
@@ -105,7 +125,7 @@ def pipeline_chunks(
     """
     if postprocess is None:
         for out in _device_pipeline(inferencer, chunks, ring, crop=crop):
-            yield out.host()
+            yield _drain_host(out)
         return
 
     from concurrent.futures import ThreadPoolExecutor
@@ -117,9 +137,10 @@ def pipeline_chunks(
                 while len(in_flight) >= post_depth:
                     yield in_flight.popleft().result()
                 # .host() inside the worker: the block-until-ready wait
-                # ALSO moves off the dispatch thread
+                # ALSO moves off the dispatch thread (spans are
+                # thread-safe; the compute/drain attribution rides along)
                 in_flight.append(
-                    pool.submit(lambda c=out: postprocess(c.host()))
+                    pool.submit(lambda c=out: postprocess(_drain_host(c)))
                 )
             while in_flight:
                 yield in_flight.popleft().result()
@@ -176,18 +197,19 @@ def pipelined_inference_stage(
 
         def finalize(entry):
             task, out, t0 = entry
-            task[output_name] = out.host()  # crop already applied on device
+            # crop already applied on device; _drain_host splits the wait
+            # into pipeline/compute + pipeline/drain spans
+            task[output_name] = _drain_host(out)
             task["log"]["timer"][op_name] = time.time() - t0
             task["log"]["compute_device"] = inferencer.compute_device
             return task
 
         def dispatch_one():
             task, slot, owned, t0 = staged.popleft()
-            pending.append((
-                task,
-                inferencer.infer_async(slot, crop=crop, consume=owned),
-                t0,
-            ))
+            with telemetry.span("pipeline/dispatch"):
+                out = inferencer.infer_async(slot, crop=crop, consume=owned)
+            pending.append((task, out, t0))
+            telemetry.gauge("pipeline/inflight", len(pending))
 
         try:
             for task in stream:
@@ -203,11 +225,13 @@ def pipelined_inference_stage(
                 chunk = task[input_name]
                 if check is not None:
                     check(chunk)
-                slot = inferencer.stage(chunk)
+                with telemetry.span("pipeline/stage"):
+                    slot = inferencer.stage(chunk)
                 # donate only pipeline-staged buffers: a chunk that was
                 # already device-resident stays valid in the task dict
                 # (it may be read downstream under another name)
                 staged.append((task, slot, slot is not chunk, time.time()))
+                telemetry.gauge("pipeline/ring_occupancy", len(staged))
                 if len(staged) >= ring:
                     # drain BEFORE dispatching so at most `depth` outputs
                     # are ever in flight (the documented memory bound)

@@ -15,6 +15,8 @@ import functools
 import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
+from chunkflow_tpu.core import telemetry
+
 DEFAULT_CHUNK_NAME = "chunk"
 
 
@@ -56,7 +58,11 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
     count = 0
     for task in stream:
         count += 1
-        drain_pending_writes(task)
+        with telemetry.span("pipeline/ack_writes"):
+            drain_pending_writes(task)
+        telemetry.inc("pipeline/tasks")
+        if task is None:
+            telemetry.inc("pipeline/tasks_skipped")
         if verbose and task is not None and task.get("log"):
             timers = task["log"]["timer"]
             total = sum(timers.values())
@@ -79,11 +85,20 @@ def operator(func: Callable) -> Callable:
         def stage(stream: Iterator[Optional[dict]]):
             for task in stream:
                 if task is not None:
-                    start = time.time()
                     original = task
-                    task = func(task, **kwargs)
+                    # the span IS the timer now: task['log']['timer'] is
+                    # the backward-compatible per-task view of the same
+                    # measurement (span duration is wall-clock, matching
+                    # the historical time.time() semantics)
+                    sp = telemetry.span(f"op/{name}")
+                    start = time.time()
+                    with sp:
+                        task = func(task, **kwargs)
                     if task is not None:
-                        task["log"]["timer"][name] = time.time() - start
+                        task["log"]["timer"][name] = (
+                            sp.duration if telemetry.enabled()
+                            else time.time() - start
+                        )
                     else:
                         # skip ops return None and downstream barriers
                         # never see the task — async write futures must

@@ -35,6 +35,7 @@ import numpy as np
 
 from chunkflow_tpu.chunk.base import Chunk, LayerType
 from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+from chunkflow_tpu.core import telemetry
 from chunkflow_tpu.core.compile_cache import (
     ProgramCache,
     enable_persistent_cache,
@@ -149,8 +150,16 @@ class Inferencer:
         # one keyed cache for every program family this inferencer builds
         # (scatter/fold/patch/spatial/spatial2d); keys derive from the
         # BUCKETED run shape, so ragged edge chunks that pad into the
-        # same bucket share one compiled program and never retrace
-        self._programs = ProgramCache()
+        # same bucket share one compiled program and never retrace. The
+        # retrace watchdog warns past CHUNKFLOW_EXPECTED_PROGRAMS builds
+        # (default 8: one per family plus a few fold/spatial geometries)
+        # — the signature of a silent retrace per chunk.
+        self._programs = ProgramCache(
+            label="inferencer",
+            expected_builds=int(
+                _os.environ.get("CHUNKFLOW_EXPECTED_PROGRAMS", "8")
+            ),
+        )
         # persistent on-disk XLA cache: a worker restart skips the
         # multi-minute UNet compile (CHUNKFLOW_JAX_CACHE=0 disables)
         enable_persistent_cache()
@@ -566,7 +575,11 @@ class Inferencer:
 
     # ------------------------------------------------------------------
     def __call__(self, chunk: Chunk) -> Chunk:
-        return self._infer(chunk, block=True)
+        # host-side span around the whole dispatch+wait (never inside
+        # the compiled program, GL007); blend mode labels the event so
+        # fold-vs-scatter time is separable offline
+        with telemetry.span("inference/infer", blend=self.blend_mode):
+            return self._infer(chunk, block=True)
 
     def stream(self, chunks, postprocess=None, post_depth: int = 2,
                ring: int = 2):

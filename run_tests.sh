@@ -48,4 +48,14 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m pytest tests/ "$@"
 rc=$?
 stage_time "pytest"
+
+# --- telemetry overhead gate ----------------------------------------------
+# Telemetry-on vs -off wall time on the pipeline_overlap workload
+# (docs/observability.md). The JSON line reports the <2% target as
+# gate_pass; the process only fails past 10% (gross regression — a lock
+# on the hot path, per-event fsync), so shared-box noise cannot redden CI.
+echo "== telemetry overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py telemetry_overhead || rc=$((rc == 0 ? 1 : rc))
+stage_time "telemetry overhead gate"
 exit $rc

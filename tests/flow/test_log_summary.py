@@ -40,3 +40,115 @@ def test_load_and_summarize(log_dir):
     assert v5e[("_mvoxel_per_s", "mean")] == pytest.approx(
         np.mean([2048 / 4 / 1e6, 2048 / 7 / 1e6])
     )
+
+
+def test_summarize_empty_returns_empty_summary(tmp_path, capsys):
+    """An empty log dir (or one with no usable records) must yield an
+    empty summary with a warning, not a pandas KeyError (ISSUE 3)."""
+    empty = tmp_path / "log"
+    empty.mkdir()
+    records = log_summary.load_log_dir(str(empty))
+    assert records == []
+    frame = log_summary.summarize(records)
+    assert len(frame) == 0
+    assert "no usable task records" in capsys.readouterr().err
+    # print_summary end to end on the empty dir
+    log_summary.print_summary(str(empty))
+    assert "no task logs found" in capsys.readouterr().out
+
+
+def test_load_log_dir_missing_dir_warns(tmp_path, capsys):
+    records = log_summary.load_log_dir(str(tmp_path / "nope"))
+    assert records == []
+    assert "no such log dir" in capsys.readouterr().err
+
+
+def test_summarize_tolerates_missing_compute_device(tmp_path):
+    d = tmp_path / "log"
+    d.mkdir()
+    (d / "0-8_0-16_0-16.json").write_text(json.dumps({
+        "timer": {"inference": 2.0},  # no compute_device key at all
+    }))
+    frame = log_summary.summarize(log_summary.load_log_dir(str(d)))
+    assert frame.loc[""][("_total", "mean")] == pytest.approx(2.0)
+
+
+def _write_events(path, events):
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+
+
+def test_telemetry_aggregation(tmp_path):
+    _write_events(tmp_path / "telemetry-1.jsonl", [
+        {"kind": "span", "name": "pipeline/stage", "dur_s": 1.0},
+        {"kind": "span", "name": "pipeline/drain", "dur_s": 3.0},
+        {"kind": "span", "name": "pipeline/drain", "dur_s": 5.0},
+        {"kind": "gauge", "name": "pipeline/ring_occupancy", "value": 2},
+        {"kind": "gauge", "name": "pipeline/ring_occupancy", "value": 1},
+        {"kind": "snapshot", "pid": 1,
+         "counters": {"compile_cache/builds": 2, "compile_cache/hits": 7}},
+    ])
+    _write_events(tmp_path / "telemetry-2.jsonl", [
+        {"kind": "span", "name": "pipeline/stage", "dur_s": 1.0},
+        {"kind": "snapshot", "pid": 2,
+         "counters": {"compile_cache/builds": 1}},
+    ])
+    (tmp_path / "ignored.txt").write_text("not jsonl")
+
+    agg = log_summary.summarize_telemetry(
+        log_summary.load_telemetry_dir(str(tmp_path))
+    )
+    assert agg["spans"]["pipeline/drain"]["count"] == 2
+    assert agg["spans"]["pipeline/drain"]["total_s"] == pytest.approx(8.0)
+    assert agg["spans"]["pipeline/drain"]["mean_s"] == pytest.approx(4.0)
+    # counters sum across per-pid snapshots
+    assert agg["counters"]["compile_cache/builds"] == 3
+    assert agg["counters"]["compile_cache/hits"] == 7
+    assert agg["gauges"]["pipeline/ring_occupancy"]["mean"] == \
+        pytest.approx(1.5)
+    # stall shares: stage 2s of 10s, drain 8s of 10s
+    assert agg["stall"]["pipeline/stage"]["share"] == pytest.approx(0.2)
+    assert agg["stall"]["pipeline/drain"]["share"] == pytest.approx(0.8)
+
+
+def test_telemetry_snapshot_fills_span_holes_without_double_count(tmp_path):
+    # a stream recorded with a late-configured sink: spans only in the
+    # snapshot hists; gauges in the snapshot must not become spans
+    _write_events(tmp_path / "telemetry-1.jsonl", [
+        {"kind": "span", "name": "pipeline/drain", "dur_s": 2.0},
+        {"kind": "snapshot", "pid": 1,
+         "gauges": {"pipeline/ring_occupancy": 2},
+         "hists": {
+             "pipeline/drain": {"count": 9, "total": 9.0, "max": 2.0},
+             "pipeline/stage": {"count": 4, "total": 1.0, "max": 0.5},
+             "pipeline/ring_occupancy": {"count": 4, "total": 8.0,
+                                         "max": 2},
+         }},
+    ])
+    agg = log_summary.summarize_telemetry(
+        log_summary.load_telemetry_dir(str(tmp_path))
+    )
+    # live span events win over the snapshot copy (no double count)
+    assert agg["spans"]["pipeline/drain"]["count"] == 1
+    # hole filled from the snapshot
+    assert agg["spans"]["pipeline/stage"]["count"] == 4
+    # the gauge's histogram is occupancy, not a span
+    assert "pipeline/ring_occupancy" not in agg["spans"]
+
+
+def test_print_telemetry_summary(tmp_path, capsys):
+    assert log_summary.print_telemetry_summary(str(tmp_path)) is None
+    assert "no telemetry events" in capsys.readouterr().out
+    _write_events(tmp_path / "telemetry-1.jsonl", [
+        {"kind": "span", "name": "pipeline/stage", "dur_s": 1.0},
+        {"kind": "span", "name": "pipeline/drain", "dur_s": 9.0},
+        {"kind": "gauge", "name": "pipeline/ring_occupancy", "value": 2},
+        {"kind": "snapshot", "pid": 1,
+         "counters": {"compile_cache/builds": 1,
+                      "compile_cache/hits": 5}},
+    ])
+    agg = log_summary.print_telemetry_summary(str(tmp_path))
+    out = capsys.readouterr().out
+    assert agg["stall"]["pipeline/drain"]["share"] == pytest.approx(0.9)
+    assert "dominant phase: pipeline/drain" in out
+    assert "ring occupancy" in out
+    assert "1 build(s), 5 hit(s)" in out

@@ -36,6 +36,41 @@ def test_program_cache_counts_and_eviction():
         ProgramCache(maxsize=0)
 
 
+def test_retrace_watchdog_warns_past_expected_builds():
+    from chunkflow_tpu.core.compile_cache import RetraceWarning
+
+    cache = ProgramCache(expected_builds=2, label="test")
+    cache.get("a", lambda: "a")
+    cache.get("b", lambda: "b")
+    with pytest.warns(RetraceWarning, match="expected bucket count"):
+        cache.get("c", lambda: "c")
+    # once per cache: a warning per retrace would swamp the log
+    import warnings as _warnings
+
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error", RetraceWarning)
+        cache.get("d", lambda: "d")
+
+
+def test_cache_counters_feed_telemetry(monkeypatch):
+    from chunkflow_tpu.core import telemetry
+
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()
+    cache = ProgramCache()
+    cache.get("a", lambda: "a")
+    cache.get("a", lambda: "a")
+    cache.get("b", lambda: "b")
+    counters = telemetry.snapshot()["counters"]
+    assert counters["compile_cache/builds"] == 2
+    assert counters["compile_cache/hits"] == 1
+    # per-instance counters stay live even with telemetry off
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    cache.get("c", lambda: "c")
+    assert cache.builds == 3
+    telemetry.reset()
+
+
 def _counting_engine(input_patch, num_output_channels):
     """Identity engine whose apply counts TRACES: the body runs under
     jit tracing only, so the counter advances once per program

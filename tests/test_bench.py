@@ -51,17 +51,36 @@ def test_pallas_config_fails_loudly_on_cpu(tiny_bench):
         tiny_bench.run_config(cfg)
 
 
-def test_pipeline_overlap_microbench():
+def test_pipeline_overlap_microbench(tmp_path):
     """The double-buffered executor must beat the serial chunk loop on
     the synthetic CPU workload (ISSUE 2 acceptance: >= 1.2x) and stay
     bit-identical — run_pipeline_overlap itself raises on divergence.
-    The overlap is deterministic (async dispatch + calibrated simulated
-    IO) but the measured ratio is not: best-of-3 guards against load
-    spikes on a shared CI box (same convention as test_prefetch's
-    generous timing margins)."""
+
+    Measured in a FRESH SUBPROCESS under the benchmark's actual
+    contract (`python bench.py pipeline_overlap` from a shell): inside
+    the suite's interpreter the ratio is contaminated down to ~1.0
+    (observed at the PR 2 commit as well, so suite state, not the
+    executor) — chiefly by conftest.py's
+    --xla_force_host_platform_device_count=8, which splits the CPU
+    client 8 ways and must be scrubbed from the child env too. The
+    overlap itself is deterministic; best-of-3 still guards against
+    load spikes on a shared CI box."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)  # the 8-device virtual mesh (conftest.py)
     best = None
     for _ in range(3):
-        stats = bench.run_pipeline_overlap()
+        proc = subprocess.run(
+            [sys.executable, bench_py, "pipeline_overlap"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
         if best is None or stats["value"] > best["value"]:
             best = stats
         if best["value"] >= 1.2:
@@ -69,6 +88,11 @@ def test_pipeline_overlap_microbench():
     assert best["value"] >= 1.2, best
     assert best["metric"] == "pipeline_overlap_speedup"
     assert best["pipelined_s"] < best["serial_s"], best
+    # the run's own telemetry JSONL landed where we pointed it
+    assert best["cache_builds"] == 1, best  # one bucket -> one trace
+    assert any(
+        name.endswith(".jsonl") for name in os.listdir(tmp_path)
+    ), best.get("telemetry_jsonl")
 
 
 def test_cfg_names_unique():

@@ -300,6 +300,75 @@ def test_gl006_named_helper_satisfies():
     assert "GL006" not in codes(findings)
 
 
+# ---------------------------------------------------------------- GL007
+GL007_POSITIVE = """\
+    import jax
+    import time
+    from chunkflow_tpu.core.telemetry import span
+
+    @jax.jit
+    def f(x):
+        t0 = time.perf_counter()
+        with span("inference/body"):
+            y = x * 2
+        return y, time.perf_counter() - t0
+"""
+
+
+def test_gl007_detects_telemetry_in_jit():
+    findings, _ = run(GL007_POSITIVE)
+    # two perf_counter calls + the span call
+    assert codes(findings).count("GL007") == 3
+
+
+def test_gl007_suppressed():
+    src = GL007_POSITIVE.replace(
+        "t0 = time.perf_counter()",
+        "t0 = time.perf_counter()  # graftlint: disable=GL007",
+    ).replace(
+        'with span("inference/body"):',
+        'with span("inference/body"):  # graftlint: disable=GL007',
+    ).replace(
+        "return y, time.perf_counter() - t0",
+        "return y, time.perf_counter() - t0  # graftlint: disable=GL007",
+    )
+    findings, suppressed = run(src)
+    assert "GL007" not in codes(findings)
+    assert suppressed == 3
+
+
+def test_gl007_ignores_host_side_telemetry():
+    # spans AROUND dispatch/wait are exactly the designed pattern
+    findings, _ = run("""\
+        import time
+        from chunkflow_tpu.core import telemetry
+
+        def drain(out):
+            t0 = time.perf_counter()
+            with telemetry.span("pipeline/drain"):
+                host = out.host()
+            telemetry.observe("pipeline/drain_s", time.perf_counter() - t0)
+            return host
+    """)
+    assert "GL007" not in codes(findings)
+
+
+def test_gl007_module_alias_and_traced_callee():
+    # `telemetry.inc` via module import, inside a lax.scan callback
+    findings, _ = run("""\
+        from jax import lax
+        from chunkflow_tpu.core import telemetry
+
+        def step(carry, x):
+            telemetry.inc("bad/under_trace")
+            return carry, x
+
+        def outer(xs):
+            return lax.scan(step, None, xs)
+    """)
+    assert "GL007" in codes(findings)
+
+
 # ------------------------------------------------- traced-context engine
 def test_traced_via_lax_scan_callback():
     findings, _ = run("""\

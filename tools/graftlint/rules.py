@@ -1,4 +1,4 @@
-"""The graftlint rule registry: GL001..GL006.
+"""The graftlint rule registry: GL001..GL007.
 
 Each rule is a class with ``code``, ``name`` and ``run(ctx, config)``
 yielding Findings. Register new rules by appending to ``RULES`` (see
@@ -373,6 +373,55 @@ class AxisOrderHazard(Rule):
             )
 
 
+class TelemetryInJit(Rule):
+    """Telemetry or wall-clock timing call inside a jit-traced function.
+
+    ``time.time()`` / ``perf_counter()`` and the telemetry API
+    (``span``, ``inc``, ``gauge``, ``observe``, ...) are host-side
+    bookkeeping. Inside a traced function they measure TRACE time, not
+    run time — executed once at compile, never per call — so the numbers
+    are silently wrong; at worst the call concretizes a tracer. The
+    telemetry layer's design rule #1 (core/telemetry.py) is that no
+    instrumentation ever executes inside jitted code: time spans around
+    the program (dispatch, block_until_ready, host copy), never in it.
+    """
+
+    code = "GL007"
+    name = "telemetry-in-jit"
+
+    TIMING_FUNCS = {
+        "time.time", "time.time_ns", "time.perf_counter",
+        "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+        "time.process_time", "time.process_time_ns",
+        "timeit.default_timer", "datetime.datetime.now",
+    }
+    TELEMETRY_MODULE = "chunkflow_tpu.core.telemetry"
+
+    def run(self, ctx, config):
+        for fn in ctx.traced:
+            for node in walk_local(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = ctx.imports.resolve(node.func)
+                if resolved in self.TIMING_FUNCS:
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"wall-clock call `{resolved}` inside jit-traced "
+                        f"`{func_name(fn)}` — measures trace time, not run "
+                        f"time; time the dispatch/wait from the host side",
+                    )
+                elif resolved is not None and resolved.startswith(
+                        self.TELEMETRY_MODULE + "."):
+                    api = resolved[len(self.TELEMETRY_MODULE) + 1:]
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"telemetry call `{api}` inside jit-traced "
+                        f"`{func_name(fn)}` — instrumentation never "
+                        f"executes in compiled code (it would record "
+                        f"trace-time only); hoist it to the call site",
+                    )
+
+
 RULES: List[Rule] = [
     HostSyncInJit(),
     NumpyOnTracer(),
@@ -380,6 +429,7 @@ RULES: List[Rule] = [
     ImplicitFloat64(),
     JitWithoutDonation(),
     AxisOrderHazard(),
+    TelemetryInJit(),
 ]
 
 RULES_BY_CODE = {r.code: r for r in RULES}
