@@ -443,6 +443,152 @@ def run_telemetry_overhead(
     }
 
 
+def run_e2e_overlap(
+    n_tasks: int = 8,
+    chunk_size=(64, 256, 256),
+    input_patch=(16, 64, 64),
+    overlap=(4, 16, 16),
+) -> dict:
+    """Serial vs scheduled wall time over the FULL task lifecycle:
+    load → H2D → device compute → D2H → host post-processing → async
+    storage write (ISSUE 4). CPU-safe: identity engine, smoke geometry,
+    and simulated load/post/write latencies each calibrated to the
+    measured per-chunk device time — the balanced regime where every
+    phase matters and the reference's serial loop pays 4x.
+
+    The serial leg is the reference loop (load, synchronous inference,
+    post, commit-before-next-task). The scheduled leg runs the same work
+    through the adaptive scheduler's full stage chain
+    (flow/scheduler.py): prefetch thread + staging ring + worker-pool
+    post + write-behind window. Outputs are asserted bit-identical; the
+    gate in tests/test_bench.py requires >= 1.4x. The run's telemetry
+    JSONL (stall spans, depth_change events, a final ``depths`` event)
+    lands under the bench metrics dir, and the JSON line reports the
+    final adapted depths.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.flow.runtime import new_task
+    from chunkflow_tpu.flow.scheduler import (
+        DepthController,
+        scheduled_inference_stage,
+        write_behind_stage,
+    )
+    from chunkflow_tpu.inference import Inferencer
+
+    telemetry.configure(_bench_metrics_dir())
+
+    inferencer = Inferencer(
+        input_patch_size=input_patch,
+        output_patch_overlap=overlap,
+        num_output_channels=3,
+        framework="identity",
+        batch_size=4,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    chunks = [
+        Chunk(rng.random(chunk_size, dtype=np.float32))
+        for _ in range(n_tasks)
+    ]
+
+    # warmup (trace + compile), then calibrate every simulated host phase
+    # to the measured steady per-chunk device time (floor keeps the
+    # sleeps meaningful on a fast box)
+    np.asarray(inferencer(chunks[0]).array)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(inferencer(chunks[0]).array)
+        times.append(time.perf_counter() - t0)
+    phase_s = max(min(times), 0.02)
+
+    write_pool = ThreadPoolExecutor(max_workers=8)
+
+    def post_fn(chunk):
+        time.sleep(phase_s)  # simulated connected-components / downsample
+        return chunk
+
+    # --- serial leg: the reference loop ---------------------------------
+    t0 = time.perf_counter()
+    serial = []
+    for chunk in chunks:
+        time.sleep(phase_s)  # simulated storage read
+        out = post_fn(inferencer(chunk))
+        serial.append(np.asarray(out.array))
+        # commit-before-next-task: the write is async but the loop waits
+        write_pool.submit(time.sleep, phase_s).result()
+    serial_s = time.perf_counter() - t0
+
+    # --- scheduled leg: the full adaptive stage chain -------------------
+    inf_ctl = DepthController()
+    write_ctl = DepthController()
+
+    def source(stream):
+        for _seed in stream:
+            for i, chunk in enumerate(chunks):
+                time.sleep(phase_s)  # simulated storage read
+                task = new_task()
+                task["chunk"] = chunk
+                task["i"] = i
+                yield task
+
+    def attach_write(stream):
+        for task in stream:
+            if task is not None:
+                # simulated async storage commit latency
+                task.setdefault("pending_writes", []).append(
+                    write_pool.submit(time.sleep, phase_s))
+            yield task
+
+    stages = [
+        source,
+        scheduled_inference_stage(
+            inferencer, postprocess=post_fn, controller=inf_ctl,
+            op_name="inference",
+        ),
+        attach_write,
+        write_behind_stage(controller=write_ctl),
+    ]
+    t0 = time.perf_counter()
+    stream = iter([new_task()])
+    for stage in stages:
+        stream = stage(stream)
+    scheduled = [(task["i"], np.asarray(task["chunk"].array))
+                 for task in stream]
+    scheduled_s = time.perf_counter() - t0
+
+    if [i for i, _ in scheduled] != list(range(n_tasks)):
+        raise RuntimeError(f"task order broken: {[i for i, _ in scheduled]}")
+    for ref, (_, out) in zip(serial, scheduled):
+        if not np.array_equal(ref, out):
+            raise RuntimeError("scheduled output diverged from serial")
+    write_pool.shutdown(wait=False)
+
+    final_depths = dict(inf_ctl.depths, write=write_ctl.depths["write"])
+    telemetry.event("depths", "scheduler/final", **final_depths)
+    telemetry.flush()
+    events_path = telemetry.configured_path()
+    telemetry.configure(None)  # close the sink (in-process callers)
+    speedup = serial_s / scheduled_s
+    return {
+        "metric": "e2e_overlap_speedup",
+        "value": round(speedup, 2),
+        "unit": "x_serial",
+        "serial_s": round(serial_s, 3),
+        "scheduled_s": round(scheduled_s, 3),
+        "n_tasks": n_tasks,
+        "phase_s": round(phase_s, 4),
+        "final_depths": final_depths,
+        "depth_changes": len(inf_ctl.changes) + len(write_ctl.changes),
+        "gate_x": 1.4,
+        "gate_pass": speedup >= 1.4,
+        "telemetry_jsonl": events_path,
+    }
+
+
 def _check_pallas_oracle():
     """Identity-engine oracle at toy size: catches a miscompiled pallas
     scatter kernel (wrong results, not just crashes) before it can taint
@@ -791,7 +937,7 @@ def parent_main() -> int:
 
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] in (
-        "pipeline_overlap", "telemetry_overhead"
+        "pipeline_overlap", "telemetry_overhead", "e2e_overlap"
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -802,6 +948,13 @@ def main() -> int:
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         if sys.argv[1] == "pipeline_overlap":
             return _emit(run_pipeline_overlap())
+        if sys.argv[1] == "e2e_overlap":
+            result = run_e2e_overlap()
+            _emit(result)
+            # soft gate at the 1.4x target (reported as gate_pass; the
+            # suite asserts it best-of-3 in a fresh subprocess); hard
+            # floor at 1.1x — below that the scheduler lost its overlap
+            return 0 if result["value"] >= 1.1 else 4
         result = run_telemetry_overhead()
         _emit(result)
         # soft gate at the 2% target (reported), hard gate at 10x it:

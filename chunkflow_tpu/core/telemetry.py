@@ -41,9 +41,13 @@ Event schema (one JSON object per line; see docs/observability.md):
      "hists": {name: {count,total,min,max}}}
 
 Span naming convention: ``<layer>/<phase>`` — ``pipeline/stage``,
-``pipeline/compute``, ``pipeline/drain``, ``op/<operator-name>``,
+``pipeline/compute``, ``pipeline/drain``, ``scheduler/load``,
+``scheduler/post``, ``scheduler/write``, ``op/<operator-name>``,
 ``inference/<family>``. Counters likewise: ``compile_cache/builds``,
-``pipeline/tasks``.
+``pipeline/tasks``. The adaptive scheduler (flow/scheduler.py) both
+*consumes* this stream (per-phase stall totals via :func:`hist_totals`
+drive its depth controller) and *feeds* it: ``scheduler/depth/<knob>``
+gauges and ``depth_change`` events record every widening decision.
 """
 from __future__ import annotations
 
@@ -56,6 +60,7 @@ from typing import Dict, Optional
 __all__ = [
     "enabled", "configure", "configured_path", "inc", "gauge", "observe",
     "span", "event", "snapshot", "flush", "reset", "summary_table",
+    "hist_totals",
 ]
 
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -229,6 +234,24 @@ def span(name: str, **attrs):
     if not enabled():
         return _NULL_SPAN
     return _Span(name, attrs)
+
+
+def hist_totals(names) -> Dict[str, float]:
+    """Cumulative histogram totals (seconds for span histograms) for the
+    given names; 0.0 for a name with no samples yet. The adaptive
+    scheduler's depth controller (flow/scheduler.py) polls per-phase
+    stall totals through this every few tasks — one lock, no per-name
+    dict rebuild — instead of materializing a full :func:`snapshot`.
+    Disabled telemetry returns all-zero totals, which the controller
+    reads as "no stall signal": depths stay at their static initial
+    values (the documented graceful fallback)."""
+    if not enabled():
+        return {name: 0.0 for name in names}
+    with _REG.lock:
+        return {
+            name: (_REG.hists[name][1] if name in _REG.hists else 0.0)
+            for name in names
+        }
 
 
 def snapshot() -> dict:

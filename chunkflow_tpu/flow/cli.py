@@ -81,7 +81,18 @@ def name_option(default):
                    "log-summary --metrics-dir (docs/observability.md). "
                    "CHUNKFLOW_TELEMETRY=0 disables all telemetry")
 def main(mip, dry_run, verbose, profile_dir, metrics_dir):
-    """chunkflow-tpu: compose chunk operators into a pipeline."""
+    """chunkflow-tpu: compose chunk operators into a pipeline.
+
+    \b
+    Adaptive scheduler env vars (docs/performance.md):
+      CHUNKFLOW_SCHED=static    kill switch: compose the static prefetch/
+                                pipeline/async-write stages exactly as
+                                before (bit-identical); default: adaptive
+      CHUNKFLOW_SCHED_MEM_GB    host-memory watermark bounding adaptive
+                                depth growth (default 4)
+      CHUNKFLOW_SCHED_INTERVAL  tasks between depth-controller ticks
+                                (default 4)
+    """
     from chunkflow_tpu.core import telemetry
 
     state.mip = mip
@@ -1655,7 +1666,19 @@ def copy_var_cmd(op_name, from_name, to_name):
          "program runs while task i's result rides D2H (jax dispatch is "
          "async). 1 = synchronous (reference behavior). Per-op timers "
          "then measure dispatch-to-materialize wall time, which overlaps "
-         "across tasks",
+         "across tasks. Under the adaptive scheduler (default; "
+         "CHUNKFLOW_SCHED=static disables) this is the INITIAL depth — "
+         "the controller may widen it up to the memory watermark "
+         "(CHUNKFLOW_SCHED_MEM_GB)",
+)
+@click.option(
+    "--prefetch-depth", type=int, default=2,
+    help="adaptive scheduler only (with --async-depth > 1): initial "
+         "number of upstream tasks pulled ahead in the scheduler's load "
+         "thread, so load-operator IO overlaps device compute without a "
+         "separate 'prefetch' command; widened by the controller when "
+         "load/stage stalls dominate. CHUNKFLOW_SCHED=static ignores "
+         "this — compose the 'prefetch' command instead",
 )
 @click.option("--input-chunk-name", "-i", type=str, default=DEFAULT_CHUNK_NAME)
 @click.option("--output-chunk-name", "-o", type=str, default=DEFAULT_CHUNK_NAME)
@@ -1665,7 +1688,8 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                   model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
                   output_dtype, model_variant, sharding, shape_bucket,
-                  blend, async_depth, input_chunk_name, output_chunk_name):
+                  blend, async_depth, prefetch_depth, input_chunk_name,
+                  output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -1732,14 +1756,33 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
 
         return stage(_name=op_name)
 
-    # pipelined: the double-buffered executor (flow/pipeline.py) threads
-    # the task dicts through a staging ring + async dispatch so task i+1
-    # stages H2D while task i computes and task i-1's result rides D2H
-    from chunkflow_tpu.flow.pipeline import pipelined_inference_stage
+    # pipelined: the double-buffered executor threads the task dicts
+    # through a staging ring + async dispatch so task i+1 stages H2D
+    # while task i computes and task i-1's result rides D2H. Default is
+    # the adaptive scheduler (flow/scheduler.py): upstream load IO runs
+    # --prefetch-depth tasks ahead, drain + host materialization move to
+    # a worker pool, and all depths widen under telemetry-driven control.
+    # CHUNKFLOW_SCHED=static pins the PR 2 composition bit-identically.
+    from chunkflow_tpu.flow.scheduler import scheduler_mode
 
-    return pipelined_inference_stage(
+    if scheduler_mode() == "static":
+        from chunkflow_tpu.flow.pipeline import pipelined_inference_stage
+
+        return pipelined_inference_stage(
+            inferencer,
+            depth=async_depth,
+            input_name=input_chunk_name,
+            output_name=output_chunk_name,
+            op_name=op_name,
+            crop=explicit_crop,
+            check=check_grid,
+        )
+    from chunkflow_tpu.flow.scheduler import scheduled_inference_stage
+
+    return scheduled_inference_stage(
         inferencer,
         depth=async_depth,
+        prefetch_depth=prefetch_depth,
         input_name=input_chunk_name,
         output_name=output_chunk_name,
         op_name=op_name,

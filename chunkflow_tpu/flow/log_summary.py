@@ -24,10 +24,14 @@ import numpy as np
 from chunkflow_tpu.core.bbox import BoundingBox
 
 #: the pipeline phases whose spans make up the stall breakdown, in
-#: pipeline order (flow/pipeline.py span names)
+#: pipeline order (flow/pipeline.py + flow/scheduler.py span names):
+#: upstream load wait, H2D staging, dispatch, device compute, D2H drain,
+#: host post-processing, storage-write drain — the same totals the
+#: adaptive depth controller consumes (docs/observability.md)
 STALL_PHASES = (
-    "pipeline/stage", "pipeline/dispatch", "pipeline/compute",
-    "pipeline/drain",
+    "scheduler/load", "pipeline/stage", "pipeline/dispatch",
+    "pipeline/compute", "pipeline/drain", "scheduler/post",
+    "scheduler/write",
 )
 
 
@@ -160,20 +164,27 @@ def summarize_telemetry(events: List[dict]) -> dict:
         {"spans":    {name: {count, total_s, mean_s, max_s}},
          "counters": {name: value},          # summed over snapshots/pids
          "gauges":   {name: {last, mean}},   # ring occupancy etc.
-         "stall":    {phase: {total_s, share}}}  # stage/dispatch/compute/drain
+         "stall":    {phase: {total_s, share}},  # load/stage/.../write
+         "depth_changes": [event, ...]}  # adaptive scheduler widenings
 
     ``stall`` shares are fractions of the summed pipeline-phase time, so
     "drain-bound" is literally ``stall['pipeline/drain']['share'] >
     0.5``. Span events are the ground truth; per-pid snapshot events
     contribute counters (each pid's final snapshot only) and fill in
-    span stats for streams recorded without span-level events."""
+    span stats for streams recorded without span-level events.
+    ``depth_changes`` preserves the scheduler's ``depth_change`` events
+    in stream order (final depths also ride the ``scheduler/depth/*``
+    gauges)."""
     spans: dict = {}
     gauge_stats: dict = {}
     gauge_last: dict = {}
     snapshots_by_pid: dict = {}
+    depth_changes: list = []
     for record in events:
         kind = record.get("kind")
-        if kind == "span":
+        if kind == "depth_change":
+            depth_changes.append(record)
+        elif kind == "span":
             name = record.get("name", "")
             dur = float(record.get("dur_s", 0.0))
             s = spans.setdefault(
@@ -229,7 +240,7 @@ def summarize_telemetry(events: List[dict]) -> dict:
         for p in STALL_PHASES if p in spans
     }
     return {"spans": spans, "counters": counters, "gauges": gauges,
-            "stall": stall}
+            "stall": stall, "depth_changes": depth_changes}
 
 
 def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
@@ -257,6 +268,20 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
         print(
             f"ring occupancy: mean {occupancy['mean']:.2f}, "
             f"last {occupancy['last']:g}"
+        )
+    depth_gauges = {
+        name.rsplit("/", 1)[-1]: g["last"]
+        for name, g in agg["gauges"].items()
+        if name.startswith("scheduler/depth/")
+    }
+    if depth_gauges or agg.get("depth_changes"):
+        changes = agg.get("depth_changes") or []
+        final = ", ".join(
+            f"{k}={v:g}" for k, v in sorted(depth_gauges.items())
+        )
+        print(
+            f"adaptive scheduler: {len(changes)} depth change(s)"
+            + (f"; final adapted depths: {final}" if final else "")
         )
     builds = agg["counters"].get("compile_cache/builds")
     hits = agg["counters"].get("compile_cache/hits")

@@ -38,6 +38,11 @@ PIPELINE-OWNED; the executor passes it to ``infer_async(consume=True)``
 and the program donates (invalidates) its buffer. Callers keep ownership
 of everything they pass in at the API surface (``pipeline_chunks`` stages
 internally; it never donates caller arrays).
+
+This module is the STATIC primitive layer: fixed depths, chosen by the
+caller. flow/scheduler.py builds the adaptive unified scheduler on the
+same spans and the same ownership contract (and reuses ``_drain_host``);
+``CHUNKFLOW_SCHED=static`` routes everything back here bit-identically.
 """
 from __future__ import annotations
 

@@ -37,13 +37,26 @@ class PipelineState:
 def drain_pending_writes(task: Optional[dict]) -> None:
     """Block until every async storage write attached to the task is
     durable. Barrier points: task ack (delete-task-in-queue,
-    mark-complete) and end-of-pipeline — the ack-after-durable-write
-    commit protocol must hold even with ``save-precomputed
-    --async-write``."""
+    mark-complete), the adaptive scheduler's write-behind window
+    (flow/scheduler.py), and end-of-pipeline — the
+    ack-after-durable-write commit protocol must hold even with
+    ``save-precomputed --async-write``.
+
+    Every future is drained even when one fails: an exception mid-drain
+    must not abandon the remaining writes un-awaited (they would race
+    process teardown, and their errors would vanish). All exceptions are
+    collected and the first re-raised."""
     if not task:
         return
+    first_exc: Optional[BaseException] = None
     for future in task.pop("pending_writes", []):
-        future.result()
+        try:
+            future.result()
+        except BaseException as exc:
+            if first_exc is None:
+                first_exc = exc
+    if first_exc is not None:
+        raise first_exc
 
 
 def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
@@ -51,7 +64,24 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
 
     Each stage maps an iterator of tasks to an iterator of tasks.
     Returns the number of tasks that reached the end of the pipeline.
+
+    Under the adaptive scheduler (CHUNKFLOW_SCHED, flow/scheduler.py) a
+    write-behind window is appended as the terminal stage: instead of
+    blocking on each task's async storage writes at the end-of-pipeline
+    barrier, up to ``write``-depth tasks ride with their commits in
+    flight while newer tasks compute. The per-task drain below then
+    sees already-durable tasks (a no-op barrier); commit ordering is
+    unchanged. ``CHUNKFLOW_SCHED=static`` restores the exact historical
+    chain.
     """
+    from chunkflow_tpu.flow.scheduler import (
+        scheduler_mode,
+        write_behind_stage,
+    )
+
+    stages = list(stages)
+    if scheduler_mode() == "adaptive":
+        stages.append(write_behind_stage())
     stream: Iterator[dict] = iter([new_task()])
     for stage in stages:
         stream = stage(stream)

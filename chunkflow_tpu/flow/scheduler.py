@@ -1,0 +1,628 @@
+"""Unified adaptive pipeline scheduler: the full task lifecycle as one
+bounded-queue executor with telemetry-driven depth control.
+
+The worker's value proposition is keeping the accelerator busy while
+petabyte-scale IO happens around it (PAPER §3: load → inference → save
+per task), yet until this module the overlap machinery was three
+independent mechanisms composed by hand — ``prefetch_stage``
+(runtime.py), the double-buffered device pipeline (pipeline.py), and
+``save --async-write`` — each with a fixed, hand-picked depth and no
+shared backpressure. PR 3's stall attribution tells us *which* phase
+dominates; nothing consumed that signal. This module closes the loop:
+
+    upstream (load ops) ──► prefetch queue ──► H2D staging ring ──►
+    device compute ──► D2H drain + host post-processing (worker pool)
+    ──► downstream (save ops) ──► write-behind window (async commits)
+
+Every arrow is a bounded queue; every bound is a **depth knob** a small
+controller (:class:`DepthController`) widens at runtime by reading the
+telemetry stall shares (core/telemetry.py) every N tasks:
+
+=====================  =======================  =========================
+dominant stall phase   meaning                  knob raised
+=====================  =======================  =========================
+scheduler/load         upstream IO starves us   ``prefetch`` (pull ahead)
+pipeline/stage         H2D transfers wait       ``prefetch``
+pipeline/dispatch      trace/compile            none (see retrace watchdog)
+pipeline/compute       the chip is the limit    none — that's the goal
+pipeline/drain         D2H + host side lag      ``post`` and ``write``
+scheduler/post         host post ops lag        ``post``
+scheduler/write        storage commits lag      ``write``
+=====================  =======================  =========================
+
+Growth is bounded by a hard host-memory watermark
+(``CHUNKFLOW_SCHED_MEM_GB``, default 4): the controller estimates
+resident bytes as (sum of depths) x (largest chunk seen) and refuses any
+raise that would cross it — graceful fallback to the static initial
+depths (``--async-depth`` / ``--prefetch-depth`` on the CLI). With
+telemetry off (``CHUNKFLOW_TELEMETRY=0``) there is no stall signal, so
+the depths simply stay static.
+
+Kill switch: ``CHUNKFLOW_SCHED=static`` removes this module from the hot
+path entirely — the CLI composes the PR 2 primitives exactly as before
+(bit-identical, by construction), and ``Inferencer.stream`` falls back
+to ``pipeline_chunks``. Outputs are bit-identical either way (same
+compiled programs, same staging ownership contract); only wall-clock and
+timer attribution differ.
+
+Ownership contract is inherited from flow/pipeline.py: buffers staged by
+the executor are donated into the program (``consume=True``); anything
+that arrived already device-resident stays caller-owned.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Iterator, Optional
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.flow.pipeline import _drain_host
+
+__all__ = [
+    "scheduler_mode", "mem_watermark_bytes", "DepthController",
+    "schedule_chunks", "scheduled_inference_stage", "write_behind_stage",
+]
+
+_OFF_VALUES = ("static", "0", "off", "false", "no")
+
+
+def scheduler_mode() -> str:
+    """``adaptive`` (default) or ``static`` (``CHUNKFLOW_SCHED=static``
+    kill switch: today's hand-composed pipeline, bit-identically).
+    Re-read per call so tests and long-lived workers can flip it."""
+    value = os.environ.get("CHUNKFLOW_SCHED", "adaptive").lower()
+    return "static" if value in _OFF_VALUES else "adaptive"
+
+
+def mem_watermark_bytes() -> int:
+    """Hard host-memory watermark for adaptive depth growth
+    (``CHUNKFLOW_SCHED_MEM_GB``, default 4 GB). The controller never
+    widens a depth past it; a malformed value falls back to the
+    default rather than disabling backpressure."""
+    raw = os.environ.get("CHUNKFLOW_SCHED_MEM_GB", "")
+    try:
+        gb = float(raw) if raw else 4.0
+    except ValueError:
+        gb = 4.0
+    return int(gb * (1 << 30))
+
+
+def _controller_interval() -> int:
+    """Tasks between controller ticks (``CHUNKFLOW_SCHED_INTERVAL``,
+    default 4)."""
+    try:
+        return max(1, int(os.environ.get("CHUNKFLOW_SCHED_INTERVAL", "4")))
+    except ValueError:
+        return 4
+
+
+#: initial depths when the caller does not override them; the CLI wires
+#: --prefetch-depth / --async-depth in as initial values
+DEFAULT_DEPTHS = {
+    "prefetch": 2,  # tasks pulled ahead from upstream (load overlap)
+    "ring": 2,      # staged-ahead H2D inputs (the PR 2 double buffer)
+    "inflight": 2,  # dispatched-but-undrained device outputs
+    "post": 2,      # drain + host post-processing tasks in the worker pool
+    "write": 2,     # tasks with storage writes still in flight
+}
+
+#: growth ceilings — past these, more depth is more memory for no overlap
+DEPTH_LIMITS = {
+    "prefetch": 8, "ring": 4, "inflight": 8, "post": 4, "write": 8,
+}
+
+#: stall phase -> knobs the controller widens when that phase dominates
+PHASE_KNOBS = {
+    "scheduler/load": ("prefetch",),
+    "pipeline/stage": ("prefetch",),
+    "pipeline/dispatch": (),  # compile time: a knob can't help (watchdog can)
+    "pipeline/compute": (),   # device-bound is the design goal
+    "pipeline/drain": ("post", "write"),
+    "scheduler/post": ("post",),
+    "scheduler/write": ("write",),
+}
+
+
+class DepthController:
+    """Widens the dominant-stall stage's depth under a memory watermark.
+
+    Pure decision logic: :meth:`tick` takes *cumulative* per-phase stall
+    totals (seconds) and mutates :attr:`depths`; :meth:`observe_task`
+    is the executor-facing wrapper that samples the process telemetry
+    registry every ``interval`` completed tasks. Unit-testable on
+    synthetic stall streams without any executor or clock.
+    """
+
+    PHASES = tuple(PHASE_KNOBS)
+
+    def __init__(self, depths: Optional[dict] = None,
+                 limits: Optional[dict] = None,
+                 interval: Optional[int] = None,
+                 watermark_bytes: Optional[int] = None,
+                 min_share: float = 0.4):
+        self.depths = dict(DEFAULT_DEPTHS)
+        if depths:
+            self.depths.update(
+                {k: max(1, int(v)) for k, v in depths.items()})
+        # a caller-raised initial depth also raises that knob's ceiling:
+        # explicit static configuration outranks the built-in caps
+        self.limits = {
+            k: max(v, self.depths.get(k, 0))
+            for k, v in dict(DEPTH_LIMITS, **(limits or {})).items()
+        }
+        self.initial = dict(self.depths)
+        self.interval = interval if interval else _controller_interval()
+        self.watermark_bytes = (
+            watermark_bytes if watermark_bytes is not None
+            else mem_watermark_bytes()
+        )
+        self.min_share = min_share
+        self.changes: list = []  # (task_index, knob, old, new)
+        self._slot_bytes = 0
+        self._tasks = 0
+        # baseline at construction: deltas measure THIS run's stalls, not
+        # whatever the process-global registry accumulated before us
+        self._last_totals = telemetry.hist_totals(self.PHASES)
+
+    # -- memory model ---------------------------------------------------
+    def note_slot_bytes(self, nbytes: int) -> None:
+        """Feed the observed chunk payload size; the watermark check uses
+        the largest slot seen (conservative: every depth unit may hold
+        one input and one output of that size)."""
+        self._slot_bytes = max(self._slot_bytes, int(nbytes))
+
+    def resident_slots(self) -> int:
+        return sum(self.depths.values())
+
+    def _would_fit(self) -> bool:
+        # 2x: each slot can pin an input and an output chunk at once
+        per_slot = 2 * max(self._slot_bytes, 1)
+        return (self.resident_slots() + 1) * per_slot <= self.watermark_bytes
+
+    # -- decision -------------------------------------------------------
+    def tick(self, totals: dict) -> list:
+        """One controller step over *cumulative* per-phase stall totals.
+        Returns the list of (knob, old, new) changes applied (empty when
+        nothing dominates, the watermark blocks growth, or the dominant
+        phase has no knob)."""
+        deltas = {
+            phase: max(0.0, float(totals.get(phase, 0.0))
+                       - self._last_totals.get(phase, 0.0))
+            for phase in self.PHASES
+        }
+        self._last_totals = {
+            phase: float(totals.get(phase, self._last_totals.get(phase, 0.0)))
+            for phase in self.PHASES
+        }
+        window = sum(deltas.values())
+        if window <= 0.0:
+            return []
+        dominant = max(deltas, key=deltas.get)
+        if deltas[dominant] / window < self.min_share:
+            return []  # no clear bottleneck: depths are matched, stand pat
+        applied = []
+        for knob in PHASE_KNOBS[dominant]:
+            old = self.depths[knob]
+            if old >= self.limits[knob] or not self._would_fit():
+                continue  # ceiling or watermark: graceful static fallback
+            self.depths[knob] = old + 1
+            applied.append((knob, old, old + 1))
+            self.changes.append((self._tasks, knob, old, old + 1))
+            telemetry.event(
+                "depth_change", f"scheduler/{knob}", old=old, new=old + 1,
+                tasks=self._tasks, dominant=dominant,
+                share=round(deltas[dominant] / window, 3),
+            )
+            telemetry.gauge(f"scheduler/depth/{knob}", old + 1)
+        return applied
+
+    def observe_task(self) -> list:
+        """Count one completed task; every ``interval`` tasks, read the
+        telemetry registry and :meth:`tick`. With telemetry disabled the
+        totals stay zero and the depths stay static — the documented
+        graceful fallback."""
+        self._tasks += 1
+        if self._tasks % self.interval:
+            return []
+        return self.tick(telemetry.hist_totals(self.PHASES))
+
+
+# ---------------------------------------------------------------------------
+# bounded handoff queue with live-adjustable capacity
+# ---------------------------------------------------------------------------
+_END = object()
+
+
+def _is_end(item) -> bool:
+    return isinstance(item, tuple) and len(item) == 2 and item[0] is _END
+
+
+class _AdaptiveQueue:
+    """Producer/consumer handoff whose capacity the controller can raise
+    live (stdlib ``queue.Queue`` fixes ``maxsize`` at construction)."""
+
+    def __init__(self, capacity: int):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._items: deque = deque()
+        self._capacity = max(1, int(capacity))
+        self._closed = False
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._capacity = max(1, int(capacity))
+            self._not_full.notify_all()
+
+    def put(self, item) -> bool:
+        """Bounded put; returns False once the consumer has closed the
+        queue (producer should stop pulling upstream)."""
+        with self._not_full:
+            while len(self._items) >= self._capacity and not self._closed:
+                self._not_full.wait(0.1)
+            if self._closed:
+                return False
+            self._items.append(item)
+            self._not_empty.notify()
+            return True
+
+    def get(self):
+        with self._not_empty:
+            while not self._items:
+                self._not_empty.wait()
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def close(self) -> None:
+        """Consumer-side: unblock and retire the producer for good."""
+        with self._lock:
+            self._closed = True
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+
+def _pump(source: Iterator, q: _AdaptiveQueue) -> None:
+    """Producer body: pull upstream (this is where load-operator IO
+    actually runs) into the bounded queue; terminate with an (_END, exc)
+    sentinel on every path so the consumer never blocks forever."""
+    try:
+        for item in source:
+            if not q.put(item):
+                return  # consumer gone: stop pulling upstream
+    except BaseException as exc:  # propagate to the consumer thread
+        q.put((_END, exc))
+        return
+    q.put((_END, None))
+
+
+def _start_pump(source: Iterable, capacity: int):
+    q = _AdaptiveQueue(capacity)
+    thread = threading.Thread(
+        target=_pump, args=(iter(source), q), daemon=True
+    )
+    thread.start()
+    return q, thread
+
+
+def _chunk_nbytes(chunk) -> int:
+    arr = getattr(chunk, "array", chunk)
+    return int(getattr(arr, "nbytes", 0) or 0)
+
+
+# ---------------------------------------------------------------------------
+# chunk-level executor (powers Inferencer.stream)
+# ---------------------------------------------------------------------------
+def _adaptive_device_pipeline(inferencer, q: _AdaptiveQueue,
+                              ctl: DepthController, crop=None):
+    """Yield device-resident outputs (D2H riding) in input order, pulling
+    inputs from the prefetch queue; ring/inflight bounds re-read from the
+    controller every iteration so a mid-run widen takes effect."""
+    staged: deque = deque()    # (slot, pipeline_owned)
+    draining: deque = deque()  # dispatched outputs, D2H in flight
+    exhausted = False
+    while True:
+        while not exhausted and len(staged) < ctl.depths["ring"]:
+            with telemetry.span("scheduler/load"):
+                item = q.get()
+            if _is_end(item):
+                exhausted = True
+                if item[1] is not None:
+                    raise item[1]  # upstream failure re-raises here
+                break
+            ctl.note_slot_bytes(_chunk_nbytes(item))
+            with telemetry.span("pipeline/stage"):
+                slot = inferencer.stage(item)
+            # donate only buffers staged here; an already-device-resident
+            # chunk stays caller-owned (same contract as flow/pipeline.py)
+            staged.append((slot, slot is not item))
+            telemetry.gauge("pipeline/ring_occupancy", len(staged))
+        if not staged:
+            break
+        slot, owned = staged.popleft()
+        with telemetry.span("pipeline/dispatch"):
+            out = inferencer.infer_async(slot, crop=crop, consume=owned)
+        draining.append(out)
+        telemetry.gauge("pipeline/inflight", len(draining))
+        while len(draining) >= ctl.depths["inflight"]:
+            yield draining.popleft()
+    while draining:
+        yield draining.popleft()
+
+
+def schedule_chunks(
+    inferencer,
+    chunks: Iterable,
+    ring: int = 2,
+    crop=None,
+    postprocess: Optional[Callable] = None,
+    post_depth: int = 2,
+    prefetch_depth: int = 2,
+    controller: Optional[DepthController] = None,
+) -> Iterator:
+    """Adaptive drop-in for :func:`flow.pipeline.pipeline_chunks`: same
+    inputs, same input-order outputs, bit-identical results — plus an
+    upstream prefetch thread (the ``chunks`` iterable's own IO runs
+    ``prefetch_depth`` items ahead) and the drain + ``postprocess`` stage
+    always running in a worker pool, with every depth under controller
+    management. Abandoning the generator early cancels queued
+    (not-yet-started) post tasks and retires the prefetch thread."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    ctl = controller or DepthController(depths={
+        "prefetch": prefetch_depth, "ring": ring, "inflight": ring,
+        "post": post_depth,
+    })
+    q, thread = _start_pump(chunks, ctl.depths["prefetch"])
+    in_flight: deque = deque()
+    pool = ThreadPoolExecutor(max_workers=ctl.limits["post"])
+
+    def finalize(out):
+        host = _drain_host(out)
+        if postprocess is None:
+            return host
+        with telemetry.span("scheduler/post"):
+            return postprocess(host)
+
+    def complete(future):
+        result = future.result()
+        ctl.observe_task()
+        q.set_capacity(ctl.depths["prefetch"])
+        return result
+
+    try:
+        for out in _adaptive_device_pipeline(inferencer, q, ctl, crop=crop):
+            while len(in_flight) >= ctl.depths["post"]:
+                yield complete(in_flight.popleft())
+            in_flight.append(pool.submit(finalize, out))
+        while in_flight:
+            yield complete(in_flight.popleft())
+    finally:
+        # early close / error: stop the producer, drop queued host work
+        q.close()
+        for f in in_flight:
+            f.cancel()
+        pool.shutdown(wait=False)
+        thread.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# task-level executor (powers the CLI inference stage)
+# ---------------------------------------------------------------------------
+def scheduled_inference_stage(
+    inferencer,
+    depth: int = 2,
+    ring: int = 2,
+    prefetch_depth: int = 2,
+    input_name: str = "chunk",
+    output_name: str = "chunk",
+    op_name: str = "inference",
+    crop=None,
+    check: Optional[Callable] = None,
+    postprocess: Optional[Callable] = None,
+    controller: Optional[DepthController] = None,
+):
+    """The scheduler as a flow-runtime stage (iterator of tasks ->
+    iterator of tasks): adaptive superset of
+    :func:`flow.pipeline.pipelined_inference_stage`.
+
+    Differences from the static stage: upstream stages run in a prefetch
+    thread ``prefetch_depth`` tasks ahead (load IO overlaps device time
+    without a separate ``prefetch`` command); the drain-and-materialize
+    step (plus optional ``postprocess`` on the output chunk) runs in a
+    worker pool so host post-processing hides behind the next task's
+    device time; and every bound widens under the controller.
+
+    Ordering/failure contract matches the static stage: results yield in
+    input order; a ``None`` skip marker flushes all in-flight work first;
+    a mid-stream exception flushes already-dispatched tasks downstream —
+    they may already have side effects pending — then re-raises. A
+    failing ``postprocess`` likewise flushes the surviving in-flight
+    tasks before re-raising, so no staged device buffer or pending write
+    is stranded.
+    """
+    ctl_arg = controller
+
+    def stage_fn(stream):
+        from concurrent.futures import ThreadPoolExecutor
+
+        ctl = ctl_arg or DepthController(depths={
+            "prefetch": prefetch_depth, "ring": ring, "inflight": depth,
+        })
+        q, thread = _start_pump(stream, ctl.depths["prefetch"])
+        staged: deque = deque()     # (task, slot, owned, t0)
+        pending: deque = deque()    # (task, device_out, t0)
+        finishing: deque = deque()  # post-pool futures, input order
+        pool = ThreadPoolExecutor(max_workers=ctl.limits["post"])
+
+        def finalize(task, out, t0):
+            # runs in the pool: compute/drain attribution rides along
+            # (spans are thread-safe), the GIL is released inside the
+            # block_until_ready / D2H waits
+            result = _drain_host(out)
+            if postprocess is not None:
+                with telemetry.span("scheduler/post"):
+                    result = postprocess(result)
+            task[output_name] = result
+            task["log"]["timer"][op_name] = time.time() - t0
+            task["log"]["compute_device"] = inferencer.compute_device
+            return task
+
+        def dispatch_one():
+            task, slot, owned, t0 = staged.popleft()
+            with telemetry.span("pipeline/dispatch"):
+                out = inferencer.infer_async(slot, crop=crop, consume=owned)
+            pending.append((task, out, t0))
+            telemetry.gauge("pipeline/inflight", len(pending))
+
+        def submit_one():
+            task, out, t0 = pending.popleft()
+            finishing.append(pool.submit(finalize, task, out, t0))
+
+        def complete():
+            task = finishing.popleft().result()
+            ctl.observe_task()
+            q.set_capacity(ctl.depths["prefetch"])
+            return task
+
+        try:
+            try:
+                while True:
+                    with telemetry.span("scheduler/load"):
+                        item = q.get()
+                    if _is_end(item):
+                        if item[1] is not None:
+                            raise item[1]
+                        break
+                    if item is None:
+                        # preserve order: flush in-flight work before
+                        # passing the skip marker downstream
+                        while staged:
+                            dispatch_one()
+                        while pending:
+                            submit_one()
+                        while finishing:
+                            yield complete()
+                        yield None
+                        continue
+                    task = item
+                    chunk = task[input_name]
+                    if check is not None:
+                        check(chunk)
+                    ctl.note_slot_bytes(_chunk_nbytes(chunk))
+                    with telemetry.span("pipeline/stage"):
+                        slot = inferencer.stage(chunk)
+                    staged.append(
+                        (task, slot, slot is not chunk, time.time()))
+                    telemetry.gauge("pipeline/ring_occupancy", len(staged))
+                    if len(staged) >= ctl.depths["ring"]:
+                        # drain BEFORE dispatching so at most `inflight`
+                        # outputs are device-resident (the memory bound)
+                        while len(pending) >= ctl.depths["inflight"]:
+                            submit_one()
+                        dispatch_one()
+                    while len(finishing) > ctl.depths["post"]:
+                        yield complete()
+            except Exception:
+                # mid-stream failure (bad grid, upstream error, poisoned
+                # post op): push everything that can still complete
+                # downstream — the synchronous path would have saved it —
+                # then re-raise the original. (except, not finally: a
+                # yield in finally would break generator close().)
+                while staged:
+                    dispatch_one()
+                while pending:
+                    submit_one()
+                while finishing:
+                    try:
+                        task = complete()
+                    except Exception:
+                        continue  # this task failed too; first error wins
+                    yield task
+                raise
+            while staged:
+                while len(pending) >= ctl.depths["inflight"]:
+                    submit_one()
+                dispatch_one()
+            while pending:
+                submit_one()
+            while finishing:
+                yield complete()
+        finally:
+            q.close()
+            pool.shutdown(wait=False)
+            thread.join(timeout=5.0)
+
+    return stage_fn
+
+
+# ---------------------------------------------------------------------------
+# write-behind (terminal stage; commit-protocol draining)
+# ---------------------------------------------------------------------------
+def write_behind_stage(window: int = 2,
+                       controller: Optional[DepthController] = None):
+    """Bound tasks with in-flight async storage writes instead of
+    blocking per task: up to ``window`` (controller knob ``write``) tasks
+    ride with undurable writes while newer tasks compute; the oldest
+    task's futures drain (``scheduler/write`` span) before it flows on.
+
+    The ack-after-durable-write commit protocol holds: a task leaves
+    this stage only with its writes durable, and every exit path —
+    normal drain, downstream error, generator close — drains the
+    remaining buffered futures (the hardened
+    :func:`runtime.drain_pending_writes` collects all exceptions and
+    re-raises the first). ``delete-task-in-queue`` drains its own task
+    *before* acking as always, so queue-fed pipelines keep their
+    per-task commit point; the window pays off in pipelines whose drain
+    barrier is the pipeline end. Tasks without pending writes pass
+    straight through when nothing is buffered."""
+    from chunkflow_tpu.flow.runtime import drain_pending_writes
+
+    ctl_arg = controller
+
+    def stage_fn(stream):
+        ctl = ctl_arg or DepthController(depths={"write": window})
+        buffered: deque = deque()
+
+        def drain_oldest():
+            task = buffered.popleft()
+            with telemetry.span("scheduler/write"):
+                drain_pending_writes(task)
+            ctl.observe_task()
+            return task
+
+        try:
+            for task in stream:
+                if task is None or not task.get("pending_writes"):
+                    # preserve order: anything buffered commits first
+                    while buffered:
+                        yield drain_oldest()
+                    yield task
+                    continue
+                buffered.append(task)
+                telemetry.gauge("scheduler/write_window", len(buffered))
+                while len(buffered) > ctl.depths["write"]:
+                    yield drain_oldest()
+            while buffered:
+                yield drain_oldest()
+        except BaseException:
+            # teardown with an error (or GeneratorExit) in flight: the
+            # buffered tasks can no longer flow downstream, but their
+            # writes must still commit — ack-after-durable-write does
+            # not bend for error paths. The propagating exception wins;
+            # drain failures are reported, not raised over it.
+            while buffered:
+                task = buffered.popleft()
+                try:
+                    drain_pending_writes(task)
+                except Exception as exc:
+                    print(
+                        f"write-behind: pending write failed during "
+                        f"teardown: {exc!r}", file=sys.stderr,
+                    )
+            raise
+
+    return stage_fn

@@ -58,4 +58,14 @@ echo "== telemetry overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py telemetry_overhead || rc=$((rc == 0 ? 1 : rc))
 stage_time "telemetry overhead gate"
+
+# --- e2e overlap gate ------------------------------------------------------
+# Serial vs adaptive-scheduler wall time over the full task lifecycle
+# (load → compute → post → write, docs/performance.md "Adaptive
+# scheduler"). Reports the >=1.4x target as gate_pass (asserted
+# best-of-3 in tests/test_bench.py); the process only fails below 1.1x.
+echo "== e2e overlap gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py e2e_overlap || rc=$((rc == 0 ? 1 : rc))
+stage_time "e2e overlap gate"
 exit $rc

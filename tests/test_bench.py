@@ -95,6 +95,57 @@ def test_pipeline_overlap_microbench(tmp_path):
     ), best.get("telemetry_jsonl")
 
 
+def test_e2e_overlap_microbench(tmp_path):
+    """The adaptive scheduler must beat the serial full-lifecycle loop
+    (load → compute → post → write) on the calibrated synthetic CPU
+    workload (ISSUE 4 acceptance: >= 1.4x) and stay bit-identical —
+    run_e2e_overlap itself raises on divergence or broken task order.
+
+    Fresh-subprocess pattern from the pipeline_overlap gate: inside the
+    suite's interpreter the ratio is contaminated by conftest's 8-device
+    virtual mesh; best-of-3 guards against load spikes on a shared CI
+    box."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)  # the 8-device virtual mesh (conftest.py)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "e2e_overlap"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] > best["value"]:
+            best = stats
+        if best["value"] >= 1.4:
+            break
+    assert best["value"] >= 1.4, best
+    assert best["metric"] == "e2e_overlap_speedup"
+    assert best["gate_pass"] is True, best
+    assert best["scheduled_s"] < best["serial_s"], best
+    # the JSON line reports the final adapted depths, and the run's own
+    # telemetry JSONL (incl. the scheduler/final depths event) landed
+    # where we pointed it
+    assert set(best["final_depths"]) == {
+        "prefetch", "ring", "inflight", "post", "write"
+    }, best
+    jsonls = [n for n in os.listdir(tmp_path) if n.endswith(".jsonl")]
+    assert jsonls, best.get("telemetry_jsonl")
+    events = []
+    for name in jsonls:
+        with open(os.path.join(tmp_path, name)) as f:
+            events += [json.loads(line) for line in f if line.strip()]
+    finals = [e for e in events
+              if e.get("kind") == "depths" and e["name"] == "scheduler/final"]
+    assert finals, "no scheduler/final depths event in the run's JSONL"
+
+
 def test_cfg_names_unique():
     names = [bench._cfg_name(c) for c in bench.CONFIGS]
     assert len(names) == len(set(names)), names

@@ -109,3 +109,97 @@ def test_tunnel_step_never_resume_skipped(tv):
     assert fake_tunnel()
     assert fake_tunnel()
     assert len(calls) == 2  # liveness gate re-runs every attempt
+
+
+def test_record_writes_trailing_newline(tv):
+    """ADVICE r5: frozen snapshots are committed text files — every
+    results write must end with a newline."""
+    mod, results = tv
+    mod.record("some_step", {"ok": True})
+    assert results.read_text().endswith("\n")
+
+
+def test_failed_tunnel_retry_preserves_banked_tunnel_row(tv):
+    """ADVICE r5: a failed tunnel retry must not overwrite the ok tunnel
+    row from the attempt that banked the measurements — it banks under
+    tunnel_last_retry instead, so a frozen snapshot stays internally
+    consistent."""
+    mod, results = tv
+    mod.record("tunnel", {"ok": True, "value": "live", "commit": "aaaa111",
+                          "platform": "tpu"})
+    mod.RESULTS["tunnel"] = json.loads(results.read_text())["tunnel"]
+
+    @mod.step("tunnel")
+    def dead_tunnel():
+        raise RuntimeError("Connection refused")
+
+    assert not dead_tunnel()
+    data = json.loads(results.read_text())
+    assert data["tunnel"]["ok"] is True  # the banked row survived
+    assert data["tunnel"]["commit"] == "aaaa111"
+    retry = data["tunnel_last_retry"]
+    assert retry["ok"] is False
+    assert "Connection refused" in retry["error"]
+
+
+def test_failed_tunnel_with_no_prior_success_records_failure(tv):
+    mod, results = tv
+
+    @mod.step("tunnel")
+    def dead_tunnel():
+        raise RuntimeError("Connection refused")
+
+    assert not dead_tunnel()
+    data = json.loads(results.read_text())
+    assert data["tunnel"]["ok"] is False
+
+
+def test_freeze_snapshot_stamps_tunnel_retry_note(tv, tmp_path):
+    """A freeze whose tunnel row is a later failed retry (the r05
+    inconsistency) must say so in _meta and end with a newline."""
+    mod, results = tv
+    live = {
+        "tunnel": {"ok": False, "commit": "bbbb222", "platform": "",
+                   "error": "Connection refused"},
+        "bench_flagship": {"ok": True, "commit": "aaaa111",
+                           "platform": "tpu", "value": {"mvox_s": 2.0}},
+    }
+    results.write_text(json.dumps(live))
+    dest = tmp_path / "frozen.json"
+    mod.freeze_snapshot(str(dest))
+    text = dest.read_text()
+    assert text.endswith("\n")
+    frozen = json.loads(text)
+    note = frozen["_meta"]["tunnel_row_note"]
+    assert "LAST RETRY" in note
+    assert "bbbb222" in note and "aaaa111" in note
+    # the data rows themselves are untouched
+    assert frozen["tunnel"] == live["tunnel"]
+    assert frozen["bench_flagship"] == live["bench_flagship"]
+
+
+def test_freeze_snapshot_consistent_run_gets_no_note(tv, tmp_path):
+    mod, results = tv
+    live = {
+        "tunnel": {"ok": True, "commit": "aaaa111", "platform": "tpu",
+                   "value": "live"},
+        "bench_flagship": {"ok": True, "commit": "aaaa111",
+                           "platform": "tpu", "value": {"mvox_s": 2.0}},
+    }
+    results.write_text(json.dumps(live))
+    dest = tmp_path / "frozen.json"
+    mod.freeze_snapshot(str(dest))
+    frozen = json.loads(dest.read_text())
+    assert "tunnel_row_note" not in frozen["_meta"]
+    assert frozen["_meta"]["measured_at_commit"]  # provenance stamped
+    assert dest.read_text().endswith("\n")
+
+
+def test_committed_r05_snapshot_is_consistent():
+    """The r05 snapshot this advisory was about: now carries the
+    tunnel-row note and a trailing newline."""
+    path = _TV_PATH.parent / "tpu_validation_r05.json"
+    text = path.read_text()
+    assert text.endswith("\n")
+    data = json.loads(text)
+    assert "tunnel_row_note" in data["_meta"]
