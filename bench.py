@@ -589,6 +589,192 @@ def run_e2e_overlap(
     }
 
 
+def run_resilience_overhead(
+    n_tasks: int = 8,
+    chunk_size=(32, 128, 128),
+    input_patch=(16, 64, 64),
+    overlap=(4, 16, 16),
+    repeats: int = 3,
+) -> dict:
+    """Wall-clock cost of the fault-tolerance layer (ISSUE 5): the same
+    queue-fed e2e_overlap-style chain — simulated storage read,
+    adaptive-scheduled inference, simulated post + async write,
+    ack-after-durable-write — run with the lifecycle machinery OFF
+    (plain fetch + delete) vs ON (supervised claims + FileLedger
+    done-markers + lease heartbeat + supervised commit). Both legs pay
+    the queue and ack; the delta is exactly the insurance: ledger
+    check/mark, heartbeat thread, retry accounting. Gate: < 3% overhead
+    (reported as gate_pass; the process only hard-fails past 15% —
+    shared-box noise must not redden CI, a real regression must).
+    Best-of-``repeats`` per leg for the same reason."""
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.flow.runtime import drain_pending_writes, new_task
+    from chunkflow_tpu.flow.scheduler import (
+        DepthController,
+        scheduled_inference_stage,
+        write_behind_stage,
+    )
+    from chunkflow_tpu.inference import Inferencer
+    from chunkflow_tpu.parallel.lifecycle import (
+        FileLedger,
+        LifecycleSupervisor,
+    )
+    from chunkflow_tpu.parallel.queues import MemoryQueue
+
+    telemetry.configure(_bench_metrics_dir())
+
+    inferencer = Inferencer(
+        input_patch_size=input_patch,
+        output_patch_overlap=overlap,
+        num_output_channels=3,
+        framework="identity",
+        batch_size=4,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    chunks = [
+        Chunk(rng.random(chunk_size, dtype=np.float32))
+        for _ in range(n_tasks)
+    ]
+    bodies = [f"task-{i}" for i in range(n_tasks)]
+
+    # warmup + calibrate the simulated host phases to device time
+    np.asarray(inferencer(chunks[0]).array)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(inferencer(chunks[0]).array)
+        times.append(time.perf_counter() - t0)
+    phase_s = max(min(times), 0.02)
+
+    write_pool = ThreadPoolExecutor(max_workers=8)
+    scratch = tempfile.mkdtemp(prefix="chunkflow-resilience-")
+    leg_seq = 0
+
+    def post_fn(chunk):
+        time.sleep(phase_s)
+        return chunk
+
+    def run_leg(lifecycle_on: bool) -> float:
+        nonlocal leg_seq
+        leg_seq += 1
+        queue = MemoryQueue(f"resilience-{leg_seq}", visibility_timeout=600)
+        queue.send_messages(bodies)
+        queue.retry_sleep = 0.001
+        queue.max_empty_retries = 2
+        index = {body: i for i, body in enumerate(bodies)}
+        supervisor = (
+            LifecycleSupervisor(
+                queue,
+                ledger=FileLedger(os.path.join(scratch, f"ledger-{leg_seq}")),
+                max_retries=3,
+                lease_renew=0.2,
+            )
+            if lifecycle_on else None
+        )
+
+        def source(stream):
+            for _seed in stream:
+                if supervisor is not None:
+                    for lc in supervisor.tasks(num=n_tasks):
+                        time.sleep(phase_s)  # simulated storage read
+                        task = new_task()
+                        task["chunk"] = chunks[index[lc.body]]
+                        task["i"] = index[lc.body]
+                        task["lifecycle"] = lc
+                        lc.task = task
+                        yield task
+                else:
+                    pulled = 0
+                    for handle, body in queue:
+                        time.sleep(phase_s)
+                        task = new_task()
+                        task["chunk"] = chunks[index[body]]
+                        task["i"] = index[body]
+                        task["task_handle"] = handle
+                        yield task
+                        pulled += 1
+                        if pulled >= n_tasks:  # symmetric with num=
+                            break
+
+        def attach_write(stream):
+            for task in stream:
+                if task is not None:
+                    task.setdefault("pending_writes", []).append(
+                        write_pool.submit(time.sleep, phase_s))
+                yield task
+
+        def ack(stream):
+            # ack-after-durable-write in both legs: the commit point is
+            # shared cost, the ledger/heartbeat delta is what we measure
+            for task in stream:
+                if task is not None:
+                    if lifecycle_on:
+                        task["lifecycle"].commit(task)
+                    else:
+                        drain_pending_writes(task)
+                        queue.delete(task["task_handle"])
+                yield task
+
+        stages = [
+            source,
+            scheduled_inference_stage(
+                inferencer, postprocess=post_fn,
+                controller=DepthController(), op_name="inference",
+            ),
+            attach_write,
+            ack,
+            write_behind_stage(controller=DepthController()),
+        ]
+        t0 = time.perf_counter()
+        stream = iter([new_task()])
+        for stage in stages:
+            stream = stage(stream)
+        order = [task["i"] for task in stream]
+        elapsed = time.perf_counter() - t0
+        if order != list(range(n_tasks)):
+            raise RuntimeError(f"task order broken: {order}")
+        if len(queue) != 0 or queue.invisible:
+            raise RuntimeError("queue not drained cleanly")
+        if supervisor is not None:
+            marks = supervisor.ledger.keys()
+            if sorted(marks) != sorted(bodies):
+                raise RuntimeError(
+                    f"ledger incomplete: {len(marks)}/{n_tasks} markers"
+                )
+        return elapsed
+
+    try:
+        off_s = min(run_leg(False) for _ in range(repeats))
+        on_s = min(run_leg(True) for _ in range(repeats))
+    finally:
+        write_pool.shutdown(wait=False)
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    telemetry.flush()
+    events_path = telemetry.configured_path()
+    telemetry.configure(None)
+    overhead_pct = (on_s / off_s - 1.0) * 100.0
+    return {
+        "metric": "resilience_overhead",
+        "value": round(overhead_pct, 2),
+        "unit": "pct_vs_unsupervised",
+        "off_s": round(off_s, 3),
+        "on_s": round(on_s, 3),
+        "n_tasks": n_tasks,
+        "repeats": repeats,
+        "phase_s": round(phase_s, 4),
+        "gate_pct": 3.0,
+        "gate_pass": overhead_pct < 3.0,
+        "telemetry_jsonl": events_path,
+    }
+
+
 def _check_pallas_oracle():
     """Identity-engine oracle at toy size: catches a miscompiled pallas
     scatter kernel (wrong results, not just crashes) before it can taint
@@ -937,7 +1123,8 @@ def parent_main() -> int:
 
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] in (
-        "pipeline_overlap", "telemetry_overhead", "e2e_overlap"
+        "pipeline_overlap", "telemetry_overhead", "e2e_overlap",
+        "resilience_overhead",
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -955,6 +1142,14 @@ def main() -> int:
             # suite asserts it best-of-3 in a fresh subprocess); hard
             # floor at 1.1x — below that the scheduler lost its overlap
             return 0 if result["value"] >= 1.1 else 4
+        if sys.argv[1] == "resilience_overhead":
+            result = run_resilience_overhead()
+            _emit(result)
+            # soft gate at the 3% target (reported as gate_pass), hard
+            # gate at 15%: the fault-tolerance layer must be ~free —
+            # a lock/fsync on the per-task path is a real regression,
+            # shared-box scheduling noise is not
+            return 0 if result["value"] < 15.0 else 4
         result = run_telemetry_overhead()
         _emit(result)
         # soft gate at the 2% target (reported), hard gate at 10x it:

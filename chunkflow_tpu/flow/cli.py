@@ -92,6 +92,13 @@ def main(mip, dry_run, verbose, profile_dir, metrics_dir):
                                 depth growth (default 4)
       CHUNKFLOW_SCHED_INTERVAL  tasks between depth-controller ticks
                                 (default 4)
+
+    \b
+    Fault tolerance (docs/fault_tolerance.md):
+      fetch-task-from-queue --max-retries/--lease-renew/--ledger runs
+      the worker supervised (contained retries, dead-letter, resume);
+      CHUNKFLOW_CHAOS injects seeded stage kills for drill runs
+      (testing/chaos.py).
     """
     from chunkflow_tpu.core import telemetry
 
@@ -462,8 +469,36 @@ def prefetch_cmd(depth, to_device):
               help="empty-queue polls before giving up (reference "
                    "sqs_queue.py:115-130)")
 @click.option("--num", type=int, default=-1, help="max tasks to process (-1: drain)")
-def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num):
+@click.option("--max-retries", type=int, default=None,
+              help="supervised mode (docs/fault_tolerance.md): a task "
+                   "failure no longer kills the worker — it retries with "
+                   "exponential backoff up to this many failed attempts, "
+                   "then moves to the dead-letter store with its failure "
+                   "reason (inspect via `chunkflow dead-letter`)")
+@click.option("--lease-renew", type=float, default=0.0,
+              help="lease heartbeat interval in seconds: renew the "
+                   "claimed task's visibility while it is in compute so "
+                   "a slow chunk is not double-claimed (0: off; "
+                   "visibility-timeout/3 is a good value)")
+@click.option("--ledger", type=str, default=None,
+              help="durable completion ledger (memory://name or a "
+                   "directory): committed tasks are skipped idempotently "
+                   "on requeue/replay, so an interrupted run resumes "
+                   "from where it died")
+@click.option("--backoff-base", type=float, default=0.5,
+              help="first-retry backoff ceiling in seconds (doubles per "
+                   "attempt, full jitter, capped at --backoff-cap)")
+@click.option("--backoff-cap", type=float, default=60.0)
+def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
+                   max_retries, lease_renew, ledger, backoff_base,
+                   backoff_cap):
     """Pull bbox tasks from a queue; ack via delete-task-in-queue.
+
+    With --max-retries / --lease-renew / --ledger the fetch runs under
+    the task lifecycle supervisor (parallel/lifecycle.py): contained
+    per-task retries, dead-letter for poison tasks, lease heartbeats,
+    idempotent resume, and graceful SIGTERM/SIGINT preemption (the
+    in-flight task is nacked back to the queue immediately).
 
     When the jax runtime spans processes (one inference program over a
     multi-host mesh), the task stream must be single-sourced: only the
@@ -472,6 +507,9 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num):
     run the compute collectives but skip writes and acks
     (runtime.is_mirror_task). The reference's workers never share a
     runtime, so its loop (sqs_queue.py:115-130) has no such mode."""
+    supervised = (
+        max_retries is not None or lease_renew > 0 or ledger is not None
+    )
 
     @generator
     def stage(task):
@@ -504,6 +542,41 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num):
 
         queue = open_queue(queue_name, visibility_timeout=visibility_timeout)
         queue.max_empty_retries = retry_times
+
+        if supervised and not crosshost:
+            from chunkflow_tpu.parallel import lifecycle
+
+            supervisor = lifecycle.LifecycleSupervisor(
+                queue,
+                ledger=lifecycle.open_ledger(ledger) if ledger else None,
+                max_retries=3 if max_retries is None else max_retries,
+                lease_renew=lease_renew,
+                backoff_base=backoff_base,
+                backoff_cap=backoff_cap,
+            )
+            for lc in supervisor.tasks(num=num):
+                t = new_task()
+                try:
+                    # a malformed body is the canonical poison task:
+                    # charge it (permanent → dead-letter), don't tear
+                    # down the other in-flight tasks' budgets
+                    t["bbox"] = BoundingBox.from_string(lc.body)
+                except BaseException as exc:
+                    lifecycle.tag_culprit(exc, lc)
+                    raise
+                t["queue"] = queue
+                t["task_handle"] = lc.handle
+                t["lifecycle"] = lc
+                lc.task = t
+                yield t
+            return
+        if supervised and crosshost:
+            print(
+                "fetch-task-from-queue: lifecycle supervision does not "
+                "compose with multi-host broadcast mode yet; running "
+                "unsupervised", file=sys.stderr,
+            )
+
         count = 0
         try:
             for handle, body in queue:
@@ -537,6 +610,13 @@ def delete_task_cmd(op_name, ):
     def stage(task):
         from chunkflow_tpu.flow.runtime import drain_pending_writes
 
+        lc = task.get("lifecycle")
+        if lc is not None and not state.dry_run:
+            # supervised task: the lifecycle commit is the ack — drain
+            # writes, mark the completion ledger, delete from the queue,
+            # stop the lease heartbeat (parallel/lifecycle.py)
+            lc.commit(task)
+            return task
         # the ack commits the task: every async write must be durable
         # first (--async-write saves attach futures to the task)
         drain_pending_writes(task)
@@ -546,6 +626,45 @@ def delete_task_cmd(op_name, ):
         return task
 
     return stage(_name=op_name)
+
+
+@main.command("dead-letter")
+@click.option("--queue-name", "-q", type=str, required=True)
+@click.option("--requeue/--inspect", default=False,
+              help="--requeue moves every dead-letter entry back to "
+                   "pending with a fresh retry budget; default is a "
+                   "read-only listing")
+def dead_letter_cmd(queue_name, requeue):
+    """Inspect or requeue a queue's dead-letter entries.
+
+    Poison tasks land here after --max-retries failed attempts (or a
+    permanent-class error), carrying their failure reason and delivery
+    count — the operator triages, fixes the cause, and requeues
+    (docs/fault_tolerance.md)."""
+
+    @generator
+    def stage(task):
+        from chunkflow_tpu.parallel.queues import open_queue
+
+        queue = open_queue(queue_name)
+        entries = queue.dead_letters()
+        if not entries:
+            print(f"dead-letter store of {queue_name} is empty")
+        else:
+            print(f"{len(entries)} dead-letter task(s) in {queue_name}:")
+            for entry in entries:
+                print(
+                    f"  {entry.get('body', '')}  "
+                    f"receives={entry.get('receives', 0)}  "
+                    f"reason={entry.get('reason', '')}"
+                )
+        if requeue and not state.dry_run:
+            n = queue.requeue_dead()
+            print(f"requeued {n} task(s)")
+        return
+        yield  # pragma: no cover
+
+    return stage()
 
 
 # ---------------------------------------------------------------------------

@@ -34,6 +34,16 @@ STALL_PHASES = (
     "scheduler/write",
 )
 
+#: fault-tolerance counters (parallel/lifecycle.py + testing/chaos.py),
+#: reported as their own block: on a preemptible fleet, "how many tasks
+#: retried / died / were ledger-skipped" is the convergence story
+LIFECYCLE_COUNTERS = (
+    "tasks/committed", "tasks/retried", "tasks/surrendered",
+    "tasks/dead_lettered", "tasks/preempted", "ledger/skips",
+    "lease/renewals", "lease/renew_failures", "pipeline/chain_rebuilds",
+    "chaos/injected",
+)
+
 
 def load_log_dir(log_dir: str) -> List[dict]:
     records = []
@@ -263,6 +273,20 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 )
         bound = max(agg["stall"], key=lambda p: agg["stall"][p]["share"])
         print(f"  -> dominant phase: {bound}")
+    fault = {
+        name: agg["counters"][name]
+        for name in LIFECYCLE_COUNTERS if agg["counters"].get(name)
+    }
+    if fault:
+        print("fault tolerance (docs/fault_tolerance.md):")
+        for name in LIFECYCLE_COUNTERS:
+            if name in fault:
+                print(f"  {name:<24} {fault[name]:>7g}")
+        if fault.get("tasks/dead_lettered"):
+            print(
+                "  -> dead-lettered tasks pending triage: inspect with "
+                "`chunkflow dead-letter -q <queue>`"
+            )
     occupancy = agg["gauges"].get("pipeline/ring_occupancy")
     if occupancy:
         print(

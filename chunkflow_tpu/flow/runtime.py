@@ -16,6 +16,7 @@ import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
 from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.testing import chaos
 
 DEFAULT_CHUNK_NAME = "chunk"
 
@@ -73,31 +74,58 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
     sees already-durable tasks (a no-op barrier); commit ordering is
     unchanged. ``CHUNKFLOW_SCHED=static`` restores the exact historical
     chain.
+
+    **Supervised mode** (``fetch-task-from-queue`` with
+    ``--max-retries`` / ``--ledger`` / ``--lease-renew``,
+    parallel/lifecycle.py): a task failure anywhere in the chain no
+    longer kills the worker. The lifecycle layer releases every
+    in-flight claimed task (retry with backoff, or dead-letter past the
+    budget), and this loop rebuilds the stage chain — stage callables
+    are reusable factories — and keeps draining the queue. Preemption
+    (SIGTERM/SIGINT) releases the in-flight tasks too (immediate
+    visibility nack + write flush) but re-raises: the worker is being
+    evicted, not retried. Without supervised tasks in flight the
+    historical crash-only behavior is unchanged.
     """
     from chunkflow_tpu.flow.scheduler import (
         scheduler_mode,
         write_behind_stage,
     )
+    from chunkflow_tpu.parallel import lifecycle
 
     stages = list(stages)
     if scheduler_mode() == "adaptive":
         stages.append(write_behind_stage())
-    stream: Iterator[dict] = iter([new_task()])
-    for stage in stages:
-        stream = stage(stream)
     count = 0
-    for task in stream:
-        count += 1
-        with telemetry.span("pipeline/ack_writes"):
-            drain_pending_writes(task)
-        telemetry.inc("pipeline/tasks")
-        if task is None:
-            telemetry.inc("pipeline/tasks_skipped")
-        if verbose and task is not None and task.get("log"):
-            timers = task["log"]["timer"]
-            total = sum(timers.values())
-            print(f"task complete; time per op (s): {timers} total={total:.3f}")
-    return count
+    while True:
+        stream: Iterator[dict] = iter([new_task()])
+        for stage in stages:
+            stream = stage(stream)
+        try:
+            for task in stream:
+                count += 1
+                with telemetry.span("pipeline/ack_writes"):
+                    drain_pending_writes(task)
+                telemetry.inc("pipeline/tasks")
+                if task is None:
+                    telemetry.inc("pipeline/tasks_skipped")
+                if verbose and task is not None and task.get("log"):
+                    timers = task["log"]["timer"]
+                    total = sum(timers.values())
+                    print(
+                        f"task complete; time per op (s): {timers} "
+                        f"total={total:.3f}"
+                    )
+        except BaseException as exc:
+            if not lifecycle.handle_failure(exc):
+                raise
+            # contained task failure: close what's left of the broken
+            # chain (stage finally-blocks retire their threads), then
+            # rebuild and continue — the queue redelivers after backoff
+            stream.close()
+            telemetry.inc("pipeline/chain_rebuilds")
+            continue
+        return count
 
 
 def operator(func: Callable) -> Callable:
@@ -122,8 +150,22 @@ def operator(func: Callable) -> Callable:
                     # the historical time.time() semantics)
                     sp = telemetry.span(f"op/{name}")
                     start = time.time()
-                    with sp:
-                        task = func(task, **kwargs)
+                    try:
+                        # fault-injection boundary: a seeded chaos plan
+                        # can kill any operator here (testing/chaos.py)
+                        # — the lifecycle supervisor must contain it
+                        chaos.chaos_point(f"op/{name}")
+                        with sp:
+                            task = func(task, **kwargs)
+                    except BaseException as exc:
+                        # charge the failure to THIS task, not the
+                        # whole in-flight window (lifecycle.tag_culprit)
+                        from chunkflow_tpu.parallel.lifecycle import (
+                            tag_culprit,
+                        )
+
+                        tag_culprit(exc, original)
+                        raise
                     if task is not None:
                         task["log"]["timer"][name] = (
                             sp.duration if telemetry.enabled()

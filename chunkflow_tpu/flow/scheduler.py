@@ -60,6 +60,8 @@ from typing import Callable, Iterable, Iterator, Optional
 
 from chunkflow_tpu.core import telemetry
 from chunkflow_tpu.flow.pipeline import _drain_host
+from chunkflow_tpu.parallel.lifecycle import tag_culprit as _tag_culprit
+from chunkflow_tpu.testing import chaos
 
 __all__ = [
     "scheduler_mode", "mem_watermark_bytes", "DepthController",
@@ -461,11 +463,19 @@ def scheduled_inference_stage(
         def finalize(task, out, t0):
             # runs in the pool: compute/drain attribution rides along
             # (spans are thread-safe), the GIL is released inside the
-            # block_until_ready / D2H waits
-            result = _drain_host(out)
-            if postprocess is not None:
-                with telemetry.span("scheduler/post"):
-                    result = postprocess(result)
+            # block_until_ready / D2H waits. Chaos boundary: an injected
+            # kill here surfaces through the future — the error-flush
+            # path below pushes the survivors downstream first, and the
+            # lifecycle supervisor contains the rest
+            try:
+                chaos.chaos_point("scheduler/post")
+                result = _drain_host(out)
+                if postprocess is not None:
+                    with telemetry.span("scheduler/post"):
+                        result = postprocess(result)
+            except BaseException as exc:
+                _tag_culprit(exc, task)
+                raise
             task[output_name] = result
             task["log"]["timer"][op_name] = time.time() - t0
             task["log"]["compute_device"] = inferencer.compute_device
@@ -473,8 +483,14 @@ def scheduled_inference_stage(
 
         def dispatch_one():
             task, slot, owned, t0 = staged.popleft()
-            with telemetry.span("pipeline/dispatch"):
-                out = inferencer.infer_async(slot, crop=crop, consume=owned)
+            try:
+                chaos.chaos_point("scheduler/dispatch")
+                with telemetry.span("pipeline/dispatch"):
+                    out = inferencer.infer_async(
+                        slot, crop=crop, consume=owned)
+            except BaseException as exc:
+                _tag_culprit(exc, task)
+                raise
             pending.append((task, out, t0))
             telemetry.gauge("pipeline/inflight", len(pending))
 

@@ -1,0 +1,619 @@
+"""Fault-tolerant task lifecycle: leases, retries, dead-letter, resume.
+
+The paper's headline number — 18 PB produced on 3600 cloud nodes — rests
+on queue-mediated fault tolerance: the fleet runs on preemptible
+instances that crash constantly, and the visibility-timeout +
+ack-after-write protocol (reference lib/aws/sqs_queue.py) is what makes
+the volume converge anyway. ``parallel/queues.py`` gives us the
+transport; this module is the supervision layer that turns at-least-once
+delivery into exactly-once *effects*:
+
+* **Durable completion ledger** (:func:`open_ledger`): one done-marker
+  per bbox string in a ``memory://`` or ``file://`` store. A requeued,
+  replayed, or crash-redelivered task whose bbox is already marked is
+  acked and skipped without recompute — an interrupted volume run
+  resumes from where it died by simply replaying the task queue.
+* **Lease heartbeats** (:class:`LeaseRenewer`): a renewal thread extends
+  the claim's visibility timeout while the task is in compute, so a
+  slow chunk (fat patch, cold compile) is not double-claimed by another
+  worker when it outlives the static timeout.
+* **Retry accounting + dead-letter**: per-task receive counts
+  (``queue.receive_count``) bound retries; the supervisor classifies
+  transient vs permanent errors (:func:`classify_error`), applies
+  exponential backoff with jitter by re-claiming the task's visibility
+  for the backoff window, and moves poison tasks past ``--max-retries``
+  to the queue's dead-letter store with their failure reason
+  (inspect/requeue via ``chunkflow dead-letter``).
+* **Graceful preemption**: SIGTERM (install via
+  :func:`install_preemption_handler`) and SIGINT unwind into the
+  supervision path, which promptly nacks the in-flight task — immediate
+  visibility release, another worker picks it up now instead of after
+  the timeout — and flushes its pending async writes before exit,
+  modeling preemptible-VM / TPU-preemption behavior.
+
+Integration: ``fetch-task-from-queue --max-retries/--lease-renew/--ledger``
+builds a :class:`LifecycleSupervisor`; ``delete-task-in-queue`` calls
+:meth:`TaskLifecycle.commit` (the ack-after-durable-write commit point);
+``flow/runtime.process_stream`` consults :func:`handle_failure` when the
+stage chain dies, releasing every in-flight task and rebuilding the
+chain — so the PR 4 adaptive scheduler (whose error path flushes the
+survivors downstream first) runs *inside* a supervised worker loop.
+
+Everything is telemetry-instrumented (``tasks/retried``,
+``tasks/dead_lettered``, ``lease/renewals``, ``ledger/skips`` counters;
+``lifecycle/*`` spans) and fault-injectable at every stage boundary
+(``chunkflow_tpu/testing/chaos.py``, ``CHUNKFLOW_CHAOS``). See
+docs/fault_tolerance.md for the state diagram and resume cookbook.
+"""
+from __future__ import annotations
+
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.parallel.queues import QueueBase
+from chunkflow_tpu.testing import chaos
+
+__all__ = [
+    "TransientTaskError", "PermanentTaskError", "classify_error",
+    "backoff_delay", "LedgerBase", "MemoryLedger", "FileLedger",
+    "open_ledger", "LeaseRenewer", "TaskLifecycle",
+    "LifecycleSupervisor", "inflight", "handle_failure", "tag_culprit",
+    "install_preemption_handler",
+]
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+class TransientTaskError(RuntimeError):
+    """Raise to force a retry regardless of the default classification
+    (e.g. a storage backend's own throttling error)."""
+
+
+class PermanentTaskError(RuntimeError):
+    """Raise to force a dead-letter regardless of retry budget (the
+    task itself is invalid; retrying burns fleet time for nothing)."""
+
+
+#: poison-task signatures: bad input or a programming error — identical
+#: on every retry, so the supervisor dead-letters without burning the
+#: retry budget. Everything else (IO, preemption, chaos) is transient.
+_PERMANENT_TYPES = (
+    PermanentTaskError, ValueError, TypeError, KeyError, IndexError,
+    AttributeError, AssertionError, ZeroDivisionError, NotImplementedError,
+)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` (retry with backoff) or ``"permanent"``
+    (dead-letter now)."""
+    if isinstance(exc, (TransientTaskError, chaos.ChaosError)):
+        return "transient"
+    if isinstance(exc, _PERMANENT_TYPES):
+        return "permanent"
+    return "transient"
+
+
+def backoff_delay(attempt: int, base: float = 0.5, cap: float = 60.0,
+                  rng: Optional[random.Random] = None) -> float:
+    """Exponential backoff with full jitter: uniform in
+    ``[0, min(cap, base * 2**(attempt-1))]``. Full jitter (vs. equal
+    jitter) maximally decorrelates a fleet retrying the same dependency
+    outage — the regime the paper's 3600 nodes live in."""
+    ceiling = min(cap, base * (2 ** max(0, attempt - 1)))
+    draw = rng.random() if rng is not None else random.random()
+    return draw * ceiling
+
+
+# ---------------------------------------------------------------------------
+# completion ledger
+# ---------------------------------------------------------------------------
+class LedgerBase:
+    """Done-markers keyed by task body (bbox string). ``mark_done`` must
+    be idempotent and atomic: exactly one marker per key no matter how
+    many times a replayed task commits."""
+
+    def is_done(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def mark_done(self, key: str) -> None:
+        raise NotImplementedError
+
+    def keys(self) -> List[str]:
+        raise NotImplementedError
+
+    def __contains__(self, key: str) -> bool:
+        return self.is_done(key)
+
+    def __len__(self) -> int:
+        return len(self.keys())
+
+
+class MemoryLedger(LedgerBase):
+    """In-process ledger (tests, single-worker runs)."""
+
+    _registry: Dict[str, "MemoryLedger"] = {}
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._done: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def open(cls, name: str) -> "MemoryLedger":
+        if name not in cls._registry:
+            cls._registry[name] = cls(name)
+        return cls._registry[name]
+
+    def is_done(self, key: str) -> bool:
+        with self._lock:
+            return key in self._done
+
+    def mark_done(self, key: str) -> None:
+        with self._lock:
+            self._done.setdefault(key, time.time())
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._done)
+
+
+class FileLedger(LedgerBase):
+    """One ``<dir>/<key>.done`` file per completed task; atomic
+    tmp+rename writes so a marker is never torn. Safe across
+    processes/hosts on a shared filesystem — the resume substrate for a
+    fleet (same trust model as FileQueue)."""
+
+    SUFFIX = ".done"
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # bbox strings are filename-safe by construction; guard anyway
+        return os.path.join(self.dir, key.replace(os.sep, "_") + self.SUFFIX)
+
+    def is_done(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def mark_done(self, key: str) -> None:
+        path = self._path(key)
+        if os.path.exists(path):
+            return  # idempotent: exactly one marker per key
+        tmp = os.path.join(self.dir, f".tmp-{os.getpid()}-{id(self)}")
+        with open(tmp, "w") as f:
+            f.write(str(time.time()))
+        os.replace(tmp, path)
+
+    def keys(self) -> List[str]:
+        return sorted(
+            name[: -len(self.SUFFIX)]
+            for name in os.listdir(self.dir)
+            if name.endswith(self.SUFFIX)
+        )
+
+
+def open_ledger(spec: str) -> LedgerBase:
+    """``memory://name`` or ``file:///dir`` (bare paths mean file://)."""
+    if spec.startswith("memory://"):
+        return MemoryLedger.open(spec[len("memory://"):])
+    if spec.startswith("file://"):
+        spec = spec[len("file://"):]
+    return FileLedger(spec)
+
+
+# ---------------------------------------------------------------------------
+# lease heartbeats
+# ---------------------------------------------------------------------------
+class LeaseRenewer:
+    """Daemon thread extending a claimed task's visibility lease every
+    ``interval`` seconds while compute runs, so a slow chunk is not
+    double-claimed when it outlives the static visibility timeout. A
+    failed renewal is counted, not fatal: the lease may already be lost
+    (another worker owns the task now), but *this* attempt's commit path
+    still runs — the ledger makes the duplicate effect-free."""
+
+    def __init__(self, queue: QueueBase, handle: str, interval: float,
+                 timeout: Optional[float] = None):
+        self.queue = queue
+        self.handle = handle
+        self.interval = max(0.05, float(interval))
+        self.timeout = timeout
+        self.renewals = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"lease-renewer-{handle[:8]}",
+        )
+
+    def start(self) -> "LeaseRenewer":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                with telemetry.span("lifecycle/renew"):
+                    self.queue.renew(self.handle, self.timeout)
+                self.renewals += 1
+                telemetry.inc("lease/renewals")
+            except Exception:
+                telemetry.inc("lease/renew_failures")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+class _Heartbeat:
+    """One renewal thread per supervisor (not per task): every
+    ``interval`` seconds it renews the lease of every in-flight task the
+    supervisor owns. With the adaptive scheduler several tasks ride
+    between claim and ack at once — a thread per task would mean a
+    thread churn per task at pipeline depth, for no benefit."""
+
+    def __init__(self, supervisor: "LifecycleSupervisor", interval: float):
+        self.supervisor = supervisor
+        self.interval = max(0.05, float(interval))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="lease-heartbeat",
+        )
+
+    def start(self) -> "_Heartbeat":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            for lc in inflight():
+                if lc.supervisor is not self.supervisor or lc.done:
+                    continue
+                try:
+                    with telemetry.span("lifecycle/renew"):
+                        self.supervisor.queue.renew(lc.handle)
+                    telemetry.inc("lease/renewals")
+                except Exception:
+                    # the lease may already be lost (task re-claimed
+                    # elsewhere); this attempt's commit still runs and
+                    # the ledger de-duplicates the effects
+                    telemetry.inc("lease/renew_failures")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# in-flight registry (module-level: process_stream consults it on failure)
+# ---------------------------------------------------------------------------
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT: Dict[int, "TaskLifecycle"] = {}
+
+
+def inflight() -> List["TaskLifecycle"]:
+    """Claimed-but-unacked supervised tasks, oldest first. With the
+    adaptive scheduler several tasks ride between claim and ack at
+    once; on a chain failure every one of them is released."""
+    with _INFLIGHT_LOCK:
+        return list(_INFLIGHT.values())
+
+
+def _register(lc: "TaskLifecycle") -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[id(lc)] = lc
+
+
+def _unregister(lc: "TaskLifecycle") -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT.pop(id(lc), None)
+
+
+# ---------------------------------------------------------------------------
+# per-task lifecycle
+# ---------------------------------------------------------------------------
+class TaskLifecycle:
+    """One claimed task's supervision state, attached to the task dict
+    as ``task["lifecycle"]``. Terminal transitions (exactly one per
+    claim): :meth:`commit` (ack + ledger marker) or :meth:`release`
+    (retry with backoff, dead-letter, or preemption nack)."""
+
+    def __init__(self, supervisor: "LifecycleSupervisor", handle: str,
+                 body: str, receives: int):
+        self.supervisor = supervisor
+        self.queue = supervisor.queue
+        self.handle = handle
+        self.body = body
+        self.receives = receives
+        self.task: Optional[dict] = None
+        self.renewer: Optional[LeaseRenewer] = None
+        self.done = False
+
+    def _finish(self) -> None:
+        self.done = True
+        if self.renewer is not None:
+            self.renewer.stop()
+        _unregister(self)
+
+    def commit(self, task: Optional[dict] = None) -> None:
+        """The commit point, in ack-after-durable-write order: drain the
+        task's async writes, mark the ledger (a crash after this line
+        redelivers the task once and ledger-skips it), then ack. A crash
+        *before* the marker redelivers and recomputes — idempotent
+        storage writes make that converge to the same bytes."""
+        if self.done:
+            return
+        from chunkflow_tpu.flow.runtime import drain_pending_writes
+
+        with telemetry.span("lifecycle/commit"):
+            drain_pending_writes(task if task is not None else self.task)
+            chaos.chaos_point("lifecycle/pre_ledger")
+            if self.supervisor.ledger is not None:
+                self.supervisor.ledger.mark_done(self.body)
+            chaos.chaos_point("lifecycle/pre_ack")
+            self.queue.delete(self.handle)
+        telemetry.inc("tasks/committed")
+        self._finish()
+
+    def _flush_writes(self) -> None:
+        """Best-effort drain of the task's pending async writes on a
+        failure/preemption path: abandoning in-flight futures would race
+        process teardown and swallow their errors. The task is being
+        retried or dead-lettered anyway, so drain errors are counted,
+        not raised."""
+        from chunkflow_tpu.flow.runtime import drain_pending_writes
+
+        try:
+            drain_pending_writes(self.task)
+        except Exception:
+            telemetry.inc("lifecycle/flush_failures")
+
+    def release(self, exc: BaseException) -> str:
+        """Failure transition. Returns ``"preempted"`` (nacked, worker
+        exiting), ``"retried"`` (backoff via visibility re-claim) or
+        ``"dead"`` (moved to the dead-letter store)."""
+        if self.done:
+            return "done"
+        self._finish()
+        with telemetry.span("lifecycle/release"):
+            if isinstance(exc, (KeyboardInterrupt, SystemExit,
+                                GeneratorExit)):
+                # preemption: hand the task back *now* (immediate
+                # visibility release), then flush writes before exit
+                self.queue.nack(self.handle)
+                telemetry.inc("tasks/preempted")
+                self._flush_writes()
+                return "preempted"
+            self._flush_writes()
+            reason = f"{type(exc).__name__}: {exc}"
+            kind = classify_error(exc)
+            if kind == "permanent" or (
+                0 <= self.supervisor.max_retries <= self.receives
+            ):
+                self.queue.dead_letter(
+                    self.handle,
+                    reason=f"{reason} (receives={self.receives}, "
+                           f"classified {kind})",
+                )
+                telemetry.inc("tasks/dead_lettered")
+                return "dead"
+            delay = backoff_delay(
+                self.receives, base=self.supervisor.backoff_base,
+                cap=self.supervisor.backoff_cap, rng=self.supervisor.rng,
+            )
+            # backoff rides the visibility clock: re-claim for `delay`
+            # seconds, leave unacked — the task reappears by itself, and
+            # a worker crash during the backoff window changes nothing
+            self.queue.renew(self.handle, delay)
+            telemetry.inc("tasks/retried")
+            telemetry.event(
+                "task_retry", "lifecycle/retry", body=self.body,
+                receives=self.receives, backoff_s=round(delay, 3),
+                error=reason[:200],
+            )
+            return "retried"
+
+    def surrender(self) -> str:
+        """Innocent-bystander transition: *another* task's failure tore
+        down the shared stage chain while this one was in flight. Hand
+        the claim back immediately (nack, no backoff) and record no
+        failure — the only cost is one receive count on redelivery,
+        exactly the semantics an SQS fleet pays when a worker holding a
+        batch dies."""
+        if self.done:
+            return "done"
+        self._finish()
+        self.queue.nack(self.handle)
+        self._flush_writes()
+        telemetry.inc("tasks/surrendered")
+        return "surrendered"
+
+
+# ---------------------------------------------------------------------------
+# the supervisor
+# ---------------------------------------------------------------------------
+class LifecycleSupervisor:
+    """Policy + claim loop: wraps a queue's (handle, body) iteration
+    into supervised :class:`TaskLifecycle` objects.
+
+    ``max_retries``: failed deliveries allowed before dead-letter
+    (a task that fails ``max_retries`` times lands in the dead-letter
+    store; negative disables the bound). ``lease_renew``: heartbeat
+    interval in seconds (0 disables). ``ledger``: a
+    :class:`LedgerBase` for idempotent skip/resume, or None.
+    """
+
+    def __init__(self, queue: QueueBase, ledger: Optional[LedgerBase] = None,
+                 max_retries: int = 3, lease_renew: float = 0.0,
+                 backoff_base: float = 0.5, backoff_cap: float = 60.0,
+                 seed: Optional[int] = None):
+        self.queue = queue
+        self.ledger = ledger
+        self.max_retries = int(max_retries)
+        self.lease_renew = float(lease_renew)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.rng = random.Random(seed)
+
+    def claim(self, handle: str, body: str) -> Optional[TaskLifecycle]:
+        """One delivery → a supervised lifecycle, or None when the
+        delivery is resolved at claim time (ledger skip, crash-loop
+        dead-letter)."""
+        with telemetry.span("lifecycle/claim"):
+            if self.ledger is not None and self.ledger.is_done(body):
+                # already committed by a previous attempt/run: ack the
+                # duplicate delivery, skip the compute — the idempotent
+                # resume path
+                self.queue.delete(handle)
+                telemetry.inc("ledger/skips")
+                return None
+            receives = self.queue.receive_count(handle) or 1
+            # the first delivery is always claimable; past that, a
+            # redelivery beyond the retry budget means every prior
+            # attempt died without even recording a failure
+            if self.max_retries >= 0 and receives > max(self.max_retries, 1):
+                # redelivered past the budget with no recorded failure:
+                # the worker died mid-compute every time (crash loop)
+                self.queue.dead_letter(
+                    handle,
+                    reason=f"receive count {receives} exceeds max retries "
+                           f"{self.max_retries} with no recorded failure "
+                           "(worker crash loop)",
+                )
+                telemetry.inc("tasks/dead_lettered")
+                return None
+            lc = TaskLifecycle(self, handle, body, receives)
+            _register(lc)
+            # the kill-able boundary sits after registration so an
+            # injected death here is released (fast retry), not leaked
+            # to the visibility timeout
+            chaos.chaos_point("lifecycle/claim")
+            return lc
+
+    def tasks(self, num: int = -1) -> Iterator[TaskLifecycle]:
+        """Claim loop: yields supervised lifecycles, at most ``num``
+        (< 0: drain). Installs the SIGTERM preemption handler and runs
+        the lease heartbeat (``lease_renew`` > 0) for the loop's
+        duration."""
+        restore = install_preemption_handler()
+        heartbeat = (
+            _Heartbeat(self, self.lease_renew).start()
+            if self.lease_renew > 0 else None
+        )
+        count = 0
+        try:
+            for handle, body in self.queue:
+                lc = self.claim(handle, body)
+                if lc is None:
+                    continue
+                yield lc
+                count += 1
+                if 0 <= num <= count:
+                    return
+        finally:
+            if heartbeat is not None:
+                heartbeat.stop()
+            restore()
+
+
+# ---------------------------------------------------------------------------
+# chain-failure + preemption entry points (flow/runtime.process_stream)
+# ---------------------------------------------------------------------------
+def tag_culprit(exc: BaseException, owner) -> None:
+    """Attach the task (dict) or :class:`TaskLifecycle` whose processing
+    raised ``exc``. The stage chain is shared by several in-flight tasks
+    (prefetch + pipelining), so when it dies, only the tagged culprit
+    should be *charged* with the failure — the bystanders merely
+    surrender their claims. First tag wins (the innermost frame knows
+    the owner best). Call sites: the runtime operator wrapper, the
+    adaptive scheduler's dispatch/finalize, the supervised fetch loop."""
+    if getattr(exc, "_chunkflow_culprit", None) is None:
+        try:
+            exc._chunkflow_culprit = owner
+        except Exception:
+            pass  # exotic exception type refusing attributes
+
+
+def _resolve_culprit(exc: BaseException,
+                     lcs: List["TaskLifecycle"]) -> Optional["TaskLifecycle"]:
+    owner = getattr(exc, "_chunkflow_culprit", None)
+    if owner is None:
+        return None
+    for lc in lcs:
+        if lc is owner or (lc.task is not None and lc.task is owner):
+            return lc
+    if isinstance(owner, dict):
+        lc = owner.get("lifecycle")
+        if lc in lcs:
+            return lc
+    return None
+
+
+def handle_failure(exc: BaseException) -> bool:
+    """Resolve every in-flight supervised task after the stage chain
+    died with ``exc``: preemption nacks them all (immediate visibility
+    release) and the worker exits; a task failure charges the tagged
+    culprit (retry with backoff, or dead-letter per policy) while the
+    innocent bystanders surrender their claims un-failed, and the
+    worker rebuilds its chain. An unattributable failure conservatively
+    charges every in-flight task.
+
+    Returns True when the caller should rebuild and continue draining
+    the queue; False when the failure is not contained (no supervised
+    task in flight, or a preemption/exit) and must re-raise."""
+    lcs = inflight()
+    if not lcs:
+        return False
+    preempt = isinstance(exc, (KeyboardInterrupt, SystemExit, GeneratorExit))
+    culprit = None if preempt else _resolve_culprit(exc, lcs)
+    for lc in lcs:
+        try:
+            if preempt or culprit is None or lc is culprit:
+                lc.release(exc)
+            else:
+                lc.surrender()
+        except Exception as release_exc:
+            # a broken queue must not mask the original failure
+            print(
+                f"lifecycle: releasing task {lc.body!r} failed: "
+                f"{release_exc!r}", file=sys.stderr,
+            )
+    return not preempt
+
+
+def install_preemption_handler():
+    """Route SIGTERM into the supervision path: the handler raises
+    ``SystemExit(143)`` in the main thread, the chain unwinds,
+    :func:`handle_failure` nacks the in-flight tasks and flushes their
+    writes, and the worker exits — the preemptible-VM contract. SIGINT
+    already arrives as KeyboardInterrupt and takes the same path.
+    Returns a zero-arg restore callable; no-op off the main thread
+    (signal handlers only install there)."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    previous = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        raise SystemExit(143)  # 128 + SIGTERM, the fleet convention
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except (ValueError, OSError):  # exotic embedding: no signal support
+        return lambda: None
+
+    def restore():
+        try:
+            signal.signal(signal.SIGTERM, previous)
+        except (ValueError, OSError, TypeError):
+            pass
+
+    return restore

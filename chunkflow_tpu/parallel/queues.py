@@ -6,6 +6,24 @@ talk to each other; the queue plus object storage is the whole protocol
 (communication-free task parallelism — the right design for chunked
 inference, kept here deliberately instead of collectives).
 
+Beyond the reference's happy path, every backend speaks the full task
+lifecycle protocol consumed by ``parallel/lifecycle.py``
+(docs/fault_tolerance.md):
+
+* :meth:`QueueBase.renew` — lease heartbeat: extend a claimed task's
+  visibility timeout so a slow chunk is not double-claimed mid-compute
+  (SQS ``ChangeMessageVisibility``);
+* :meth:`QueueBase.nack` — immediate visibility release of a claimed
+  task (graceful preemption: a SIGTERM'd worker hands its task back
+  instead of letting the timeout expire);
+* :meth:`QueueBase.receive_count` — per-task delivery count (memory:
+  dict; file: sidecar count next to the claimed entry; SQS:
+  ``ApproximateReceiveCount``), the retry accounting substrate;
+* :meth:`QueueBase.dead_letter` / :meth:`dead_letters` /
+  :meth:`requeue_dead` — a poison task that keeps failing moves to a
+  dead-letter store carrying its failure reason, inspectable and
+  requeueable via the CLI (``chunkflow dead-letter``).
+
 Backends:
 - ``memory://name``  — in-process, for tests (fixes the reference's
   untestable-SQS gap);
@@ -17,6 +35,7 @@ Backends:
 """
 from __future__ import annotations
 
+import json
 import os
 import time
 import uuid
@@ -24,7 +43,10 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 
 class QueueBase:
-    """handle/body iteration + ack protocol shared by all backends."""
+    """handle/body iteration + ack/lease/dead-letter protocol shared by
+    all backends."""
+
+    visibility_timeout: float = 1800.0
 
     def send_messages(self, bodies: List[str]) -> None:
         raise NotImplementedError
@@ -35,6 +57,41 @@ class QueueBase:
 
     def delete(self, handle: str) -> None:
         """Ack: permanently remove a claimed task (the commit point)."""
+        raise NotImplementedError
+
+    # -- lifecycle protocol (parallel/lifecycle.py) ---------------------
+    def renew(self, handle: str, timeout: Optional[float] = None) -> None:
+        """Extend the claim on ``handle`` so it stays invisible for
+        another ``timeout`` seconds (default: the queue's visibility
+        timeout) from now. The lease heartbeat for in-compute tasks."""
+        raise NotImplementedError
+
+    def nack(self, handle: str) -> None:
+        """Release the claim immediately: the task becomes visible to
+        other workers right away (preemption / fast retry) instead of
+        after the visibility timeout."""
+        raise NotImplementedError
+
+    def receive_count(self, handle: str) -> int:
+        """How many times the claimed task has been delivered, this
+        delivery included. 1 on first claim; best-effort (0 when the
+        backend cannot tell)."""
+        return 0
+
+    def dead_letter(self, handle: str, reason: str = "") -> None:
+        """Move a claimed poison task to the dead-letter store with its
+        failure reason; it will never be delivered again until an
+        operator requeues it."""
+        raise NotImplementedError
+
+    def dead_letters(self) -> List[dict]:
+        """List dead-letter entries as ``{"body", "reason", "receives",
+        "t"}`` dicts (non-destructive where the backend allows)."""
+        raise NotImplementedError
+
+    def requeue_dead(self) -> int:
+        """Move every dead-letter entry back to pending with a fresh
+        retry budget; returns how many were requeued."""
         raise NotImplementedError
 
     # polling iteration with bounded retries on empty
@@ -64,13 +121,22 @@ class MemoryQueue(QueueBase):
         self.name = name
         self.visibility_timeout = visibility_timeout
         self.pending: Dict[str, str] = {}
+        # handle -> (body, visibility deadline): invisible until deadline
         self.invisible: Dict[str, Tuple[str, float]] = {}
+        self.receives: Dict[str, int] = {}
+        self.dead: Dict[str, dict] = {}
         self.retry_sleep = 0.01
 
     @classmethod
     def open(cls, name: str, visibility_timeout: float = 1800.0) -> "MemoryQueue":
         if name not in cls._registry:
             cls._registry[name] = cls(name, visibility_timeout)
+        else:
+            # a reopen with a different timeout is a reconfiguration,
+            # not a no-op: silently keeping the first value would give
+            # lease renewal / requeue tests (and real workers) a
+            # different timeout than they asked for
+            cls._registry[name].visibility_timeout = visibility_timeout
         return cls._registry[name]
 
     def send_messages(self, bodies: List[str]) -> None:
@@ -79,8 +145,8 @@ class MemoryQueue(QueueBase):
 
     def _requeue_expired(self) -> None:
         now = time.time()
-        expired = [h for h, (_, t) in self.invisible.items()
-                   if now - t > self.visibility_timeout]
+        expired = [h for h, (_, deadline) in self.invisible.items()
+                   if now > deadline]
         for h in expired:
             body, _ = self.invisible.pop(h)
             self.pending[h] = body
@@ -91,12 +157,50 @@ class MemoryQueue(QueueBase):
             return None
         handle, body = next(iter(self.pending.items()))
         del self.pending[handle]
-        self.invisible[handle] = (body, time.time())
+        self.invisible[handle] = (body, time.time() + self.visibility_timeout)
+        self.receives[handle] = self.receives.get(handle, 0) + 1
         return handle, body
 
     def delete(self, handle: str) -> None:
         self.invisible.pop(handle, None)
         self.pending.pop(handle, None)
+        self.receives.pop(handle, None)
+
+    def renew(self, handle: str, timeout: Optional[float] = None) -> None:
+        entry = self.invisible.get(handle)
+        if entry is None:
+            return  # already expired/acked: nothing to extend
+        timeout = self.visibility_timeout if timeout is None else timeout
+        self.invisible[handle] = (entry[0], time.time() + timeout)
+
+    def nack(self, handle: str) -> None:
+        entry = self.invisible.pop(handle, None)
+        if entry is not None:
+            self.pending[handle] = entry[0]
+
+    def receive_count(self, handle: str) -> int:
+        return self.receives.get(handle, 0)
+
+    def dead_letter(self, handle: str, reason: str = "") -> None:
+        entry = self.invisible.pop(handle, None)
+        body = entry[0] if entry else self.pending.pop(handle, None)
+        if body is None:
+            return
+        self.dead[handle] = {
+            "body": body, "reason": reason,
+            "receives": self.receives.pop(handle, 0), "t": time.time(),
+        }
+
+    def dead_letters(self) -> List[dict]:
+        return [dict(entry) for entry in self.dead.values()]
+
+    def requeue_dead(self) -> int:
+        count = 0
+        for handle, entry in list(self.dead.items()):
+            del self.dead[handle]
+            self.pending[handle] = entry["body"]  # fresh retry budget
+            count += 1
+        return count
 
     def __len__(self) -> int:
         self._requeue_expired()
@@ -109,15 +213,23 @@ class FileQueue(QueueBase):
     Layout: ``<dir>/pending/<id>`` holds the body; claiming renames it to
     ``<dir>/claimed/<id>``; delete removes the claimed file. A janitor pass
     returns claimed files older than the visibility timeout to pending —
-    so crashed workers' tasks reappear, same as SQS.
+    so crashed workers' tasks reappear, same as SQS. The lifecycle
+    extensions ride the same layout: ``<dir>/counts/<id>`` is the
+    delivery-count sidecar of a claimed entry (it survives janitor
+    requeues, so retry accounting sees crashed attempts too) and
+    ``<dir>/dead/<id>`` holds dead-lettered tasks as JSON
+    ``{body, reason, receives, t}``.
     """
 
     def __init__(self, directory: str, visibility_timeout: float = 1800.0):
         self.dir = directory
         self.pending_dir = os.path.join(directory, "pending")
         self.claimed_dir = os.path.join(directory, "claimed")
-        os.makedirs(self.pending_dir, exist_ok=True)
-        os.makedirs(self.claimed_dir, exist_ok=True)
+        self.counts_dir = os.path.join(directory, "counts")
+        self.dead_dir = os.path.join(directory, "dead")
+        for d in (self.pending_dir, self.claimed_dir,
+                  self.counts_dir, self.dead_dir):
+            os.makedirs(d, exist_ok=True)
         self.visibility_timeout = visibility_timeout
 
     def send_messages(self, bodies: List[str]) -> None:
@@ -137,6 +249,35 @@ class FileQueue(QueueBase):
                     os.rename(path, os.path.join(self.pending_dir, name))
             except OSError:
                 pass  # another janitor/worker won the race
+        # a sender that crashed mid-send_messages leaves .tmp-* staging
+        # files in the queue root forever; sweep the stale ones (older
+        # than the visibility timeout, so an in-progress send is safe)
+        for name in os.listdir(self.dir):
+            if not name.startswith(".tmp-"):
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                if now - os.path.getmtime(path) > self.visibility_timeout:
+                    os.remove(path)
+            except OSError:
+                pass
+
+    def _bump_count(self, name: str) -> int:
+        path = os.path.join(self.counts_dir, name)
+        count = self._read_count(name) + 1
+        try:
+            with open(path, "w") as f:
+                f.write(str(count))
+        except OSError:
+            pass
+        return count
+
+    def _read_count(self, name: str) -> int:
+        try:
+            with open(os.path.join(self.counts_dir, name)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
 
     def receive(self) -> Optional[Tuple[str, str]]:
         self._requeue_expired()
@@ -148,37 +289,139 @@ class FileQueue(QueueBase):
             except OSError:
                 continue  # raced with another worker
             os.utime(dst)
+            self._bump_count(name)
             with open(dst) as f:
                 return name, f.read()
         return None
 
     def delete(self, handle: str) -> None:
+        for path in (os.path.join(self.claimed_dir, handle),
+                     os.path.join(self.counts_dir, handle)):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+
+    def renew(self, handle: str, timeout: Optional[float] = None) -> None:
+        timeout = self.visibility_timeout if timeout is None else timeout
+        path = os.path.join(self.claimed_dir, handle)
+        # expiry is mtime + visibility_timeout: place the mtime so the
+        # claim lives exactly `timeout` seconds from now
+        stamp = time.time() + timeout - self.visibility_timeout
         try:
-            os.remove(os.path.join(self.claimed_dir, handle))
-        except FileNotFoundError:
-            pass
+            os.utime(path, (stamp, stamp))
+        except OSError:
+            pass  # expired and re-claimed elsewhere: lease is lost
+
+    def nack(self, handle: str) -> None:
+        try:
+            os.rename(os.path.join(self.claimed_dir, handle),
+                      os.path.join(self.pending_dir, handle))
+        except OSError:
+            pass  # the janitor beat us to it
+
+    def receive_count(self, handle: str) -> int:
+        return self._read_count(handle)
+
+    def dead_letter(self, handle: str, reason: str = "") -> None:
+        claimed = os.path.join(self.claimed_dir, handle)
+        try:
+            with open(claimed) as f:
+                body = f.read()
+        except OSError:
+            return  # lost the claim: someone else owns the task now
+        entry = {"body": body, "reason": reason,
+                 "receives": self._read_count(handle), "t": time.time()}
+        tmp = os.path.join(self.dir, f".tmp-dead-{handle}")
+        with open(tmp, "w") as f:
+            json.dump(entry, f)
+        os.rename(tmp, os.path.join(self.dead_dir, handle))
+        self.delete(handle)
+
+    def dead_letters(self) -> List[dict]:
+        entries = []
+        for name in sorted(os.listdir(self.dead_dir)):
+            try:
+                with open(os.path.join(self.dead_dir, name)) as f:
+                    entries.append(json.load(f))
+            except (OSError, ValueError):
+                continue
+        return entries
+
+    def requeue_dead(self) -> int:
+        count = 0
+        for name in sorted(os.listdir(self.dead_dir)):
+            path = os.path.join(self.dead_dir, name)
+            try:
+                with open(path) as f:
+                    entry = json.load(f)
+            except (OSError, ValueError):
+                continue
+            self.send_messages([entry["body"]])
+            try:
+                os.remove(path)
+            except OSError:
+                continue
+            count += 1
+        return count
 
     def __len__(self) -> int:
         return len(os.listdir(self.pending_dir))
 
 
 class SQSQueue(QueueBase):
-    """AWS SQS backend (requires boto3 + credentials; not in this image)."""
+    """AWS SQS backend (requires boto3 + credentials; not in this image).
 
-    def __init__(self, name: str, visibility_timeout: int = 1800):
-        try:
-            import boto3
-        except ImportError as e:
-            raise RuntimeError(
-                "sqs:// queues need boto3, which is not installed; "
-                "use file:// or memory:// queues instead"
-            ) from e
-        self.client = boto3.client("sqs")
+    ``client`` injection exists for tests: the lifecycle/batch-send
+    surfaces are exercised against a fake client without boto3."""
+
+    def __init__(self, name: str, visibility_timeout: int = 1800,
+                 client=None):
+        if client is None:
+            try:
+                import boto3
+            except ImportError as e:
+                raise RuntimeError(
+                    "sqs:// queues need boto3, which is not installed; "
+                    "use file:// or memory:// queues instead"
+                ) from e
+            client = boto3.client("sqs")
+        self.client = client
+        self.name = name
+        self.visibility_timeout = visibility_timeout
         resp = self.client.create_queue(
             QueueName=name,
             Attributes={"VisibilityTimeout": str(visibility_timeout)},
         )
         self.queue_url = resp["QueueUrl"]
+        self._dead_url: Optional[str] = None
+        self._receive_counts: Dict[str, int] = {}
+
+    def _send_batch(self, entries: List[dict]) -> None:
+        resp = self.client.send_message_batch(
+            QueueUrl=self.queue_url, Entries=entries
+        )
+        failed = resp.get("Failed") or []
+        if not failed:
+            return
+        # partial-batch failure is a *success* response carrying Failed
+        # entries — dropping them silently loses tasks. Retry the failed
+        # subset once (throttling is transient), then raise.
+        failed_ids = {f["Id"] for f in failed}
+        retry = [e for e in entries if e["Id"] in failed_ids]
+        resp = self.client.send_message_batch(
+            QueueUrl=self.queue_url, Entries=retry
+        )
+        failed = resp.get("Failed") or []
+        if failed:
+            raise IOError(
+                f"SQS send_message_batch failed for {len(failed)} "
+                f"message(s) after retry: "
+                + "; ".join(
+                    f"{f.get('Id')}: {f.get('Code')} {f.get('Message', '')}"
+                    for f in failed
+                )
+            )
 
     def send_messages(self, bodies: List[str]) -> None:
         for i in range(0, len(bodies), 10):  # SQS batch limit
@@ -186,13 +429,13 @@ class SQSQueue(QueueBase):
                 {"Id": str(j), "MessageBody": body}
                 for j, body in enumerate(bodies[i : i + 10])
             ]
-            self.client.send_message_batch(
-                QueueUrl=self.queue_url, Entries=entries
-            )
+            self._send_batch(entries)
 
     def receive(self) -> Optional[Tuple[str, str]]:
         resp = self.client.receive_message(
-            QueueUrl=self.queue_url, MaxNumberOfMessages=1, WaitTimeSeconds=20
+            QueueUrl=self.queue_url, MaxNumberOfMessages=1,
+            WaitTimeSeconds=20,
+            AttributeNames=["ApproximateReceiveCount"],
         )
         messages = resp.get("Messages", [])
         if not messages:
@@ -208,10 +451,90 @@ class SQSQueue(QueueBase):
                 raise IOError(
                     f"SQS body md5 mismatch: got {got}, expected {expected}"
                 )
-        return msg["ReceiptHandle"], msg["Body"]
+        handle = msg["ReceiptHandle"]
+        try:
+            self._receive_counts[handle] = int(
+                (msg.get("Attributes") or {}).get("ApproximateReceiveCount", 0)
+            )
+        except (TypeError, ValueError):
+            self._receive_counts[handle] = 0
+        self._bodies = getattr(self, "_bodies", {})
+        self._bodies[handle] = msg["Body"]
+        return handle, msg["Body"]
 
     def delete(self, handle: str) -> None:
         self.client.delete_message(QueueUrl=self.queue_url, ReceiptHandle=handle)
+        self._receive_counts.pop(handle, None)
+        getattr(self, "_bodies", {}).pop(handle, None)
+
+    def renew(self, handle: str, timeout: Optional[float] = None) -> None:
+        timeout = self.visibility_timeout if timeout is None else timeout
+        self.client.change_message_visibility(
+            QueueUrl=self.queue_url, ReceiptHandle=handle,
+            VisibilityTimeout=int(timeout),
+        )
+
+    def nack(self, handle: str) -> None:
+        self.renew(handle, 0)
+
+    def receive_count(self, handle: str) -> int:
+        return self._receive_counts.get(handle, 0)
+
+    def _dead_queue_url(self) -> str:
+        if self._dead_url is None:
+            # short nonzero visibility: dead_letters() below drains to
+            # empty to list, so entries must go invisible between
+            # receives (or the listing loop would never terminate) and
+            # reappear shortly after
+            resp = self.client.create_queue(
+                QueueName=f"{self.name}-dead",
+                Attributes={"VisibilityTimeout": "300"},
+            )
+            self._dead_url = resp["QueueUrl"]
+        return self._dead_url
+
+    def dead_letter(self, handle: str, reason: str = "") -> None:
+        body = getattr(self, "_bodies", {}).get(handle)
+        if body is None:
+            return  # not a task this client received
+        entry = {"body": body, "reason": reason,
+                 "receives": self.receive_count(handle), "t": time.time()}
+        self.client.send_message(
+            QueueUrl=self._dead_queue_url(), MessageBody=json.dumps(entry)
+        )
+        self.delete(handle)
+
+    def _drain_dead(self):
+        while True:
+            resp = self.client.receive_message(
+                QueueUrl=self._dead_queue_url(), MaxNumberOfMessages=10,
+                WaitTimeSeconds=0,
+            )
+            messages = resp.get("Messages", [])
+            if not messages:
+                return
+            for msg in messages:
+                try:
+                    entry = json.loads(msg["Body"])
+                except ValueError:
+                    entry = {"body": msg["Body"], "reason": "", "receives": 0}
+                yield msg["ReceiptHandle"], entry
+
+    def dead_letters(self) -> List[dict]:
+        # SQS has no non-destructive listing: receive-to-empty instead;
+        # the entries go invisible for the dead queue's short visibility
+        # timeout and then reappear (listing never loses them)
+        return [entry for _, entry in self._drain_dead()]
+
+    def requeue_dead(self) -> int:
+        count = 0
+        for handle, entry in self._drain_dead():
+            self.send_messages([entry["body"]])
+            self.client.delete_message(
+                QueueUrl=self._dead_queue_url(), ReceiptHandle=handle
+            )
+            count += 1
+        return count
 
 
 def open_queue(spec: str, visibility_timeout: float = 1800.0) -> QueueBase:

@@ -1,0 +1,7 @@
+"""Deterministic test harnesses (fault injection, fixtures).
+
+Import-light by design: modules here are imported from production hot
+paths (``flow/runtime.py`` consults the chaos harness per operator), so
+nothing in this package may import jax or any heavyweight dependency at
+module load.
+"""

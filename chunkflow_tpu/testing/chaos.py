@@ -1,0 +1,173 @@
+"""Deterministic fault injection: kill lifecycle stages at chosen boundaries.
+
+The paper's fleet (3600 preemptible cloud nodes) converges because every
+failure mode — worker death mid-compute, death between write and ack,
+poison tasks — is handled by the queue + ledger protocol
+(parallel/lifecycle.py, docs/fault_tolerance.md). This harness makes
+those failure modes *reproducible*: production code calls
+:func:`chaos_point` at its stage boundaries, and a seeded plan decides
+which calls raise :class:`ChaosError`. With no plan configured the call
+is a cheap no-op, so the hooks stay in the shipping code paths (the same
+philosophy as telemetry's kill switch — you test the wiring you run).
+
+Configuration (``CHUNKFLOW_CHAOS`` env var or :func:`configure`), fields
+separated by ``:``, lists by ``,``; ``fnmatch`` patterns allowed in
+point names::
+
+    CHUNKFLOW_CHAOS="once=lifecycle/claim,op/inference,lifecycle/pre_ack"
+        kill each listed point exactly once (its first hit) — the
+        acceptance harness: every stage dies at least once, the run
+        must still converge bit-identically
+
+    CHUNKFLOW_CHAOS="seed=42:rate=0.25:points=op/*,scheduler/dispatch"
+        seeded Bernoulli kill at every matching hit — soak testing
+
+    CHUNKFLOW_CHAOS="seed=7:rate=0.5:points=lifecycle/claim:max=3"
+        stop injecting after 3 kills total
+
+Well-known points (grep ``chaos_point`` for the current set):
+``lifecycle/claim`` (task claimed, before compute),
+``op/<operator-name>`` (every runtime operator body),
+``scheduler/dispatch`` / ``scheduler/post`` (the adaptive scheduler's
+device dispatch and host post stages), ``lifecycle/pre_ledger`` (writes
+durable, ledger not yet marked), ``lifecycle/pre_ack`` (ledger marked,
+queue not yet acked).
+
+:class:`ChaosError` is classified *transient* by the lifecycle
+supervisor — an injected kill models a preemption/IO blip, so the task
+must retry and the drained output must match a fault-free run.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from fnmatch import fnmatchcase
+from typing import Dict, List, Optional
+
+__all__ = [
+    "ChaosError", "configure", "reset", "active", "chaos_point",
+    "injections",
+]
+
+
+class ChaosError(RuntimeError):
+    """An injected fault. Transient by lifecycle classification."""
+
+
+class _Plan:
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.seed = 0
+        self.rate = 1.0
+        self.points: List[str] = []
+        self.once: List[str] = []
+        self.max_kills: Optional[int] = None
+        for field in spec.split(":"):
+            field = field.strip()
+            if not field:
+                continue
+            key, _, value = field.partition("=")
+            key, value = key.strip(), value.strip()
+            if key == "seed":
+                self.seed = int(value)
+            elif key == "rate":
+                self.rate = float(value)
+            elif key == "points":
+                self.points = [p for p in value.split(",") if p]
+            elif key == "once":
+                self.once = [p for p in value.split(",") if p]
+            elif key == "max":
+                self.max_kills = int(value)
+            else:
+                raise ValueError(
+                    f"bad CHUNKFLOW_CHAOS field {field!r} "
+                    "(want seed=/rate=/points=/once=/max=)"
+                )
+        self.rng = random.Random(self.seed)
+        self.fired_once: set = set()
+        self.kills: Dict[str, int] = {}
+        self.lock = threading.Lock()
+
+    def strike(self, name: str) -> bool:
+        with self.lock:
+            if (self.max_kills is not None
+                    and sum(self.kills.values()) >= self.max_kills):
+                return False
+            for pattern in self.once:
+                if fnmatchcase(name, pattern) and pattern not in self.fired_once:
+                    self.fired_once.add(pattern)
+                    self.kills[name] = self.kills.get(name, 0) + 1
+                    return True
+            for pattern in self.points:
+                if fnmatchcase(name, pattern):
+                    # one draw per matching hit: the kill sequence is a
+                    # pure function of (seed, hit order)
+                    if self.rng.random() < self.rate:
+                        self.kills[name] = self.kills.get(name, 0) + 1
+                        return True
+                    return False
+            return False
+
+
+_plan: Optional[_Plan] = None
+_env_seen: Optional[str] = None
+_state_lock = threading.Lock()
+
+
+def configure(spec: Optional[str]) -> None:
+    """Install a chaos plan programmatically (tests). ``None`` or empty
+    disables injection and detaches from the env var until the next
+    :func:`reset`."""
+    global _plan, _env_seen
+    with _state_lock:
+        _plan = _Plan(spec) if spec else None
+        _env_seen = "<configured>"
+
+
+def reset() -> None:
+    """Drop any plan and re-arm env-var pickup."""
+    global _plan, _env_seen
+    with _state_lock:
+        _plan = None
+        _env_seen = None
+
+
+def _current_plan() -> Optional[_Plan]:
+    global _plan, _env_seen
+    env = os.environ.get("CHUNKFLOW_CHAOS", "")
+    with _state_lock:
+        if _env_seen == "<configured>":
+            return _plan
+        if env != _env_seen:
+            _env_seen = env
+            _plan = _Plan(env) if env else None
+        return _plan
+
+
+def active() -> bool:
+    return _current_plan() is not None
+
+
+def chaos_point(name: str) -> None:
+    """Declare a kill-able stage boundary. No-op without a plan; raises
+    :class:`ChaosError` when the plan strikes. Never call inside jit —
+    it is host-side control flow by definition."""
+    plan = _current_plan()
+    if plan is None:
+        return
+    if plan.strike(name):
+        from chunkflow_tpu.core import telemetry
+
+        telemetry.inc("chaos/injected")
+        raise ChaosError(
+            f"chaos injected at {name} "
+            f"(kill #{sum(plan.kills.values())}, spec {plan.spec!r})"
+        )
+
+
+def injections() -> Dict[str, int]:
+    """Per-point kill counts of the current plan (empty when inactive).
+    The acceptance test asserts every lifecycle stage died >= once."""
+    plan = _current_plan()
+    return dict(plan.kills) if plan else {}

@@ -68,4 +68,15 @@ echo "== e2e overlap gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py e2e_overlap || rc=$((rc == 0 ? 1 : rc))
 stage_time "e2e overlap gate"
+
+# --- resilience overhead gate ----------------------------------------------
+# Fault-tolerance layer on-vs-off over the e2e_overlap workload
+# (docs/fault_tolerance.md): supervised claims + completion ledger +
+# lease heartbeat must cost < 3% wall-clock (reported as gate_pass);
+# the process only fails past 15% (a lock/fsync landed on the per-task
+# hot path), so shared-box noise cannot redden CI.
+echo "== resilience overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py resilience_overhead || rc=$((rc == 0 ? 1 : rc))
+stage_time "resilience overhead gate"
 exit $rc

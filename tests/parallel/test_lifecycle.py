@@ -1,0 +1,590 @@
+"""Task lifecycle layer: ledger, leases, retries, dead-letter, resume.
+
+Covers the supervision units (parallel/lifecycle.py), the crash-recovery
+contract (a claimed-but-unacked task reappears exactly once and is
+ledger-skipped on replay), and the acceptance chaos run: with seeded
+fault injection killing every lifecycle stage at least once over a
+12-task queue, the drained volume is bit-identical to a fault-free run,
+the ledger holds exactly one done-marker per bbox, and the poison task
+lands in the dead-letter store with its failure reason.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.parallel import lifecycle
+from chunkflow_tpu.parallel.lifecycle import (
+    FileLedger,
+    LeaseRenewer,
+    LifecycleSupervisor,
+    MemoryLedger,
+    PermanentTaskError,
+    TransientTaskError,
+    backoff_delay,
+    classify_error,
+    open_ledger,
+)
+from chunkflow_tpu.parallel.queues import FileQueue, MemoryQueue, QueueBase
+from chunkflow_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Chaos plans and telemetry are process-global; never leak them."""
+    chaos.reset()
+    telemetry.reset()
+    yield
+    chaos.reset()
+    telemetry.reset()
+    # a test that errors mid-claim must not leave in-flight registrations
+    for lc in lifecycle.inflight():
+        lifecycle._unregister(lc)
+
+
+# ---------------------------------------------------------------------------
+# classification + backoff
+# ---------------------------------------------------------------------------
+def test_classify_error():
+    assert classify_error(ValueError("bad bbox")) == "permanent"
+    assert classify_error(PermanentTaskError("poison")) == "permanent"
+    assert classify_error(OSError("storage blip")) == "transient"
+    assert classify_error(RuntimeError("flake")) == "transient"
+    assert classify_error(TransientTaskError("throttled")) == "transient"
+    assert classify_error(chaos.ChaosError("injected")) == "transient"
+
+
+def test_backoff_delay_bounds_and_determinism():
+    import random
+
+    rng = random.Random(7)
+    delays = [backoff_delay(a, base=0.5, cap=4.0, rng=rng)
+              for a in range(1, 8)]
+    for attempt, delay in enumerate(delays, start=1):
+        assert 0.0 <= delay <= min(4.0, 0.5 * 2 ** (attempt - 1))
+    # seeded: the whole fleet's jitter is reproducible in tests
+    rng2 = random.Random(7)
+    assert delays == [backoff_delay(a, base=0.5, cap=4.0, rng=rng2)
+                      for a in range(1, 8)]
+
+
+# ---------------------------------------------------------------------------
+# completion ledger
+# ---------------------------------------------------------------------------
+def test_memory_ledger_registry_and_idempotence():
+    led = MemoryLedger.open("ml-test")
+    assert MemoryLedger.open("ml-test") is led
+    assert not led.is_done("0-4_0-4_0-4")
+    led.mark_done("0-4_0-4_0-4")
+    led.mark_done("0-4_0-4_0-4")  # idempotent
+    assert led.is_done("0-4_0-4_0-4")
+    assert led.keys() == ["0-4_0-4_0-4"]
+    assert "0-4_0-4_0-4" in led and len(led) == 1
+
+
+def test_file_ledger_durable_and_idempotent(tmp_path):
+    led = FileLedger(str(tmp_path / "ledger"))
+    led.mark_done("0-4_0-4_0-4")
+    led.mark_done("0-4_0-4_0-4")
+    # a fresh handle on the same dir (a new process resuming) sees it
+    led2 = FileLedger(str(tmp_path / "ledger"))
+    assert led2.is_done("0-4_0-4_0-4")
+    assert led2.keys() == ["0-4_0-4_0-4"]
+    # exactly one marker file per key
+    done = [n for n in os.listdir(led.dir) if n.endswith(".done")]
+    assert len(done) == 1
+
+
+def test_open_ledger_specs(tmp_path):
+    assert isinstance(open_ledger("memory://x"), MemoryLedger)
+    assert isinstance(open_ledger(str(tmp_path / "ld")), FileLedger)
+    assert isinstance(open_ledger("file://" + str(tmp_path / "ld2")),
+                      FileLedger)
+
+
+# ---------------------------------------------------------------------------
+# lease heartbeats
+# ---------------------------------------------------------------------------
+def test_lease_renewer_keeps_slow_task_claimed():
+    q = MemoryQueue("lease-slow", visibility_timeout=0.15)
+    q.send_messages(["task"])
+    handle, _ = q.receive()
+    renewer = LeaseRenewer(q, handle, interval=0.05).start()
+    try:
+        time.sleep(0.4)  # well past the static visibility timeout
+        assert q.receive() is None  # heartbeat held the lease
+        assert renewer.renewals >= 3
+    finally:
+        renewer.stop()
+    time.sleep(0.2)
+    assert q.receive() is not None  # no heartbeat: lease expires again
+    assert telemetry.snapshot()["counters"]["lease/renewals"] >= 3
+
+
+def test_supervisor_heartbeat_holds_in_flight_leases():
+    """The supervisor runs ONE heartbeat thread for all of its in-flight
+    claims (not a thread per task): a slow task outliving the static
+    visibility timeout stays leased until commit."""
+    q = MemoryQueue("hb-sup", visibility_timeout=0.15)
+    q.send_messages(["slow-task"])
+    sup = LifecycleSupervisor(q, lease_renew=0.05)
+    gen = sup.tasks(num=1)
+    lc = next(gen)
+    try:
+        time.sleep(0.4)  # "compute" well past the visibility timeout
+        assert q.receive() is None  # heartbeat held the lease
+        assert telemetry.snapshot()["counters"]["lease/renewals"] >= 3
+        lc.commit()
+        assert len(q) == 0
+    finally:
+        gen.close()  # retires the heartbeat + restores SIGTERM
+
+
+def test_lease_renewer_survives_renew_failure():
+    class BrokenQueue(QueueBase):
+        def renew(self, handle, timeout=None):
+            raise OSError("queue gone")
+
+    renewer = LeaseRenewer(BrokenQueue(), "h", interval=0.05).start()
+    time.sleep(0.15)
+    renewer.stop()  # must not have died with an unhandled exception
+    assert renewer.renewals == 0
+    assert telemetry.snapshot()["counters"]["lease/renew_failures"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# supervisor claim/commit/release
+# ---------------------------------------------------------------------------
+def test_claim_skips_ledgered_task_idempotently():
+    q = MemoryQueue("claim-skip", visibility_timeout=100)
+    led = MemoryLedger("claim-skip-ledger")
+    led.mark_done("0-4_0-4_0-4")
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, ledger=led)
+    handle, body = q.receive()
+    assert sup.claim(handle, body) is None
+    assert len(q) == 0 and not q.invisible  # acked, not redelivered
+    assert telemetry.snapshot()["counters"]["ledger/skips"] == 1
+
+
+def test_claim_dead_letters_crash_loop():
+    """Redelivered past the retry budget with no recorded failure: the
+    worker died mid-compute every time — dead-letter at claim."""
+    q = MemoryQueue("claim-loop", visibility_timeout=100)
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, max_retries=2)
+    for _ in range(2):  # two crashed deliveries
+        handle, body = q.receive()
+        q.nack(handle)  # redeliverable, count retained
+    handle, body = q.receive()  # third delivery: receives=3 > 2
+    assert sup.claim(handle, body) is None
+    assert len(q) == 0
+    dead = q.dead_letters()
+    assert len(dead) == 1 and "crash loop" in dead[0]["reason"]
+
+
+def test_commit_acks_and_marks_ledger():
+    q = MemoryQueue("commit", visibility_timeout=100)
+    led = MemoryLedger("commit-ledger")
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, ledger=led)
+    handle, body = q.receive()
+    lc = sup.claim(handle, body)
+    lc.task = {"log": {"timer": {}}}
+    lc.commit()
+    assert led.is_done(body)
+    assert len(q) == 0 and not q.invisible
+    assert lifecycle.inflight() == []
+    lc.commit()  # terminal transitions are idempotent
+
+
+def test_release_transient_retries_with_backoff():
+    q = MemoryQueue("release-retry", visibility_timeout=100)
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, max_retries=3, backoff_base=0.02,
+                              backoff_cap=0.05, seed=1)
+    handle, body = q.receive()
+    lc = sup.claim(handle, body)
+    assert lc.release(OSError("storage blip")) == "retried"
+    assert lifecycle.inflight() == []
+    time.sleep(0.1)  # > the backoff cap
+    handle2, body2 = q.receive()
+    assert body2 == body
+    assert q.receive_count(handle2) == 2
+
+
+def test_release_permanent_dead_letters_immediately():
+    q = MemoryQueue("release-perm", visibility_timeout=100)
+    q.send_messages(["NOT_A_BBOX"])
+    sup = LifecycleSupervisor(q, max_retries=5)
+    handle, body = q.receive()
+    lc = sup.claim(handle, body)
+    assert lc.release(ValueError("cannot parse")) == "dead"
+    dead = q.dead_letters()
+    assert len(dead) == 1
+    assert "ValueError" in dead[0]["reason"]
+    assert "cannot parse" in dead[0]["reason"]
+
+
+def test_release_exhausted_budget_dead_letters():
+    """A task that fails --max-retries times lands in the dead-letter
+    store (acceptance criterion)."""
+    q = MemoryQueue("release-budget", visibility_timeout=100)
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, max_retries=2, backoff_base=0.01,
+                              backoff_cap=0.02, seed=3)
+    outcomes = []
+    while True:
+        item = q.receive()
+        if item is None:
+            time.sleep(0.03)
+            item = q.receive()
+            if item is None:
+                break
+        lc = sup.claim(*item)
+        if lc is None:
+            break
+        outcomes.append(lc.release(RuntimeError("flaky op")))
+        if outcomes[-1] == "dead":
+            break
+    assert outcomes == ["retried", "dead"]  # fails max_retries=2 times
+    dead = q.dead_letters()
+    assert len(dead) == 1 and "flaky op" in dead[0]["reason"]
+    snap = telemetry.snapshot()["counters"]
+    assert snap["tasks/retried"] == 1
+    assert snap["tasks/dead_lettered"] == 1
+
+
+def test_release_preemption_nacks_and_flushes_writes():
+    from concurrent.futures import ThreadPoolExecutor
+
+    q = MemoryQueue("release-preempt", visibility_timeout=100)
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q)
+    handle, body = q.receive()
+    lc = sup.claim(handle, body)
+    flushed = threading.Event()
+    with ThreadPoolExecutor(1) as pool:
+        lc.task = {"log": {"timer": {}},
+                   "pending_writes": [pool.submit(flushed.set)]}
+        assert lc.release(SystemExit(143)) == "preempted"
+    assert flushed.is_set()  # pending writes flushed before exit
+    handle2, body2 = q.receive()  # immediately visible again
+    assert body2 == body
+
+
+def test_handle_failure_charges_culprit_surrenders_bystanders():
+    """One task's failure must not burn the retry budget of every task
+    in the pipelined in-flight window: the tagged culprit is released
+    (retried/dead-lettered), the bystanders surrender (immediate nack,
+    no failure recorded)."""
+    q = MemoryQueue("culprit", visibility_timeout=100)
+    q.send_messages(["a", "b", "c"])
+    sup = LifecycleSupervisor(q, max_retries=3, backoff_base=0.01,
+                              backoff_cap=0.01, seed=0)
+    lcs = [sup.claim(*q.receive()) for _ in range(3)]
+    exc = RuntimeError("op died on b")
+    lifecycle.tag_culprit(exc, lcs[1])
+    lifecycle.tag_culprit(exc, lcs[2])  # first tag wins
+    assert lifecycle.handle_failure(exc) is True
+    snap = telemetry.snapshot()["counters"]
+    assert snap["tasks/retried"] == 1  # only the culprit
+    assert snap["tasks/surrendered"] == 2
+    # bystanders redeliverable immediately; culprit after its backoff
+    assert len(q) == 2
+    time.sleep(0.05)
+    assert len(q) == 3
+
+
+def test_tag_culprit_via_task_dict():
+    q = MemoryQueue("culprit-dict", visibility_timeout=100)
+    q.send_messages(["a", "b"])
+    sup = LifecycleSupervisor(q, backoff_base=0.01, backoff_cap=0.01)
+    lc_a = sup.claim(*q.receive())
+    lc_b = sup.claim(*q.receive())
+    lc_a.task = {"log": {"timer": {}}, "lifecycle": lc_a}
+    exc = OSError("storage blip")
+    lifecycle.tag_culprit(exc, lc_a.task)  # operators tag the task dict
+    assert lifecycle.handle_failure(exc) is True
+    snap = telemetry.snapshot()["counters"]
+    assert snap["tasks/retried"] == 1
+    assert snap["tasks/surrendered"] == 1
+
+
+def test_handle_failure_contains_task_errors_only():
+    assert lifecycle.handle_failure(RuntimeError("x")) is False  # no inflight
+    q = MemoryQueue("handle-fail", visibility_timeout=100)
+    q.send_messages(["a", "b"])
+    sup = LifecycleSupervisor(q, max_retries=3, backoff_base=0.01,
+                              backoff_cap=0.01, seed=0)
+    lcs = [sup.claim(*q.receive()) for _ in range(2)]
+    assert len(lifecycle.inflight()) == 2
+    # task failure: every in-flight task released, worker continues
+    assert lifecycle.handle_failure(RuntimeError("op died")) is True
+    assert lifecycle.inflight() == []
+    time.sleep(0.05)
+    assert len(q) == 2  # both back after backoff
+    # preemption: released (nacked) but the worker must exit
+    lcs = [sup.claim(*q.receive()) for _ in range(2)]
+    assert lifecycle.handle_failure(SystemExit(143)) is False
+    assert len(q) == 2  # nacked immediately, no backoff
+
+
+def test_preemption_handler_routes_sigterm():
+    restore = lifecycle.install_preemption_handler()
+    try:
+        with pytest.raises(SystemExit) as exc_info:
+            os.kill(os.getpid(), signal.SIGTERM)
+            # the signal is delivered on the next bytecode boundary
+            time.sleep(0.5)
+        assert exc_info.value.code == 143
+    finally:
+        restore()
+    assert signal.getsignal(signal.SIGTERM) is not None
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: exactly-once effects from at-least-once delivery
+# ---------------------------------------------------------------------------
+def test_crash_recovery_exactly_once(tmp_path):
+    """A claimed task whose worker dies (no ack) reappears exactly once
+    after the visibility timeout, completes on retry, and is
+    ledger-skipped when the whole queue is replayed."""
+    q = FileQueue(str(tmp_path / "q"), visibility_timeout=0.2)
+    ledger = FileLedger(str(tmp_path / "ledger"))
+    q.send_messages(["0-4_0-4_0-4"])
+    sup = LifecycleSupervisor(q, ledger=ledger, max_retries=3)
+
+    # worker 1 claims and dies: no ack, no recorded failure
+    handle, body = q.receive()
+    lc = sup.claim(handle, body)
+    assert lc is not None
+    lifecycle._unregister(lc)  # simulated process death
+
+    assert q.receive() is None  # invisible while "in compute"
+    time.sleep(0.3)
+    item = q.receive()  # reappears after the timeout...
+    assert item is not None and item[1] == body
+    assert q.receive() is None  # ...exactly once
+
+    # worker 2 completes the retry
+    lc2 = sup.claim(*item)
+    assert lc2 is not None and lc2.receives == 2
+    lc2.commit()
+    assert ledger.is_done(body)
+    assert len(q) == 0
+
+    # replay the entire queue (operator re-seeds after an interruption):
+    # the committed task is skipped idempotently, no recompute
+    q.send_messages([body])
+    item = q.receive()
+    assert sup.claim(*item) is None  # ledger skip acks it
+    assert len(q) == 0 and q.receive() is None
+    assert telemetry.snapshot()["counters"]["ledger/skips"] == 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: seeded chaos over a 12-task volume through the full CLI
+# ---------------------------------------------------------------------------
+LIFECYCLE_POINTS = (
+    "lifecycle/claim",       # task claimed, before compute
+    "op/load-h5",            # upstream load operator
+    "op/save-h5",            # storage write operator
+    "lifecycle/pre_ledger",  # writes durable, ledger not yet marked
+    "lifecycle/pre_ack",     # ledger marked, queue not yet acked
+)
+SCHEDULER_POINTS = (
+    "scheduler/dispatch",    # adaptive scheduler device dispatch
+    "scheduler/post",        # adaptive scheduler host post stage
+)
+
+
+def _run_worker(tmp_path, tag, qdir, in_dir, ledger=None):
+    out_dir = tmp_path / f"out-{tag}"
+    out_dir.mkdir()
+    from chunkflow_tpu.flow.cli import main
+
+    # the retry budget is receive-count based (SQS semantics): innocent
+    # bystander redeliveries — surrendered claims when ANOTHER in-flight
+    # task's failure tears down the shared chain — also count a receive,
+    # so the budget must exceed (pipeline depth x injected kills); 10
+    # covers the 7-kill plan with margin. The tight-budget dead-letter
+    # path is covered by test_release_exhausted_budget_dead_letters.
+    args = [
+        "fetch-task-from-queue", "-q", qdir, "-r", "20",
+        "--max-retries", "10", "--lease-renew", "0.25",
+        "--backoff-base", "0.01", "--backoff-cap", "0.05",
+    ]
+    if ledger:
+        args += ["--ledger", ledger]
+    args += [
+        "load-h5", "-f", str(in_dir) + "/",
+        "inference", "-s", "4", "8", "8", "-v", "1", "2", "2",
+        "-c", "1", "-f", "identity", "--no-crop-output-margin",
+        "--async-depth", "2",
+        "save-h5", "--file-name", str(out_dir) + "/",
+        "delete-task-in-queue",
+    ]
+    result = CliRunner().invoke(main, args, catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    return out_dir
+
+
+def _seed_volume(tmp_path, tag):
+    """12 distinct random input chunks + a queue holding their bboxes."""
+    import itertools
+
+    from chunkflow_tpu.chunk import Chunk
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    in_dir = tmp_path / f"in-{tag}"
+    in_dir.mkdir()
+    rng = np.random.default_rng(11)
+    bodies = []
+    for zi, yi, xi in itertools.product(range(3), range(2), range(2)):
+        off = (zi * 8, yi * 16, xi * 16)
+        c = Chunk(rng.random((8, 16, 16)).astype(np.float32),
+                  voxel_offset=off)
+        c.to_h5(str(in_dir) + "/")
+        bodies.append(c.bbox.string)
+    qdir = str(tmp_path / f"q-{tag}")
+    open_queue(qdir).send_messages(bodies)
+    return qdir, in_dir, bodies
+
+
+def _load_outputs(out_dir):
+    import h5py
+
+    outputs = {}
+    for path in sorted(out_dir.iterdir()):
+        with h5py.File(path, "r") as f:
+            outputs[path.name] = np.asarray(f["main"][:])
+    return outputs
+
+
+@pytest.mark.parametrize("sched", ["adaptive", "static"])
+def test_chaos_run_converges_bit_identical(tmp_path, monkeypatch, sched):
+    """The acceptance run: every lifecycle stage killed at least once
+    across a 12-task queue + one poison task; the drained volume is
+    bit-identical to the fault-free leg, the ledger holds exactly one
+    done-marker per bbox, no task lost or double-committed, and the
+    poison task is dead-lettered with its reason and requeueable via
+    the CLI. Both scheduler modes: the static (PR 2) pipeline has no
+    scheduler/* stages, so those kill points only apply to adaptive."""
+    points = LIFECYCLE_POINTS + (
+        SCHEDULER_POINTS if sched == "adaptive" else ()
+    )
+    monkeypatch.setenv("CHUNKFLOW_SCHED", sched)
+    monkeypatch.setattr(QueueBase, "retry_sleep", 0.02)
+
+    # fault-free reference leg
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "ref")
+    ref_out = _run_worker(tmp_path, "ref", qdir, in_dir)
+    reference = _load_outputs(ref_out)
+    assert len(reference) == 12
+
+    # chaos leg: same inputs, seeded kills at every stage + a poison task
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "chaos")
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    open_queue(qdir).send_messages(["NOT_A_BBOX"])
+    ledger_dir = str(tmp_path / "ledger")
+    chaos.configure("once=" + ",".join(points))
+    try:
+        chaos_out = _run_worker(
+            tmp_path, "chaos", qdir, in_dir, ledger=ledger_dir
+        )
+        injected = chaos.injections()
+    finally:
+        chaos.reset()
+
+    # every lifecycle stage died at least once
+    for point in points:
+        assert injected.get(point, 0) >= 1, (point, injected)
+
+    # bit-identical convergence
+    faulty = _load_outputs(chaos_out)
+    assert sorted(faulty) == sorted(reference)
+    for name in reference:
+        assert np.array_equal(faulty[name], reference[name]), name
+
+    # exactly one done-marker per bbox; no task lost or double-committed
+    ledger = FileLedger(ledger_dir)
+    assert sorted(ledger.keys()) == sorted(bodies)
+
+    # the poison task — and ONLY the poison task — is dead-lettered,
+    # with its failure reason (innocent bystanders of injected kills
+    # must not be falsely dead-lettered)
+    queue = open_queue(qdir)
+    assert len(queue) == 0
+    dead = queue.dead_letters()
+    assert len(dead) == 1, dead
+    assert dead[0]["body"] == "NOT_A_BBOX"
+    assert "ValueError" in dead[0]["reason"]
+
+    # ...and requeueable via the CLI
+    from chunkflow_tpu.flow.cli import main
+
+    result = CliRunner().invoke(
+        main, ["dead-letter", "-q", qdir, "--requeue"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    assert "requeued 1" in result.output
+    assert len(queue) == 1 and queue.dead_letters() == []
+
+
+def test_supervised_resume_after_interrupted_run(tmp_path, monkeypatch):
+    """Kill a run partway (SystemExit mid-stream), then rerun the SAME
+    queue replay: already-committed tasks ledger-skip, the rest
+    complete, and the output set is whole."""
+    monkeypatch.setattr(QueueBase, "retry_sleep", 0.02)
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "resume")
+    ledger_dir = str(tmp_path / "resume-ledger")
+
+    from chunkflow_tpu.flow.cli import main
+
+    out_dir = tmp_path / "out-resume"
+    out_dir.mkdir()
+
+    def worker_args(num):
+        args = [
+            "fetch-task-from-queue", "-q", qdir, "-r", "3",
+            "--ledger", ledger_dir, "--max-retries", "2",
+            "--backoff-base", "0.01",
+        ]
+        if num is not None:
+            args += ["--num", str(num)]
+        return args + [
+            "load-h5", "-f", str(in_dir) + "/",
+            "save-h5", "--file-name", str(out_dir) + "/",
+            "delete-task-in-queue",
+        ]
+
+    # first worker processes 5 tasks, then "the VM is reclaimed"
+    result = CliRunner().invoke(main, worker_args(5), catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    ledger = FileLedger(ledger_dir)
+    assert len(ledger.keys()) == 5
+
+    # operator replays the WHOLE task grid into the queue (the standard
+    # resume move: no bookkeeping of which tasks remain)
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    queue = open_queue(qdir)
+    queue.send_messages(bodies)
+
+    telemetry.reset()
+    result = CliRunner().invoke(main, worker_args(None),
+                                catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    assert sorted(FileLedger(ledger_dir).keys()) == sorted(bodies)
+    assert len(queue) == 0
+    assert len(_load_outputs(out_dir)) == 12
+    # the 5 committed tasks were skipped, not recomputed
+    assert telemetry.snapshot()["counters"]["ledger/skips"] >= 5

@@ -1,0 +1,90 @@
+"""The deterministic fault-injection harness (testing/chaos.py)."""
+import pytest
+
+from chunkflow_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def test_inactive_is_noop():
+    assert not chaos.active()
+    chaos.chaos_point("lifecycle/claim")  # must not raise
+    assert chaos.injections() == {}
+
+
+def test_once_kills_each_point_exactly_once():
+    chaos.configure("once=a/b,c/d")
+    with pytest.raises(chaos.ChaosError):
+        chaos.chaos_point("a/b")
+    chaos.chaos_point("a/b")  # second hit survives
+    with pytest.raises(chaos.ChaosError):
+        chaos.chaos_point("c/d")
+    chaos.chaos_point("c/d")
+    assert chaos.injections() == {"a/b": 1, "c/d": 1}
+
+
+def test_rate_sequence_is_seed_deterministic():
+    def kill_sequence():
+        chaos.configure("seed=42:rate=0.5:points=op/*")
+        seq = []
+        for _ in range(32):
+            try:
+                chaos.chaos_point("op/load-h5")
+                seq.append(False)
+            except chaos.ChaosError:
+                seq.append(True)
+        return seq
+
+    first = kill_sequence()
+    assert any(first) and not all(first)  # actually Bernoulli at 0.5
+    assert kill_sequence() == first  # pure function of (seed, hit order)
+
+
+def test_fnmatch_patterns_and_nonmatching_points():
+    chaos.configure("seed=1:rate=1.0:points=op/*")
+    chaos.chaos_point("lifecycle/claim")  # no match: survives
+    with pytest.raises(chaos.ChaosError):
+        chaos.chaos_point("op/save-h5")
+
+
+def test_max_kills_bounds_total_injections():
+    chaos.configure("seed=1:rate=1.0:points=op/*:max=2")
+    for _ in range(2):
+        with pytest.raises(chaos.ChaosError):
+            chaos.chaos_point("op/x")
+    chaos.chaos_point("op/x")  # budget spent: no more kills
+    assert sum(chaos.injections().values()) == 2
+
+
+def test_env_var_pickup_and_change(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_CHAOS", "once=env/point")
+    assert chaos.active()
+    with pytest.raises(chaos.ChaosError):
+        chaos.chaos_point("env/point")
+    monkeypatch.setenv("CHUNKFLOW_CHAOS", "")
+    assert not chaos.active()  # re-read: plan dropped with the env var
+
+
+def test_configure_overrides_env(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_CHAOS", "once=env/point")
+    chaos.configure(None)  # explicit off wins over the env until reset()
+    assert not chaos.active()
+    chaos.chaos_point("env/point")
+    chaos.reset()
+    assert chaos.active()
+
+
+def test_bad_spec_raises():
+    with pytest.raises(ValueError, match="bad CHUNKFLOW_CHAOS field"):
+        chaos.configure("bogus=1")
+
+
+def test_chaos_error_is_transient():
+    from chunkflow_tpu.parallel.lifecycle import classify_error
+
+    assert classify_error(chaos.ChaosError("injected")) == "transient"
