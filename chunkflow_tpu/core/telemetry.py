@@ -48,11 +48,25 @@ Span naming convention: ``<layer>/<phase>`` — ``pipeline/stage``,
 *consumes* this stream (per-phase stall totals via :func:`hist_totals`
 drive its depth controller) and *feeds* it: ``scheduler/depth/<knob>``
 gauges and ``depth_change`` events record every widening decision.
+
+Fleet correlation (docs/observability.md "Fleet view"): every emitted
+line is stamped with this process's :func:`worker_id` (stable host+pid
+identity, ``CHUNKFLOW_WORKER_ID`` override for pid-namespaced
+containers), and — while a task is in flight under
+:func:`task_context` — with the task's ``trace_id``, the id minted when
+the task was first submitted to a queue (parallel/queues.py). Merged
+multi-worker JSONL therefore reconstructs a task's full history across
+claim/retry/requeue hops between workers. The task context is a
+``contextvars.ContextVar``: thread- and generator-safe on the host
+side, and statically banned inside jitted code like every other
+telemetry call (graftlint GL007).
 """
 from __future__ import annotations
 
+import contextvars
 import json
 import os
+import socket
 import threading
 import time
 from typing import Dict, Optional
@@ -60,7 +74,8 @@ from typing import Dict, Optional
 __all__ = [
     "enabled", "configure", "configured_path", "inc", "gauge", "observe",
     "span", "event", "snapshot", "flush", "reset", "summary_table",
-    "hist_totals",
+    "hist_totals", "worker_id", "task_context", "current_trace_id",
+    "snapshot_interval",
 ]
 
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -71,6 +86,104 @@ def enabled() -> bool:
     reacting to a config push) can flip it at runtime."""
     return os.environ.get("CHUNKFLOW_TELEMETRY", "1").lower() \
         not in _OFF_VALUES
+
+
+# ---------------------------------------------------------------------------
+# fleet identity + per-task trace context
+# ---------------------------------------------------------------------------
+_WORKER_ID: Optional[str] = None
+
+
+def worker_id() -> str:
+    """Stable identity of this worker process: ``<hostname>-<pid>``, or
+    the ``CHUNKFLOW_WORKER_ID`` env override (pid-namespaced containers
+    where every worker is pid 1, and tests simulating a fleet in one
+    process). Cached after first use; :func:`reset` clears the cache (a
+    forked child should call :func:`configure`/:func:`reset` anyway —
+    it must not inherit the parent's sink)."""
+    global _WORKER_ID
+    if _WORKER_ID is None:
+        _WORKER_ID = (
+            os.environ.get("CHUNKFLOW_WORKER_ID")
+            or f"{socket.gethostname()}-{os.getpid()}"
+        )
+    return _WORKER_ID
+
+
+_TASK_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "chunkflow_trace_id", default=None
+)
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id of the task currently in flight on this
+    thread/context, or None outside any :func:`task_context`."""
+    return _TASK_CTX.get()
+
+
+class _TaskContext:
+    """Scoped trace-id binding; ``trace_id=None`` is a no-op so an
+    un-traced task never clobbers an enclosing context."""
+
+    __slots__ = ("trace_id", "_token")
+
+    def __init__(self, trace_id: Optional[str]):
+        self.trace_id = trace_id
+        self._token = None
+
+    def __enter__(self):
+        if self.trace_id is not None:
+            self._token = _TASK_CTX.set(self.trace_id)
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _TASK_CTX.reset(self._token)
+            self._token = None
+        return False
+
+
+def task_context(trace_id: Optional[str]):
+    """Bind ``trace_id`` for the dynamic extent of a ``with`` block:
+    every span/gauge/event emitted inside is stamped with it (plus
+    :func:`worker_id`), so a task's history is reconstructable from
+    merged multi-worker JSONL. Call sites hold the task dict or
+    lifecycle object: the runtime operator wrapper, the adaptive
+    scheduler's dispatch/finalize, the lifecycle claim/commit/release
+    paths. Host-side only (GL007)."""
+    return _TaskContext(trace_id)
+
+
+def _stamp(payload: dict) -> dict:
+    """Fleet-correlation stamp on an outgoing JSONL payload."""
+    payload["worker"] = worker_id()
+    trace_id = _TASK_CTX.get()
+    if trace_id is not None:
+        payload["trace_id"] = trace_id
+    return payload
+
+
+def snapshot_interval() -> int:
+    """Tasks between periodic snapshot events in the supervised claim
+    loop (``CHUNKFLOW_TELEMETRY_SNAPSHOT_EVERY``, default 8; 0
+    disables). Without it a killed worker leaves no counter record —
+    snapshots otherwise ride only the end-of-run flush()."""
+    raw = os.environ.get("CHUNKFLOW_TELEMETRY_SNAPSHOT_EVERY", "")
+    try:
+        return max(0, int(raw)) if raw else 8
+    except ValueError:
+        return 8
+
+
+def _max_sink_bytes() -> int:
+    """JSONL rotation threshold (``CHUNKFLOW_TELEMETRY_MAX_MB``,
+    default a generous 256 MB; <=0 disables rotation)."""
+    raw = os.environ.get("CHUNKFLOW_TELEMETRY_MAX_MB", "")
+    try:
+        mb = float(raw) if raw else 256.0
+    except ValueError:
+        mb = 256.0
+    return int(mb * (1 << 20))
 
 
 class _Registry:
@@ -85,6 +198,8 @@ class _Registry:
         self.hists: Dict[str, list] = {}
         self.sink = None
         self.sink_path: Optional[str] = None
+        self.sink_bytes = 0
+        self.max_sink_bytes = 0
 
     # -- metric updates (caller holds no lock) -------------------------
     def add_counter(self, name: str, n: float) -> None:
@@ -111,22 +226,47 @@ class _Registry:
         with self.lock:
             if self.sink is None:
                 return
+            line = json.dumps(payload) + "\n"
             try:
-                self.sink.write(json.dumps(payload) + "\n")
+                self.sink.write(line)
             except (OSError, ValueError):
                 # a full disk / closed sink must never take the pipeline
                 # down; drop the event and keep computing
                 self.sink = None
+                return
+            self.sink_bytes += len(line)
+            if 0 < self.max_sink_bytes < self.sink_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Size-capped rotation (caller holds the lock): the current
+        file moves to ``<path>.1`` (replacing any previous rotation) and
+        a fresh file opens at ``<path>`` — a long-lived worker keeps at
+        most two generations on disk. ``load_telemetry_dir`` reads both
+        (flow/log_summary.py)."""
+        try:
+            self.sink.close()
+        except OSError:
+            pass
+        try:
+            os.replace(self.sink_path, self.sink_path + ".1")
+            self.sink = open(self.sink_path, "a")
+            self.sink_bytes = 0
+        except OSError:
+            self.sink = None  # unrotatable sink: stop emitting, keep computing
 
 
 _REG = _Registry()
 
 
 def configure(metrics_dir: Optional[str]) -> Optional[str]:
-    """Open (or close, with None) the per-process JSONL sink under
+    """Open (or close, with None) the per-worker JSONL sink under
     ``metrics_dir``. Returns the file path in effect, or None when
     disabled — with ``CHUNKFLOW_TELEMETRY=0`` nothing is created, so an
-    off run leaves no trace on disk."""
+    off run leaves no trace on disk. The file is named by
+    :func:`worker_id` (host+pid by default, so one file per process as
+    before); when it outgrows ``CHUNKFLOW_TELEMETRY_MAX_MB`` it rotates
+    to a ``.1`` suffix."""
     with _REG.lock:
         if _REG.sink is not None:
             try:
@@ -137,10 +277,19 @@ def configure(metrics_dir: Optional[str]) -> Optional[str]:
     if metrics_dir is None or not enabled():
         return None
     os.makedirs(metrics_dir, exist_ok=True)
-    path = os.path.join(metrics_dir, f"telemetry-{os.getpid()}.jsonl")
+    safe = "".join(
+        ch if ch.isalnum() or ch in "._-" else "_" for ch in worker_id()
+    )
+    path = os.path.join(metrics_dir, f"telemetry-{safe}.jsonl")
     sink = open(path, "a")
+    try:
+        existing = os.path.getsize(path)
+    except OSError:
+        existing = 0
     with _REG.lock:
         _REG.sink, _REG.sink_path = sink, path
+        _REG.sink_bytes = existing
+        _REG.max_sink_bytes = _max_sink_bytes()
     return path
 
 
@@ -166,8 +315,8 @@ def gauge(name: str, value: float) -> None:
     _REG.set_gauge(name, value)
     _REG.add_hist(name, value)
     if _REG.sink is not None:
-        _REG.emit({"kind": "gauge", "name": name, "t": time.time(),
-                   "value": value})
+        _REG.emit(_stamp({"kind": "gauge", "name": name, "t": time.time(),
+                          "value": value}))
 
 
 def observe(name: str, value: float) -> None:
@@ -183,7 +332,7 @@ def event(kind: str, name: str, **attrs) -> None:
         return
     payload = {"kind": kind, "name": name, "t": time.time()}
     payload.update(attrs)
-    _REG.emit(payload)
+    _REG.emit(_stamp(payload))
 
 
 class _NullSpan:
@@ -222,7 +371,7 @@ class _Span:
                        "dur_s": self.duration, "pid": os.getpid()}
             if self.attrs:
                 payload.update(self.attrs)
-            _REG.emit(payload)
+            _REG.emit(_stamp(payload))
         return False
 
 
@@ -284,8 +433,8 @@ def flush() -> None:
         return
     snap = snapshot()
     if _REG.sink is not None:
-        _REG.emit({"kind": "snapshot", "t": time.time(),
-                   "pid": os.getpid(), **snap})
+        _REG.emit(_stamp({"kind": "snapshot", "t": time.time(),
+                          "pid": os.getpid(), **snap}))
         with _REG.lock:
             if _REG.sink is not None:
                 try:
@@ -295,8 +444,10 @@ def flush() -> None:
 
 
 def reset() -> None:
-    """Clear all metrics and close the sink (tests; each CLI invocation
-    is one process, so production never needs this)."""
+    """Clear all metrics, close the sink, and drop the cached worker
+    identity (tests; each CLI invocation is one process, so production
+    never needs this)."""
+    global _WORKER_ID
     with _REG.lock:
         _REG.counters.clear()
         _REG.gauges.clear()
@@ -307,6 +458,8 @@ def reset() -> None:
             except OSError:
                 pass
         _REG.sink, _REG.sink_path = None, None
+        _REG.sink_bytes = 0
+    _WORKER_ID = None
 
 
 # -- end-of-run reporting ----------------------------------------------

@@ -80,7 +80,13 @@ def name_option(default):
                    "attribution, cache counters) here; aggregate with "
                    "log-summary --metrics-dir (docs/observability.md). "
                    "CHUNKFLOW_TELEMETRY=0 disables all telemetry")
-def main(mip, dry_run, verbose, profile_dir, metrics_dir):
+@click.option("--metrics-port", type=int, default=None,
+              help="serve live /metrics (Prometheus text) + /healthz "
+                   "from this worker for the run's duration (0 binds an "
+                   "ephemeral port; CHUNKFLOW_METRICS_PORT is the env "
+                   "equivalent). CHUNKFLOW_TELEMETRY=0 creates no "
+                   "listener (docs/observability.md \"Fleet view\")")
+def main(mip, dry_run, verbose, profile_dir, metrics_dir, metrics_port):
     """chunkflow-tpu: compose chunk operators into a pipeline.
 
     \b
@@ -113,6 +119,19 @@ def main(mip, dry_run, verbose, profile_dir, metrics_dir):
         # configure BEFORE any stage runs so operator construction
         # (engine load, program cache) is visible in the stream too
         telemetry.configure(metrics_dir)
+    from chunkflow_tpu.parallel.restapi import (
+        exporter_port_from_env,
+        start_metrics_exporter,
+    )
+
+    port = metrics_port if metrics_port is not None \
+        else exporter_port_from_env()
+    state.metrics_server = (
+        start_metrics_exporter(port) if port is not None else None
+    )
+    if state.metrics_server is not None and verbose:
+        host, bound = state.metrics_server.server_address[:2]
+        print(f"metrics exporter: http://{host}:{bound}/metrics")
 
 
 def _print_run_telemetry(verbose: int) -> None:
@@ -152,7 +171,8 @@ def _print_run_telemetry(verbose: int) -> None:
 
 
 @main.result_callback()
-def run_pipeline(stages, mip, dry_run, verbose, profile_dir, metrics_dir):
+def run_pipeline(stages, mip, dry_run, verbose, profile_dir, metrics_dir,
+                 metrics_port):
     if profile_dir:
         import jax
 
@@ -165,6 +185,13 @@ def run_pipeline(stages, mip, dry_run, verbose, profile_dir, metrics_dir):
 
             jax.profiler.stop_trace()
         _print_run_telemetry(verbose)
+        # the exporter's lifetime is the run's: a supervisor scraping a
+        # finished worker should see connection-refused, not stale data
+        server = getattr(state, "metrics_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            state.metrics_server = None
     if verbose:
         print(f"pipeline drained {count} task(s)")
 
@@ -510,6 +537,20 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
     supervised = (
         max_retries is not None or lease_renew > 0 or ledger is not None
     )
+    # --num is a PER-RUN cap, shared across chain rebuilds: a contained
+    # task failure rebuilds the stage chain (runtime.process_stream),
+    # which re-enters this generator — a budget local to one generator
+    # instance would reset on every rebuild, letting a worker grind a
+    # persistently-failing task until its receive count burns the whole
+    # retry budget instead of handing it to another worker
+    budget = {"left": num}
+
+    def consume_budget() -> bool:
+        """Count one claimed task; True when the run's budget is spent."""
+        if budget["left"] < 0:
+            return False  # -1: drain
+        budget["left"] -= 1
+        return budget["left"] <= 0
 
     @generator
     def stage(task):
@@ -546,6 +587,8 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
         if supervised and not crosshost:
             from chunkflow_tpu.parallel import lifecycle
 
+            if budget["left"] == 0:
+                return  # rebuild after the last budgeted task: done
             supervisor = lifecycle.LifecycleSupervisor(
                 queue,
                 ledger=lifecycle.open_ledger(ledger) if ledger else None,
@@ -554,7 +597,7 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
                 backoff_base=backoff_base,
                 backoff_cap=backoff_cap,
             )
-            for lc in supervisor.tasks(num=num):
+            for lc in supervisor.tasks(num=-1):
                 t = new_task()
                 try:
                     # a malformed body is the canonical poison task:
@@ -567,8 +610,11 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
                 t["queue"] = queue
                 t["task_handle"] = lc.handle
                 t["lifecycle"] = lc
+                t["trace_id"] = lc.trace_id
                 lc.task = t
                 yield t
+                if consume_budget():
+                    return
             return
         if supervised and crosshost:
             print(
@@ -577,7 +623,8 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
                 "unsupervised", file=sys.stderr,
             )
 
-        count = 0
+        if budget["left"] == 0:
+            return
         try:
             for handle, body in queue:
                 if crosshost:
@@ -586,9 +633,9 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
                 t["bbox"] = BoundingBox.from_string(body)
                 t["queue"] = queue
                 t["task_handle"] = handle
+                t["trace_id"] = queue.trace_id(handle)
                 yield t
-                count += 1
-                if 0 <= num <= count:
+                if consume_budget():
                     break
         finally:
             # sentinel on EVERY exit path — normal drain, --num cap,
@@ -653,14 +700,82 @@ def dead_letter_cmd(queue_name, requeue):
         else:
             print(f"{len(entries)} dead-letter task(s) in {queue_name}:")
             for entry in entries:
+                trace = entry.get("trace_id")
                 print(
                     f"  {entry.get('body', '')}  "
                     f"receives={entry.get('receives', 0)}  "
-                    f"reason={entry.get('reason', '')}"
+                    + (f"trace={trace}  " if trace else "")
+                    + f"reason={entry.get('reason', '')}"
                 )
         if requeue and not state.dry_run:
             n = queue.requeue_dead()
             print(f"requeued {n} task(s)")
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
+@main.command("fleet-status")
+@click.option("--queue-name", "-q", type=str, required=True)
+@click.option("--workers", "-w", type=str, default=None,
+              help="comma-separated worker /metrics endpoints "
+                   "(host:port or full URLs) to sample live")
+@click.option("--timeout", type=float, default=1.0,
+              help="per-worker scrape timeout in seconds")
+def fleet_status_cmd(queue_name, workers, timeout):
+    """Live fleet dashboard: queue depth, in-flight leases, receive and
+    dead-letter counts, plus each reachable worker's /healthz identity
+    and a few headline /metrics samples — exactly the signal surface the
+    future autoscaling supervisor will poll
+    (docs/observability.md "Fleet view")."""
+
+    @generator
+    def stage(task):
+        from chunkflow_tpu.parallel.queues import open_queue
+        from chunkflow_tpu.parallel.restapi import scrape_worker
+
+        queue = open_queue(queue_name)
+        stats = queue.stats()
+
+        def show(value):
+            return "?" if value is None else f"{value:g}"
+
+        print(
+            f"queue {queue.describe()}: "
+            f"pending={show(stats.get('pending'))} "
+            f"in-flight={show(stats.get('inflight'))} "
+            f"dead={show(stats.get('dead'))} "
+            f"receives={show(stats.get('receives'))}"
+        )
+        if stats.get("dead"):
+            print(
+                "  -> dead-letter tasks pending triage: inspect with "
+                f"`chunkflow dead-letter -q {queue_name}`"
+            )
+        for endpoint in (workers or "").split(","):
+            endpoint = endpoint.strip()
+            if not endpoint:
+                continue
+            sample = scrape_worker(endpoint, timeout=timeout)
+            if sample["error"] is not None:
+                print(f"worker {sample['endpoint']}: unreachable "
+                      f"({sample['error']})")
+                continue
+            health = sample["healthz"] or {}
+            metrics = sample["metrics"] or {}
+            committed = metrics.get("chunkflow_tasks_committed_total", 0)
+            retried = metrics.get("chunkflow_tasks_retried_total", 0)
+            dominant = metrics.get("chunkflow_stall_dominant_share")
+            line = (
+                f"worker {sample['endpoint']}: "
+                f"{health.get('worker', '?')} "
+                f"leases={health.get('inflight_leases', '?')} "
+                f"committed={committed:g} retried={retried:g}"
+            )
+            if dominant is not None:
+                line += f" dominant-stall-share={dominant:.0%}"
+            print(line)
         return
         yield  # pragma: no cover
 
@@ -1160,11 +1275,21 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
               help="telemetry JSONL dir (--metrics-dir of a previous run): "
                    "per-phase stall breakdown, ring occupancy, cache "
                    "builds/hits")
+@click.option("--fleet/--no-fleet", default=False,
+              help="merge multi-worker JSONL by worker identity: "
+                   "per-worker dominant stall, retries, ledger skips, "
+                   "cache hit rates (docs/observability.md \"Fleet view\")")
+@click.option("--trace-id", type=str, default=None,
+              help="with --fleet: also print this task's merged "
+                   "cross-worker timeline (submit → claim(s) → retries → "
+                   "commit/dead-letter)")
 @cartesian_option("--output-size", default=None)
-def log_summary_cmd(log_dir, summary_metrics_dir, output_size):
+def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
+                    output_size):
     """Aggregate per-task timing logs and/or telemetry JSONL into a
     throughput + stall-attribution report."""
     from chunkflow_tpu.flow.log_summary import (
+        print_fleet_summary,
         print_summary,
         print_telemetry_summary,
     )
@@ -1172,6 +1297,10 @@ def log_summary_cmd(log_dir, summary_metrics_dir, output_size):
     if log_dir is None and summary_metrics_dir is None:
         raise click.UsageError(
             "log-summary needs --log-dir and/or --metrics-dir"
+        )
+    if (fleet or trace_id) and summary_metrics_dir is None:
+        raise click.UsageError(
+            "log-summary --fleet/--trace-id needs --metrics-dir"
         )
 
     @generator
@@ -1183,7 +1312,10 @@ def log_summary_cmd(log_dir, summary_metrics_dir, output_size):
                 else None,
             )
         if summary_metrics_dir is not None:
-            print_telemetry_summary(summary_metrics_dir)
+            if fleet or trace_id:
+                print_fleet_summary(summary_metrics_dir, trace_id=trace_id)
+            else:
+                print_telemetry_summary(summary_metrics_dir)
         return
         yield  # pragma: no cover
 

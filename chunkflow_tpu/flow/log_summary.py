@@ -144,16 +144,22 @@ def print_summary(log_dir: str, output_size=None) -> None:
 # telemetry JSONL aggregation (core/telemetry.py event stream)
 # ---------------------------------------------------------------------------
 def load_telemetry_dir(metrics_dir: str) -> List[dict]:
-    """Parse every ``telemetry-*.jsonl`` under ``metrics_dir`` into a
-    flat event list (multi-process runs append one file per pid; the
-    aggregate is the fleet view). Torn trailing lines (a worker killed
+    """Parse every ``telemetry-*.jsonl`` (plus size-capped ``.jsonl.1``
+    rotations, read first so a worker's stream stays in order) under
+    ``metrics_dir`` into a flat event list — one file per worker; the
+    aggregate is the fleet view. Torn trailing lines (a worker killed
     mid-write) are skipped, not fatal."""
     events: List[dict] = []
     if not os.path.isdir(metrics_dir):
         return events
-    for name in sorted(os.listdir(metrics_dir)):
-        if not name.endswith(".jsonl"):
-            continue
+    names = [
+        name for name in os.listdir(metrics_dir)
+        if name.endswith(".jsonl") or name.endswith(".jsonl.1")
+    ]
+    # "<base>.jsonl.1" holds the OLDER events of "<base>.jsonl": sort
+    # rotations immediately before their live file
+    names.sort(key=lambda n: (n[:-2], 0) if n.endswith(".1") else (n, 1))
+    for name in names:
         with open(os.path.join(metrics_dir, name)) as f:
             for line in f:
                 line = line.strip()
@@ -166,6 +172,12 @@ def load_telemetry_dir(metrics_dir: str) -> List[dict]:
                 if isinstance(record, dict):
                     events.append(record)
     return events
+
+
+def _event_worker(record: dict) -> str:
+    """Worker identity of one event: the ``worker`` stamp, with a
+    pid-based fallback for pre-fleet streams."""
+    return str(record.get("worker") or f"pid-{record.get('pid', 0)}")
 
 
 def summarize_telemetry(events: List[dict]) -> dict:
@@ -211,13 +223,22 @@ def summarize_telemetry(events: List[dict]) -> dict:
             g[1] += value
             gauge_last[name] = value
         elif kind == "snapshot":
-            # last snapshot per pid wins (a run may flush more than once)
-            snapshots_by_pid[record.get("pid", 0)] = record
+            # last snapshot per worker wins (a run may flush more than
+            # once: the supervised claim loop emits periodic snapshots
+            # so killed workers still leave a counter record)
+            snapshots_by_pid[_event_worker(record)] = record
 
     counters: dict = {}
     for snap in snapshots_by_pid.values():
         for name, value in (snap.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + value
+        for name, value in (snap.get("gauges") or {}).items():
+            # snapshot gauges fill holes for streams with no gauge-level
+            # events (a worker killed before any sink was configured, or
+            # counters-only periodic snapshots)
+            if name not in gauge_stats:
+                gauge_stats[name] = [1, float(value)]
+                gauge_last[name] = float(value)
         for name, h in (snap.get("hists") or {}).items():
             # snapshot hists cover spans recorded while no sink was
             # configured yet; only fill holes, never double-count (and a
@@ -320,6 +341,13 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
             f"{agg['counters']['compile_cache/retrace_warnings']:g} "
             f"(builds exceeded the expected bucket count)"
         )
+    if agg["gauges"].get("device/bytes_in_use"):
+        mem = agg["gauges"]["device/bytes_in_use"]
+        peak = agg["gauges"].get("device/peak_bytes", {})
+        print(
+            f"device memory: {mem['last'] / 2**20:.1f} MiB in use (last), "
+            f"peak {peak.get('last', 0) / 2**20:.1f} MiB"
+        )
     if agg["spans"]:
         print(f"  {'span':<28} {'count':>7} {'total_s':>9} {'mean_s':>9}")
         for name in sorted(agg["spans"]):
@@ -329,6 +357,122 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 f"{s['mean_s']:>9.4f}"
             )
     return agg
+
+
+# ---------------------------------------------------------------------------
+# fleet view: per-worker aggregation + per-trace timelines
+# ---------------------------------------------------------------------------
+def summarize_fleet(events: List[dict]) -> dict:
+    """Merge a multi-worker event stream by worker identity::
+
+        {worker: {"spans": {...}, "counters": {...}, "stall": {...},
+                  "dominant": phase|None, "retries": n, "ledger_skips": n,
+                  "committed": n, "dead_lettered": n,
+                  "cache_hit_rate": float|None,
+                  "device_bytes_in_use": float|None}}
+
+    Each worker's sub-stream goes through :func:`summarize_telemetry`,
+    so per-worker stall shares and counters agree with the single-worker
+    report (and with the live registry each worker exported)."""
+    by_worker: dict = {}
+    for record in events:
+        by_worker.setdefault(_event_worker(record), []).append(record)
+    fleet = {}
+    for worker, stream in sorted(by_worker.items()):
+        agg = summarize_telemetry(stream)
+        counters = agg["counters"]
+        builds = counters.get("compile_cache/builds", 0)
+        hits = counters.get("compile_cache/hits", 0)
+        dominant = (
+            max(agg["stall"], key=lambda p: agg["stall"][p]["share"])
+            if agg["stall"] else None
+        )
+        device_mem = agg["gauges"].get("device/bytes_in_use")
+        fleet[worker] = {
+            "spans": agg["spans"],
+            "counters": counters,
+            "stall": agg["stall"],
+            "dominant": dominant,
+            "retries": counters.get("tasks/retried", 0),
+            "ledger_skips": counters.get("ledger/skips", 0),
+            "committed": counters.get("tasks/committed", 0),
+            "dead_lettered": counters.get("tasks/dead_lettered", 0),
+            "cache_hit_rate": (
+                hits / (hits + builds) if (hits + builds) else None
+            ),
+            "device_bytes_in_use": (
+                device_mem["last"] if device_mem else None
+            ),
+        }
+    return fleet
+
+
+def trace_timeline(events: List[dict], trace_id: str) -> List[dict]:
+    """Every event stamped with ``trace_id`` (plus the queue/submit
+    event that minted it), in time order — one task's full history
+    across submit, claim(s), retry/requeue hops between workers, and
+    commit or dead-letter, reconstructed from merged JSONL alone."""
+    hits = [
+        record for record in events
+        if record.get("trace_id") == trace_id
+    ]
+    hits.sort(key=lambda record: record.get("t", 0.0))
+    return hits
+
+
+def print_fleet_summary(metrics_dir: str,
+                        trace_id: Optional[str] = None) -> Optional[dict]:
+    """The ``log-summary --fleet`` report: one block per worker (task
+    outcomes, dominant stall share, cache hit rate, device memory) and,
+    with ``--trace-id``, that task's merged cross-worker timeline.
+    Returns the fleet aggregate (None when the dir holds no events)."""
+    events = load_telemetry_dir(metrics_dir)
+    if not events:
+        print(f"no telemetry events found in {metrics_dir}")
+        return None
+    fleet = summarize_fleet(events)
+    print(f"fleet: {len(fleet)} worker(s), {len(events)} events "
+          f"from {metrics_dir}")
+    for worker, info in fleet.items():
+        print(f"worker {worker}:")
+        print(
+            f"  committed={info['committed']:g} retries={info['retries']:g} "
+            f"ledger_skips={info['ledger_skips']:g} "
+            f"dead_lettered={info['dead_lettered']:g}"
+        )
+        if info["stall"]:
+            for phase in STALL_PHASES:
+                if phase in info["stall"]:
+                    s = info["stall"][phase]
+                    print(
+                        f"    {phase:<20} {s['total_s']:>9.3f}s "
+                        f"{100 * s['share']:>5.1f}%"
+                    )
+            print(f"    -> dominant phase: {info['dominant']}")
+        if info["cache_hit_rate"] is not None:
+            print(f"  cache hit rate: {100 * info['cache_hit_rate']:.1f}%")
+        if info["device_bytes_in_use"] is not None:
+            print(
+                f"  device memory in use: "
+                f"{info['device_bytes_in_use'] / 2**20:.1f} MiB"
+            )
+    if trace_id is not None:
+        timeline = trace_timeline(events, trace_id)
+        print(f"trace {trace_id}: {len(timeline)} event(s)")
+        for record in timeline:
+            kind = record.get("kind", "?")
+            name = record.get("name", "")
+            worker = _event_worker(record)
+            extra = ""
+            if kind == "span":
+                extra = f" dur={record.get('dur_s', 0.0):.4f}s"
+            elif record.get("body"):
+                extra = f" body={record['body']}"
+            if record.get("reason"):
+                extra += f" reason={record['reason']}"
+            print(f"  t={record.get('t', 0.0):.6f} [{worker}] "
+                  f"{kind}:{name}{extra}")
+    return fleet
 
 
 # reference spellings (flow/log_summary.py:16,57)

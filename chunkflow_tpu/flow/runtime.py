@@ -33,6 +33,7 @@ class PipelineState:
         self.dry_run = False
         self.verbose = 0
         self.operators: Dict[str, object] = {}
+        self.metrics_server = None  # live /metrics exporter (cli.py)
 
 
 def drain_pending_writes(task: Optional[dict]) -> None:
@@ -104,7 +105,9 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
         try:
             for task in stream:
                 count += 1
-                with telemetry.span("pipeline/ack_writes"):
+                trace_id = task.get("trace_id") if task else None
+                with telemetry.task_context(trace_id), \
+                        telemetry.span("pipeline/ack_writes"):
                     drain_pending_writes(task)
                 telemetry.inc("pipeline/tasks")
                 if task is None:
@@ -147,16 +150,19 @@ def operator(func: Callable) -> Callable:
                     # the span IS the timer now: task['log']['timer'] is
                     # the backward-compatible per-task view of the same
                     # measurement (span duration is wall-clock, matching
-                    # the historical time.time() semantics)
+                    # the historical time.time() semantics). The task
+                    # context stamps the span (and anything the operator
+                    # emits) with the queue-minted trace id.
                     sp = telemetry.span(f"op/{name}")
                     start = time.time()
                     try:
                         # fault-injection boundary: a seeded chaos plan
                         # can kill any operator here (testing/chaos.py)
                         # — the lifecycle supervisor must contain it
-                        chaos.chaos_point(f"op/{name}")
-                        with sp:
-                            task = func(task, **kwargs)
+                        with telemetry.task_context(task.get("trace_id")):
+                            chaos.chaos_point(f"op/{name}")
+                            with sp:
+                                task = func(task, **kwargs)
                     except BaseException as exc:
                         # charge the failure to THIS task, not the
                         # whole in-flight window (lifecycle.tag_culprit)

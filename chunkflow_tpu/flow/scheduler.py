@@ -66,6 +66,7 @@ from chunkflow_tpu.testing import chaos
 __all__ = [
     "scheduler_mode", "mem_watermark_bytes", "DepthController",
     "schedule_chunks", "scheduled_inference_stage", "write_behind_stage",
+    "sample_device_memory",
 ]
 
 _OFF_VALUES = ("static", "0", "off", "false", "no")
@@ -316,6 +317,49 @@ def _chunk_nbytes(chunk) -> int:
 
 
 # ---------------------------------------------------------------------------
+# device-memory gauges (sampled at drain time)
+# ---------------------------------------------------------------------------
+_DEVICE_MEM_UNSUPPORTED = False
+
+
+def sample_device_memory() -> None:
+    """Fold ``jax.Device.memory_stats()`` into ``device/bytes_in_use`` /
+    ``device/peak_bytes`` gauges (summed over local devices), sampled at
+    task drain time so memory pressure shows up in ``/metrics`` and
+    ``log-summary`` next to the scheduler's host watermark. Backends
+    without memory stats (the CPU simulator) mark themselves
+    unsupported after the first probe and the call becomes a no-op."""
+    global _DEVICE_MEM_UNSUPPORTED
+    if _DEVICE_MEM_UNSUPPORTED or not telemetry.enabled():
+        return
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        _DEVICE_MEM_UNSUPPORTED = True
+        return
+    in_use = peak = 0
+    sampled = False
+    for device in devices:
+        try:
+            stats = device.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        sampled = True
+        in_use += int(stats.get("bytes_in_use", 0) or 0)
+        peak += int(stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0)) or 0)
+    if not sampled:
+        _DEVICE_MEM_UNSUPPORTED = True
+        return
+    telemetry.gauge("device/bytes_in_use", in_use)
+    telemetry.gauge("device/peak_bytes", peak)
+
+
+# ---------------------------------------------------------------------------
 # chunk-level executor (powers Inferencer.stream)
 # ---------------------------------------------------------------------------
 def _adaptive_device_pipeline(inferencer, q: _AdaptiveQueue,
@@ -392,6 +436,7 @@ def schedule_chunks(
     def complete(future):
         result = future.result()
         ctl.observe_task()
+        sample_device_memory()
         q.set_capacity(ctl.depths["prefetch"])
         return result
 
@@ -462,20 +507,23 @@ def scheduled_inference_stage(
 
         def finalize(task, out, t0):
             # runs in the pool: compute/drain attribution rides along
-            # (spans are thread-safe), the GIL is released inside the
+            # (spans are thread-safe, the trace context is rebound from
+            # the task here because contextvars do not follow work into
+            # pool threads), the GIL is released inside the
             # block_until_ready / D2H waits. Chaos boundary: an injected
             # kill here surfaces through the future — the error-flush
             # path below pushes the survivors downstream first, and the
             # lifecycle supervisor contains the rest
-            try:
-                chaos.chaos_point("scheduler/post")
-                result = _drain_host(out)
-                if postprocess is not None:
-                    with telemetry.span("scheduler/post"):
-                        result = postprocess(result)
-            except BaseException as exc:
-                _tag_culprit(exc, task)
-                raise
+            with telemetry.task_context(task.get("trace_id")):
+                try:
+                    chaos.chaos_point("scheduler/post")
+                    result = _drain_host(out)
+                    if postprocess is not None:
+                        with telemetry.span("scheduler/post"):
+                            result = postprocess(result)
+                except BaseException as exc:
+                    _tag_culprit(exc, task)
+                    raise
             task[output_name] = result
             task["log"]["timer"][op_name] = time.time() - t0
             task["log"]["compute_device"] = inferencer.compute_device
@@ -483,14 +531,15 @@ def scheduled_inference_stage(
 
         def dispatch_one():
             task, slot, owned, t0 = staged.popleft()
-            try:
-                chaos.chaos_point("scheduler/dispatch")
-                with telemetry.span("pipeline/dispatch"):
-                    out = inferencer.infer_async(
-                        slot, crop=crop, consume=owned)
-            except BaseException as exc:
-                _tag_culprit(exc, task)
-                raise
+            with telemetry.task_context(task.get("trace_id")):
+                try:
+                    chaos.chaos_point("scheduler/dispatch")
+                    with telemetry.span("pipeline/dispatch"):
+                        out = inferencer.infer_async(
+                            slot, crop=crop, consume=owned)
+                except BaseException as exc:
+                    _tag_culprit(exc, task)
+                    raise
             pending.append((task, out, t0))
             telemetry.gauge("pipeline/inflight", len(pending))
 
@@ -501,6 +550,7 @@ def scheduled_inference_stage(
         def complete():
             task = finishing.popleft().result()
             ctl.observe_task()
+            sample_device_memory()
             q.set_capacity(ctl.depths["prefetch"])
             return task
 
@@ -605,7 +655,8 @@ def write_behind_stage(window: int = 2,
 
         def drain_oldest():
             task = buffered.popleft()
-            with telemetry.span("scheduler/write"):
+            with telemetry.task_context(task.get("trace_id")), \
+                    telemetry.span("scheduler/write"):
                 drain_pending_writes(task)
             ctl.observe_task()
             return task

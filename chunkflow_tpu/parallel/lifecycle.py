@@ -334,6 +334,11 @@ class TaskLifecycle:
         self.handle = handle
         self.body = body
         self.receives = receives
+        # the trace id minted at queue submission rides the claim: every
+        # span/event in this task's lifecycle is stamped with it
+        # (telemetry.task_context), so retry hops across workers merge
+        # into one timeline (docs/observability.md "Fleet view")
+        self.trace_id = self.queue.trace_id(handle)
         self.task: Optional[dict] = None
         self.renewer: Optional[LeaseRenewer] = None
         self.done = False
@@ -354,14 +359,19 @@ class TaskLifecycle:
             return
         from chunkflow_tpu.flow.runtime import drain_pending_writes
 
-        with telemetry.span("lifecycle/commit"):
-            drain_pending_writes(task if task is not None else self.task)
-            chaos.chaos_point("lifecycle/pre_ledger")
-            if self.supervisor.ledger is not None:
-                self.supervisor.ledger.mark_done(self.body)
-            chaos.chaos_point("lifecycle/pre_ack")
-            self.queue.delete(self.handle)
-        telemetry.inc("tasks/committed")
+        with telemetry.task_context(self.trace_id):
+            with telemetry.span("lifecycle/commit"):
+                drain_pending_writes(task if task is not None else self.task)
+                chaos.chaos_point("lifecycle/pre_ledger")
+                if self.supervisor.ledger is not None:
+                    self.supervisor.ledger.mark_done(self.body)
+                chaos.chaos_point("lifecycle/pre_ack")
+                self.queue.delete(self.handle)
+            telemetry.inc("tasks/committed")
+            telemetry.event(
+                "task", "lifecycle/committed", body=self.body,
+                receives=self.receives,
+            )
         self._finish()
 
     def _flush_writes(self) -> None:
@@ -384,13 +394,18 @@ class TaskLifecycle:
         if self.done:
             return "done"
         self._finish()
-        with telemetry.span("lifecycle/release"):
+        with telemetry.task_context(self.trace_id), \
+                telemetry.span("lifecycle/release"):
             if isinstance(exc, (KeyboardInterrupt, SystemExit,
                                 GeneratorExit)):
                 # preemption: hand the task back *now* (immediate
                 # visibility release), then flush writes before exit
                 self.queue.nack(self.handle)
                 telemetry.inc("tasks/preempted")
+                telemetry.event(
+                    "task", "lifecycle/preempted", body=self.body,
+                    receives=self.receives,
+                )
                 self._flush_writes()
                 return "preempted"
             self._flush_writes()
@@ -405,6 +420,10 @@ class TaskLifecycle:
                            f"classified {kind})",
                 )
                 telemetry.inc("tasks/dead_lettered")
+                telemetry.event(
+                    "task", "lifecycle/dead_letter", body=self.body,
+                    receives=self.receives, reason=reason[:200],
+                )
                 return "dead"
             delay = backoff_delay(
                 self.receives, base=self.supervisor.backoff_base,
@@ -434,7 +453,12 @@ class TaskLifecycle:
         self._finish()
         self.queue.nack(self.handle)
         self._flush_writes()
-        telemetry.inc("tasks/surrendered")
+        with telemetry.task_context(self.trace_id):
+            telemetry.inc("tasks/surrendered")
+            telemetry.event(
+                "task", "lifecycle/surrendered", body=self.body,
+                receives=self.receives,
+            )
         return "surrendered"
 
 
@@ -468,13 +492,15 @@ class LifecycleSupervisor:
         """One delivery → a supervised lifecycle, or None when the
         delivery is resolved at claim time (ledger skip, crash-loop
         dead-letter)."""
-        with telemetry.span("lifecycle/claim"):
+        with telemetry.task_context(self.queue.trace_id(handle)), \
+                telemetry.span("lifecycle/claim"):
             if self.ledger is not None and self.ledger.is_done(body):
                 # already committed by a previous attempt/run: ack the
                 # duplicate delivery, skip the compute — the idempotent
                 # resume path
                 self.queue.delete(handle)
                 telemetry.inc("ledger/skips")
+                telemetry.event("task", "lifecycle/ledger_skip", body=body)
                 return None
             receives = self.queue.receive_count(handle) or 1
             # the first delivery is always claimable; past that, a
@@ -483,16 +509,21 @@ class LifecycleSupervisor:
             if self.max_retries >= 0 and receives > max(self.max_retries, 1):
                 # redelivered past the budget with no recorded failure:
                 # the worker died mid-compute every time (crash loop)
-                self.queue.dead_letter(
-                    handle,
-                    reason=f"receive count {receives} exceeds max retries "
-                           f"{self.max_retries} with no recorded failure "
-                           "(worker crash loop)",
-                )
+                reason = (f"receive count {receives} exceeds max retries "
+                          f"{self.max_retries} with no recorded failure "
+                          "(worker crash loop)")
+                self.queue.dead_letter(handle, reason=reason)
                 telemetry.inc("tasks/dead_lettered")
+                telemetry.event(
+                    "task", "lifecycle/dead_letter", body=body,
+                    receives=receives, reason=reason,
+                )
                 return None
             lc = TaskLifecycle(self, handle, body, receives)
             _register(lc)
+            telemetry.event(
+                "task", "lifecycle/claimed", body=body, receives=receives,
+            )
             # the kill-able boundary sits after registration so an
             # injected death here is released (fast retry), not leaked
             # to the visibility timeout
@@ -503,12 +534,16 @@ class LifecycleSupervisor:
         """Claim loop: yields supervised lifecycles, at most ``num``
         (< 0: drain). Installs the SIGTERM preemption handler and runs
         the lease heartbeat (``lease_renew`` > 0) for the loop's
-        duration."""
+        duration. Every ``CHUNKFLOW_TELEMETRY_SNAPSHOT_EVERY`` claimed
+        tasks a telemetry snapshot event is flushed, so a worker killed
+        mid-run still leaves a counter record for ``log-summary
+        --fleet`` (the end-of-run flush alone would die with it)."""
         restore = install_preemption_handler()
         heartbeat = (
             _Heartbeat(self, self.lease_renew).start()
             if self.lease_renew > 0 else None
         )
+        snapshot_every = telemetry.snapshot_interval()
         count = 0
         try:
             for handle, body in self.queue:
@@ -517,6 +552,8 @@ class LifecycleSupervisor:
                     continue
                 yield lc
                 count += 1
+                if snapshot_every and count % snapshot_every == 0:
+                    telemetry.flush()
                 if 0 <= num <= count:
                     return
         finally:

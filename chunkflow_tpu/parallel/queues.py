@@ -32,6 +32,18 @@ Backends:
   shared filesystem (SLURM-style clusters);
 - ``sqs://name``     — AWS SQS via boto3, gated on the library being
   importable (not baked into this image).
+
+Distributed tracing (docs/observability.md "Fleet view"): every task
+submitted through :meth:`QueueBase.send_messages` is wrapped in a JSON
+envelope carrying a freshly minted ``trace_id``. The envelope is the
+*wire* format only — :meth:`receive` unwraps it, so consumers keep
+seeing the plain bbox-string body — and it survives every lifecycle
+hop: claim, nack, janitor requeue, dead-letter, ``requeue_dead``
+(:func:`pack_task` is idempotent, so a requeued envelope keeps its
+original id). :meth:`QueueBase.trace_id` exposes the claimed task's id
+so the lifecycle layer can stamp telemetry with it
+(``telemetry.task_context``). Pre-envelope bodies (an old queue on
+disk) still work: they unwrap to themselves with no trace id.
 """
 from __future__ import annotations
 
@@ -40,6 +52,40 @@ import os
 import time
 import uuid
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from chunkflow_tpu.core import telemetry
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex trace id, minted once per task submission."""
+    return uuid.uuid4().hex
+
+
+_ENVELOPE_PREFIX = '{"chunkflow"'
+
+
+def pack_task(body: str, trace_id: Optional[str] = None) -> str:
+    """Wrap a task body in the traced wire envelope. Idempotent: a body
+    that is already an envelope is returned unchanged, preserving its
+    original trace id across requeue/dead-letter round trips."""
+    if unpack_task(body)[1] is not None:
+        return body
+    if trace_id is None:
+        trace_id = new_trace_id()
+    return json.dumps({"chunkflow": 1, "body": body, "trace_id": trace_id})
+
+
+def unpack_task(raw: str) -> Tuple[str, Optional[str]]:
+    """``(body, trace_id)`` from a wire payload; a non-envelope payload
+    (pre-tracing queue contents) unwraps to ``(raw, None)``."""
+    if raw.startswith(_ENVELOPE_PREFIX):
+        try:
+            env = json.loads(raw)
+        except ValueError:
+            return raw, None
+        if isinstance(env, dict) and "body" in env:
+            return str(env["body"]), env.get("trace_id")
+    return raw, None
 
 
 class QueueBase:
@@ -54,6 +100,62 @@ class QueueBase:
     def receive(self) -> Optional[Tuple[str, str]]:
         """One (handle, body) or None when empty."""
         raise NotImplementedError
+
+    # -- distributed tracing --------------------------------------------
+    def _pack_bodies(self, bodies: List[str]) -> List[str]:
+        """Envelope each outgoing body (idempotent) and emit one
+        ``queue/submit`` event per task — submission is where a trace
+        begins, so the submitter's JSONL anchors every timeline."""
+        packed = []
+        for body in bodies:
+            wire = pack_task(body)
+            packed.append(wire)
+            plain, trace_id = unpack_task(wire)
+            telemetry.inc("queue/sent")
+            telemetry.event(
+                "task", "queue/submit", queue=self.describe(),
+                body=plain, trace_id=trace_id,
+            )
+        return packed
+
+    def _note_receive(self, handle: str, trace_id: Optional[str]) -> None:
+        if not hasattr(self, "_traces"):
+            self._traces: Dict[str, Optional[str]] = {}
+        self._traces[handle] = trace_id
+        telemetry.inc("queue/receives")
+
+    def trace_id(self, handle: str) -> Optional[str]:
+        """Trace id of a claimed task (None when the delivery carried
+        no envelope)."""
+        return getattr(self, "_traces", {}).get(handle)
+
+    @staticmethod
+    def _present(entry: dict) -> dict:
+        """Dead-letter entry for display: the stored body stays in wire
+        format (so requeue preserves the trace), the listed copy shows
+        the plain body plus its trace id."""
+        body, trace_id = unpack_task(entry.get("body", ""))
+        shown = dict(entry)
+        shown["body"] = body
+        if trace_id is not None:
+            shown.setdefault("trace_id", trace_id)
+        return shown
+
+    def describe(self) -> str:
+        """Human-readable queue identity for events and fleet-status."""
+        return getattr(self, "name", None) or getattr(self, "dir", "") \
+            or type(self).__name__
+
+    def stats(self) -> dict:
+        """Live queue state for the fleet-status dashboard:
+        ``{"pending", "inflight", "dead", "receives"}``; None for a
+        field the backend cannot report cheaply."""
+        try:
+            pending: Optional[int] = len(self)  # type: ignore[arg-type]
+        except (TypeError, NotImplementedError):
+            pending = None
+        return {"pending": pending, "inflight": None, "dead": None,
+                "receives": None}
 
     def delete(self, handle: str) -> None:
         """Ack: permanently remove a claimed task (the commit point)."""
@@ -140,7 +242,7 @@ class MemoryQueue(QueueBase):
         return cls._registry[name]
 
     def send_messages(self, bodies: List[str]) -> None:
-        for body in bodies:
+        for body in self._pack_bodies(bodies):
             self.pending[uuid.uuid4().hex] = body
 
     def _requeue_expired(self) -> None:
@@ -155,16 +257,19 @@ class MemoryQueue(QueueBase):
         self._requeue_expired()
         if not self.pending:
             return None
-        handle, body = next(iter(self.pending.items()))
+        handle, wire = next(iter(self.pending.items()))
         del self.pending[handle]
-        self.invisible[handle] = (body, time.time() + self.visibility_timeout)
+        self.invisible[handle] = (wire, time.time() + self.visibility_timeout)
         self.receives[handle] = self.receives.get(handle, 0) + 1
+        body, trace_id = unpack_task(wire)
+        self._note_receive(handle, trace_id)
         return handle, body
 
     def delete(self, handle: str) -> None:
         self.invisible.pop(handle, None)
         self.pending.pop(handle, None)
         self.receives.pop(handle, None)
+        getattr(self, "_traces", {}).pop(handle, None)
 
     def renew(self, handle: str, timeout: Optional[float] = None) -> None:
         entry = self.invisible.get(handle)
@@ -192,15 +297,26 @@ class MemoryQueue(QueueBase):
         }
 
     def dead_letters(self) -> List[dict]:
-        return [dict(entry) for entry in self.dead.values()]
+        return [self._present(entry) for entry in self.dead.values()]
 
     def requeue_dead(self) -> int:
         count = 0
         for handle, entry in list(self.dead.items()):
             del self.dead[handle]
-            self.pending[handle] = entry["body"]  # fresh retry budget
+            # the stored body is still the wire envelope: the requeued
+            # task keeps its original trace id, fresh retry budget
+            self.pending[handle] = entry["body"]
             count += 1
         return count
+
+    def stats(self) -> dict:
+        self._requeue_expired()
+        return {
+            "pending": len(self.pending),
+            "inflight": len(self.invisible),
+            "dead": len(self.dead),
+            "receives": sum(self.receives.values()),
+        }
 
     def __len__(self) -> int:
         self._requeue_expired()
@@ -233,7 +349,7 @@ class FileQueue(QueueBase):
         self.visibility_timeout = visibility_timeout
 
     def send_messages(self, bodies: List[str]) -> None:
-        for body in bodies:
+        for body in self._pack_bodies(bodies):
             name = uuid.uuid4().hex
             tmp = os.path.join(self.dir, f".tmp-{name}")
             with open(tmp, "w") as f:
@@ -291,7 +407,9 @@ class FileQueue(QueueBase):
             os.utime(dst)
             self._bump_count(name)
             with open(dst) as f:
-                return name, f.read()
+                body, trace_id = unpack_task(f.read())
+            self._note_receive(name, trace_id)
+            return name, body
         return None
 
     def delete(self, handle: str) -> None:
@@ -301,6 +419,7 @@ class FileQueue(QueueBase):
                 os.remove(path)
             except FileNotFoundError:
                 pass
+        getattr(self, "_traces", {}).pop(handle, None)
 
     def renew(self, handle: str, timeout: Optional[float] = None) -> None:
         timeout = self.visibility_timeout if timeout is None else timeout
@@ -343,7 +462,7 @@ class FileQueue(QueueBase):
         for name in sorted(os.listdir(self.dead_dir)):
             try:
                 with open(os.path.join(self.dead_dir, name)) as f:
-                    entries.append(json.load(f))
+                    entries.append(self._present(json.load(f)))
             except (OSError, ValueError):
                 continue
         return entries
@@ -357,6 +476,8 @@ class FileQueue(QueueBase):
                     entry = json.load(f)
             except (OSError, ValueError):
                 continue
+            # the stored body is the wire envelope; pack_task inside
+            # send_messages is idempotent, so the trace id survives
             self.send_messages([entry["body"]])
             try:
                 os.remove(path)
@@ -364,6 +485,18 @@ class FileQueue(QueueBase):
                 continue
             count += 1
         return count
+
+    def stats(self) -> dict:
+        self._requeue_expired()
+        receives = 0
+        for name in os.listdir(self.counts_dir):
+            receives += self._read_count(name)
+        return {
+            "pending": len(os.listdir(self.pending_dir)),
+            "inflight": len(os.listdir(self.claimed_dir)),
+            "dead": len(os.listdir(self.dead_dir)),
+            "receives": receives,
+        }
 
     def __len__(self) -> int:
         return len(os.listdir(self.pending_dir))
@@ -424,6 +557,7 @@ class SQSQueue(QueueBase):
             )
 
     def send_messages(self, bodies: List[str]) -> None:
+        bodies = self._pack_bodies(bodies)
         for i in range(0, len(bodies), 10):  # SQS batch limit
             entries = [
                 {"Id": str(j), "MessageBody": body}
@@ -459,13 +593,16 @@ class SQSQueue(QueueBase):
         except (TypeError, ValueError):
             self._receive_counts[handle] = 0
         self._bodies = getattr(self, "_bodies", {})
-        self._bodies[handle] = msg["Body"]
-        return handle, msg["Body"]
+        self._bodies[handle] = msg["Body"]  # wire format: dead-letter re-sends it
+        body, trace_id = unpack_task(msg["Body"])
+        self._note_receive(handle, trace_id)
+        return handle, body
 
     def delete(self, handle: str) -> None:
         self.client.delete_message(QueueUrl=self.queue_url, ReceiptHandle=handle)
         self._receive_counts.pop(handle, None)
         getattr(self, "_bodies", {}).pop(handle, None)
+        getattr(self, "_traces", {}).pop(handle, None)
 
     def renew(self, handle: str, timeout: Optional[float] = None) -> None:
         timeout = self.visibility_timeout if timeout is None else timeout
@@ -524,7 +661,7 @@ class SQSQueue(QueueBase):
         # SQS has no non-destructive listing: receive-to-empty instead;
         # the entries go invisible for the dead queue's short visibility
         # timeout and then reappear (listing never loses them)
-        return [entry for _, entry in self._drain_dead()]
+        return [self._present(entry) for _, entry in self._drain_dead()]
 
     def requeue_dead(self) -> int:
         count = 0
@@ -535,6 +672,32 @@ class SQSQueue(QueueBase):
             )
             count += 1
         return count
+
+    def stats(self) -> dict:
+        out = {"pending": None, "inflight": None, "dead": None,
+               "receives": sum(self._receive_counts.values()) or None}
+        try:
+            resp = self.client.get_queue_attributes(
+                QueueUrl=self.queue_url,
+                AttributeNames=["ApproximateNumberOfMessages",
+                                "ApproximateNumberOfMessagesNotVisible"],
+            )
+            attrs = resp.get("Attributes") or {}
+            out["pending"] = int(attrs.get("ApproximateNumberOfMessages", 0))
+            out["inflight"] = int(
+                attrs.get("ApproximateNumberOfMessagesNotVisible", 0))
+        except Exception:
+            pass  # older fakes / restricted IAM: depth stays unknown
+        try:
+            resp = self.client.get_queue_attributes(
+                QueueUrl=self._dead_queue_url(),
+                AttributeNames=["ApproximateNumberOfMessages"],
+            )
+            out["dead"] = int((resp.get("Attributes") or {})
+                              .get("ApproximateNumberOfMessages", 0))
+        except Exception:
+            pass
+        return out
 
 
 def open_queue(spec: str, visibility_timeout: float = 1800.0) -> QueueBase:

@@ -1,4 +1,4 @@
-"""Coordination HTTP service: global IDs + spatial task scheduling.
+"""Coordination HTTP service: global IDs, task scheduling, live metrics.
 
 Parity target: reference distributed/restapi/server.py (FastAPI global-ID
 range server) — upgraded from prototype to a dependency-light HTTP server
@@ -9,20 +9,147 @@ required). Endpoints:
 - ``GET /task``                 -> next runnable task bbox string, or 204
 - ``POST /task/<bbox>/done``    -> mark a claimed task done
 - ``GET /state``                -> full task-tree JSON
+- ``GET /metrics``              -> Prometheus text exposition of the live
+  telemetry registry snapshot (counters/gauges/span summaries + derived
+  stall shares), the scrape surface a fleet supervisor polls
+- ``GET /healthz``              -> worker identity + in-flight lease count
 
 Workers coordinate hierarchical jobs (meshing/agglomeration merges) through
 this service; flat grid jobs should keep using queues (SURVEY §5.8 — the
-queue-of-bboxes architecture is communication-free and preferred).
+queue-of-bboxes architecture is communication-free and preferred). The
+metrics endpoints ride the SAME server machinery: a queue-fed worker runs
+:func:`start_metrics_exporter` (CLI ``--metrics-port`` /
+``CHUNKFLOW_METRICS_PORT``), which serves only the observability routes —
+and, matching the telemetry kill-switch discipline, creates **no socket at
+all** under ``CHUNKFLOW_TELEMETRY=0``.
 """
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, Optional
 
+from chunkflow_tpu.core import telemetry
 from chunkflow_tpu.parallel.task_tree import GlobalIdAllocator, SpatialTaskTree
+
+#: the stall phases whose shares ride /metrics as labeled gauges — same
+#: set the adaptive depth controller and log-summary consume
+#: (flow/log_summary.STALL_PHASES; duplicated literally to keep this
+#: module import-light for bare worker images)
+_STALL_PHASES = (
+    "scheduler/load", "pipeline/stage", "pipeline/dispatch",
+    "pipeline/compute", "pipeline/drain", "scheduler/post",
+    "scheduler/write",
+)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (zero-dependency rendering + parsing)
+# ---------------------------------------------------------------------------
+def prometheus_name(name: str) -> str:
+    """Registry metric name -> Prometheus metric name: ``chunkflow_``
+    prefix, every character outside ``[a-zA-Z0-9_:]`` becomes ``_``
+    (``pipeline/ring_occupancy`` -> ``chunkflow_pipeline_ring_occupancy``)."""
+    return "chunkflow_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_prometheus(snap: Optional[dict] = None,
+                      worker: Optional[str] = None) -> str:
+    """The telemetry registry snapshot as Prometheus text exposition
+    (format 0.0.4). Counters render as ``<name>_total`` counters, gauges
+    as gauges, histograms as ``summary`` count/sum pairs, plus derived
+    per-phase stall-share gauges and the dominant share — the exact
+    signal the future autoscaling supervisor polls. Every sample carries
+    a ``worker`` label so a fleet scrape stays attributable."""
+    if snap is None:
+        snap = telemetry.snapshot()
+    if worker is None:
+        worker = telemetry.worker_id()
+    label = f'{{worker="{_escape_label(worker)}"}}'
+    lines = []
+    for name in sorted(snap.get("counters", {})):
+        metric = prometheus_name(name) + "_total"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric}{label} {snap['counters'][name]:g}")
+    for name in sorted(snap.get("gauges", {})):
+        metric = prometheus_name(name)
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric}{label} {snap['gauges'][name]:g}")
+    for name in sorted(snap.get("hists", {})):
+        h = snap["hists"][name]
+        metric = prometheus_name(name)
+        lines.append(f"# TYPE {metric} summary")
+        lines.append(f"{metric}_count{label} {h['count']:g}")
+        lines.append(f"{metric}_sum{label} {h['total']:g}")
+    # derived: per-phase stall shares + the dominant share, so the
+    # scraper reads "what is this worker waiting on" without re-deriving
+    hists = snap.get("hists", {})
+    totals = {p: hists[p]["total"] for p in _STALL_PHASES if p in hists}
+    window = sum(totals.values())
+    if window > 0:
+        lines.append("# TYPE chunkflow_stall_share gauge")
+        for phase in _STALL_PHASES:
+            if phase in totals:
+                lines.append(
+                    f'chunkflow_stall_share{{worker="'
+                    f'{_escape_label(worker)}",phase="'
+                    f'{_escape_label(phase)}"}} {totals[phase] / window:.6f}'
+                )
+        dominant = max(totals, key=totals.get)
+        lines.append("# TYPE chunkflow_stall_dominant_share gauge")
+        lines.append(
+            f'chunkflow_stall_dominant_share{{worker="'
+            f'{_escape_label(worker)}",phase="{_escape_label(dominant)}"}} '
+            f"{totals[dominant] / window:.6f}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(-?[0-9.eE+-]+|NaN)$"
+)
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Minimal exposition parser (labels dropped): ``{name: value}``.
+    Shared by the fleet-status scraper and the rendering golden test;
+    raises ValueError on a malformed sample line."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"malformed Prometheus sample line: {line!r}")
+        out[m.group(1)] = float(m.group(3))
+    return out
+
+
+def worker_health() -> dict:
+    """The /healthz payload: worker identity + live lease state."""
+    from chunkflow_tpu.parallel import lifecycle
+
+    return {
+        "status": "ok",
+        "worker": telemetry.worker_id(),
+        "pid": os.getpid(),
+        "inflight_leases": len(lifecycle.inflight()),
+        "telemetry_enabled": telemetry.enabled(),
+        "metrics_path": telemetry.configured_path(),
+        "t": time.time(),
+    }
 
 
 class CoordinationService:
@@ -37,7 +164,12 @@ class CoordinationService:
 
     # ---- request handling (transport-independent) ----------------------
     def handle(self, method: str, path: str):
-        """Returns (status, payload-dict-or-None)."""
+        """Returns (status, payload): a dict serves as JSON, a str as
+        ``text/plain`` (the Prometheus exposition), None as empty."""
+        if method == "GET" and path == "/metrics":
+            return 200, render_prometheus()
+        if method == "GET" and path == "/healthz":
+            return 200, worker_health()
         m = re.fullmatch(r"/objids/(\d+)", path)
         if method == "GET" and m:
             return 200, {"base_id": self.ids.allocate(int(m.group(1)))}
@@ -76,6 +208,14 @@ def serve(
         def _respond(self):
             status, payload = service.handle(self.command, self.path)
             self.send_response(status)
+            if isinstance(payload, str):
+                # raw text route (/metrics: Prometheus exposition 0.0.4)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.end_headers()
+                self.wfile.write(payload.encode())
+                return
             self.send_header("Content-Type", "application/json")
             self.end_headers()
             if payload is not None:
@@ -96,3 +236,55 @@ def serve(
         thread.start()
         return server, thread
     server.serve_forever()  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# per-worker metrics exporter + fleet-status scraping
+# ---------------------------------------------------------------------------
+def start_metrics_exporter(port: int, host: str = "0.0.0.0"):
+    """Serve ``/metrics`` + ``/healthz`` from a daemon thread for the
+    lifetime of a worker run (CLI ``--metrics-port`` /
+    ``CHUNKFLOW_METRICS_PORT``; port 0 binds an ephemeral port — read it
+    back from ``server.server_address``). Returns the live
+    ``ThreadingHTTPServer``, or **None without creating any socket**
+    when telemetry is disabled — ``CHUNKFLOW_TELEMETRY=0`` means no
+    files, no listener, nothing."""
+    if not telemetry.enabled():
+        return None
+    service = CoordinationService()  # no task tree: observability routes only
+    server, _thread = serve(service, host=host, port=int(port),
+                            background=True)
+    return server
+
+
+def exporter_port_from_env() -> Optional[int]:
+    """``CHUNKFLOW_METRICS_PORT`` as an int, or None when unset/empty/
+    malformed (the exporter stays off rather than crashing a worker)."""
+    raw = os.environ.get("CHUNKFLOW_METRICS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
+    """Sample one worker's observability endpoints for ``fleet-status``:
+    ``{"endpoint", "healthz": dict|None, "metrics": {name: value}|None,
+    "error": str|None}``. ``endpoint`` is ``host:port`` or a full URL;
+    unreachable workers report the error instead of raising — a fleet
+    dashboard must render around dead workers."""
+    base = endpoint if "://" in endpoint else f"http://{endpoint}"
+    base = base.rstrip("/")
+    out = {"endpoint": base, "healthz": None, "metrics": None, "error": None}
+    try:
+        with urllib.request.urlopen(f"{base}/healthz",
+                                    timeout=timeout) as resp:
+            out["healthz"] = json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=timeout) as resp:
+            out["metrics"] = parse_prometheus(resp.read().decode())
+    except Exception as exc:  # noqa: BLE001 — any failure = unreachable
+        out["error"] = f"{type(exc).__name__}: {exc}"
+    return out

@@ -1,23 +1,96 @@
-"""Publish per-operator timings as CloudWatch metrics
-(reference plugins/aws/cloud_watch.py:26-67). Requires boto3 + credentials."""
+"""Publish the telemetry registry snapshot as CloudWatch metrics.
+
+The reference plugin (plugins/aws/cloud_watch.py:26-67) publishes only
+the per-task ``log['timer']`` dict — one Seconds metric per operator.
+Since PR 3 the process has a far richer registry
+(``core/telemetry.py``): fault-tolerance counters, queue receive
+counts, depth/occupancy gauges, per-phase stall histograms. This plugin
+now publishes that snapshot — the same signal surface ``/metrics``
+serves to a Prometheus scraper, shaped for CloudWatch — so the SQS-fed
+fleet (the paper's 3600-worker deployment) gets dashboards and alarms
+without any scrape infrastructure. Every datum carries a ``worker``
+dimension (``telemetry.worker_id()``) so fleet graphs stay
+attributable per worker.
+
+Published, namespace ``chunkflow-tpu``:
+
+* counters (``tasks/committed``, ``tasks/retried``, ``queue/receives``,
+  ``compile_cache/*``...) as Count;
+* gauges (``scheduler/depth/*``, ``device/bytes_in_use``...) as None/
+  Bytes;
+* per-phase span totals as Seconds, plus the derived per-phase stall
+  shares and the dominant-stall share (``stall/dominant_share``) — the
+  autoscaling signal;
+* the legacy ``log['timer']`` dict (when a task log is passed) exactly
+  as before, so existing dashboards keep working.
+
+Requires boto3 + credentials in production; ``client`` injection keeps
+the payload shape testable without either.
+"""
+from typing import List, Optional
+
+from chunkflow_tpu.core import telemetry
+
+DEFAULT_NAMESPACE = "chunkflow-tpu"
+
+#: CloudWatch PutMetricData caps MetricData at 20 entries per call
+_BATCH = 20
+
+#: gauges measured in bytes get the proper CloudWatch unit
+_BYTE_GAUGES = ("device/bytes_in_use", "device/peak_bytes")
 
 
-def execute(log: dict, name: str = "chunkflow-tpu"):
-    try:
-        import boto3
-    except ImportError as e:
-        raise ImportError(
-            "cloud_watch needs the 'boto3' package, which is not installed "
-            "in this environment"
-        ) from e
-    client = boto3.client("cloudwatch")
-    metric_data = [
-        {
-            "MetricName": f"{key}-time",
+def snapshot_metric_data(snap: Optional[dict] = None,
+                         log: Optional[dict] = None) -> List[dict]:
+    """The registry snapshot (plus an optional legacy task log) as a
+    CloudWatch MetricData list."""
+    from chunkflow_tpu.flow.log_summary import STALL_PHASES
+
+    if snap is None:
+        snap = telemetry.snapshot()
+    dimensions = [{"Name": "worker", "Value": telemetry.worker_id()}]
+    data: List[dict] = []
+
+    def add(name: str, value: float, unit: str) -> None:
+        data.append({
+            "MetricName": name,
             "Value": float(value),
-            "Unit": "Seconds",
-        }
-        for key, value in log.get("timer", {}).items()
-    ]
-    if metric_data:
-        client.put_metric_data(Namespace=name, MetricData=metric_data)
+            "Unit": unit,
+            "Dimensions": dimensions,
+        })
+
+    for name, value in sorted((snap.get("counters") or {}).items()):
+        add(name, value, "Count")
+    for name, value in sorted((snap.get("gauges") or {}).items()):
+        add(name, value, "Bytes" if name in _BYTE_GAUGES else "None")
+    hists = snap.get("hists") or {}
+    for name, h in sorted(hists.items()):
+        add(f"{name}-total", h["total"], "Seconds")
+    totals = {p: hists[p]["total"] for p in STALL_PHASES if p in hists}
+    window = sum(totals.values())
+    if window > 0:
+        for phase, total in totals.items():
+            add(f"stall-share/{phase}", total / window, "None")
+        dominant = max(totals, key=totals.get)
+        add("stall/dominant_share", totals[dominant] / window, "None")
+    for key, value in (log or {}).get("timer", {}).items():
+        add(f"{key}-time", value, "Seconds")
+    return data
+
+
+def execute(log: Optional[dict] = None, name: str = DEFAULT_NAMESPACE,
+            client=None):
+    if client is None:
+        try:
+            import boto3
+        except ImportError as e:
+            raise ImportError(
+                "cloud_watch needs the 'boto3' package, which is not "
+                "installed in this environment"
+            ) from e
+        client = boto3.client("cloudwatch")
+    metric_data = snapshot_metric_data(log=log)
+    for i in range(0, len(metric_data), _BATCH):
+        client.put_metric_data(
+            Namespace=name, MetricData=metric_data[i:i + _BATCH]
+        )

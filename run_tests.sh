@@ -79,4 +79,15 @@ echo "== resilience overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py resilience_overhead || rc=$((rc == 0 ? 1 : rc))
 stage_time "resilience overhead gate"
+
+# --- export overhead gate ---------------------------------------------------
+# Live /metrics exporter on-vs-off over the e2e_overlap workload, scraped
+# continuously while tasks flow (docs/observability.md "Fleet view"):
+# serving registry snapshots must cost < 2% wall-clock (reported as
+# gate_pass); the process only fails past 10% (a lock landed on the
+# per-task hot path), so shared-box noise cannot redden CI.
+echo "== export overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py export_overhead || rc=$((rc == 0 ? 1 : rc))
+stage_time "export overhead gate"
 exit $rc

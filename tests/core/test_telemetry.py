@@ -156,3 +156,113 @@ def test_configure_reconfigure_closes_previous(tmp_path):
     telemetry.flush()
     assert open(first).read() == ""
     assert "span" in open(second).read()
+
+
+# ---------------------------------------------------------------------------
+# fleet identity + task trace context (ISSUE 6)
+# ---------------------------------------------------------------------------
+def test_worker_id_stable_and_overridable(monkeypatch):
+    first = telemetry.worker_id()
+    assert str(os.getpid()) in first
+    assert telemetry.worker_id() == first  # cached, stable within a run
+    monkeypatch.setenv("CHUNKFLOW_WORKER_ID", "fleet-worker-7")
+    assert telemetry.worker_id() == first  # env read only at first use...
+    telemetry.reset()
+    assert telemetry.worker_id() == "fleet-worker-7"  # ...or after reset
+
+
+def test_sink_file_named_by_worker_id(tmp_path, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_WORKER_ID", "worker a/b")
+    telemetry.reset()
+    path = telemetry.configure(str(tmp_path))
+    # unsafe characters sanitized, telemetry-*.jsonl contract preserved
+    assert os.path.basename(path) == "telemetry-worker_a_b.jsonl"
+
+
+def test_events_stamped_with_worker_and_trace(tmp_path):
+    path = telemetry.configure(str(tmp_path))
+    with telemetry.task_context("trace-123"):
+        with telemetry.span("op/x"):
+            pass
+        telemetry.gauge("g", 1)
+        telemetry.event("task", "lifecycle/claimed", body="b")
+    with telemetry.span("op/outside"):
+        pass
+    telemetry.flush()
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    inside = [e for e in events if e.get("trace_id") == "trace-123"]
+    assert {e["kind"] for e in inside} == {"span", "gauge", "task"}
+    for e in events:
+        assert e["worker"] == telemetry.worker_id()
+    outside = next(e for e in events if e.get("name") == "op/outside")
+    assert "trace_id" not in outside  # context did not leak past exit
+    snap_event = next(e for e in events if e["kind"] == "snapshot")
+    assert snap_event["worker"] == telemetry.worker_id()
+
+
+def test_task_context_nesting_and_none(tmp_path):
+    assert telemetry.current_trace_id() is None
+    with telemetry.task_context("outer"):
+        assert telemetry.current_trace_id() == "outer"
+        with telemetry.task_context(None):  # no-op: keeps the outer id
+            assert telemetry.current_trace_id() == "outer"
+        with telemetry.task_context("inner"):
+            assert telemetry.current_trace_id() == "inner"
+        assert telemetry.current_trace_id() == "outer"
+    assert telemetry.current_trace_id() is None
+
+
+def test_task_context_is_thread_local(tmp_path):
+    seen = {}
+
+    def work(tid):
+        with telemetry.task_context(tid):
+            import time as _time
+
+            _time.sleep(0.01)
+            seen[tid] = telemetry.current_trace_id()
+
+    threads = [
+        threading.Thread(target=work, args=(f"t{i}",)) for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert seen == {f"t{i}": f"t{i}" for i in range(4)}
+
+
+# ---------------------------------------------------------------------------
+# JSONL rotation (ISSUE 6: long-lived workers must not grow unbounded)
+# ---------------------------------------------------------------------------
+def test_jsonl_rotation_caps_size(tmp_path, monkeypatch):
+    # ~1 KB cap: a few hundred spans must rotate at least once
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_MAX_MB", "0.001")
+    path = telemetry.configure(str(tmp_path))
+    for _ in range(200):
+        with telemetry.span("op/rotate"):
+            pass
+    telemetry.flush()
+    rotated = path + ".1"
+    assert os.path.exists(rotated)
+    assert os.path.getsize(path) <= 4096  # live file stays near the cap
+    # at most two generations on disk, both valid JSONL
+    files = sorted(os.listdir(tmp_path))
+    assert files == [os.path.basename(path), os.path.basename(rotated)]
+    for name in files:
+        for line in open(tmp_path / name):
+            json.loads(line)
+
+
+def test_rotation_off_without_sink_and_when_disabled(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_MAX_MB", "0.001")
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    assert telemetry.configure(str(tmp_path / "off")) is None
+    for _ in range(200):
+        with telemetry.span("op/none"):
+            pass
+    telemetry.flush()
+    # kill switch: no files at all, rotated or otherwise
+    assert not (tmp_path / "off").exists()

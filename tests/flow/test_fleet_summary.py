@@ -1,0 +1,290 @@
+"""Fleet aggregation (ISSUE 6): per-worker summaries, trace timelines,
+rotated-file loading, the fleet-status CLI, device-memory gauges, and
+the CloudWatch snapshot publisher.
+"""
+import json
+import os
+
+import pytest
+from click.testing import CliRunner
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.flow.log_summary import (
+    load_telemetry_dir,
+    summarize_fleet,
+    trace_timeline,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _write_events(path, events):
+    with open(path, "w") as f:
+        for event in events:
+            f.write(json.dumps(event) + "\n")
+
+
+def _two_worker_dir(tmp_path):
+    """Synthesized two-worker stream: worker-a is drain-bound and
+    retried a task; worker-b is load-bound and finished the trace."""
+    a = [
+        {"kind": "span", "name": "pipeline/drain", "t": 1.0, "dur_s": 3.0,
+         "worker": "worker-a", "pid": 1},
+        {"kind": "span", "name": "pipeline/compute", "t": 1.1, "dur_s": 1.0,
+         "worker": "worker-a", "pid": 1},
+        {"kind": "task", "name": "lifecycle/claimed", "t": 1.2,
+         "worker": "worker-a", "trace_id": "t1", "body": "bbox-1"},
+        {"kind": "task_retry", "name": "lifecycle/retry", "t": 1.3,
+         "worker": "worker-a", "trace_id": "t1", "body": "bbox-1"},
+        {"kind": "snapshot", "t": 2.0, "worker": "worker-a", "pid": 1,
+         "counters": {"tasks/retried": 1, "tasks/committed": 4,
+                      "compile_cache/builds": 1, "compile_cache/hits": 3},
+         "gauges": {"device/bytes_in_use": 1048576.0}, "hists": {}},
+    ]
+    b = [
+        {"kind": "span", "name": "scheduler/load", "t": 3.0, "dur_s": 5.0,
+         "worker": "worker-b", "pid": 1},
+        {"kind": "span", "name": "pipeline/compute", "t": 3.1, "dur_s": 1.0,
+         "worker": "worker-b", "pid": 1},
+        {"kind": "task", "name": "lifecycle/claimed", "t": 3.2,
+         "worker": "worker-b", "trace_id": "t1", "body": "bbox-1"},
+        {"kind": "task", "name": "lifecycle/committed", "t": 3.4,
+         "worker": "worker-b", "trace_id": "t1", "body": "bbox-1"},
+        {"kind": "snapshot", "t": 4.0, "worker": "worker-b", "pid": 1,
+         "counters": {"tasks/committed": 5, "ledger/skips": 2},
+         "gauges": {}, "hists": {}},
+    ]
+    _write_events(tmp_path / "telemetry-worker-a.jsonl", a)
+    _write_events(tmp_path / "telemetry-worker-b.jsonl", b)
+    return a + b
+
+
+def test_summarize_fleet_per_worker(tmp_path):
+    _two_worker_dir(tmp_path)
+    fleet = summarize_fleet(load_telemetry_dir(str(tmp_path)))
+    assert sorted(fleet) == ["worker-a", "worker-b"]
+    a, b = fleet["worker-a"], fleet["worker-b"]
+    assert a["dominant"] == "pipeline/drain"
+    assert a["stall"]["pipeline/drain"]["share"] == pytest.approx(0.75)
+    assert a["retries"] == 1 and a["committed"] == 4
+    assert a["cache_hit_rate"] == pytest.approx(0.75)
+    assert a["device_bytes_in_use"] == pytest.approx(1048576.0)
+    assert b["dominant"] == "scheduler/load"
+    assert b["retries"] == 0 and b["committed"] == 5
+    assert b["ledger_skips"] == 2
+    assert b["cache_hit_rate"] is None  # no cache traffic on b
+
+
+def test_trace_timeline_merges_workers(tmp_path):
+    events = _two_worker_dir(tmp_path)
+    timeline = trace_timeline(events, "t1")
+    assert [e["name"] for e in timeline] == [
+        "lifecycle/claimed", "lifecycle/retry",
+        "lifecycle/claimed", "lifecycle/committed",
+    ]
+    assert [e["worker"] for e in timeline] == [
+        "worker-a", "worker-a", "worker-b", "worker-b",
+    ]
+
+
+def test_load_telemetry_dir_reads_rotations(tmp_path):
+    """Rotated ``.jsonl.1`` files load, and before their live file so a
+    worker's stream stays in order."""
+    _write_events(tmp_path / "telemetry-w.jsonl.1",
+                  [{"kind": "span", "name": "old", "t": 1.0, "dur_s": 1}])
+    _write_events(tmp_path / "telemetry-w.jsonl",
+                  [{"kind": "span", "name": "new", "t": 2.0, "dur_s": 1}])
+    events = load_telemetry_dir(str(tmp_path))
+    assert [e["name"] for e in events] == ["old", "new"]
+
+
+def test_cli_fleet_and_trace_report(tmp_path):
+    _two_worker_dir(tmp_path)
+    from chunkflow_tpu.flow.cli import main
+
+    result = CliRunner().invoke(
+        main,
+        ["log-summary", "--metrics-dir", str(tmp_path), "--fleet",
+         "--trace-id", "t1"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "fleet: 2 worker(s)" in result.output
+    assert "worker worker-a:" in result.output
+    assert "retries=1" in result.output
+    assert "dominant phase: pipeline/drain" in result.output
+    assert "dominant phase: scheduler/load" in result.output
+    assert "trace t1: 4 event(s)" in result.output
+    assert "lifecycle/committed" in result.output
+
+
+def test_cli_fleet_requires_metrics_dir(tmp_path):
+    from chunkflow_tpu.flow.cli import main
+
+    result = CliRunner().invoke(
+        main, ["log-summary", "--log-dir", str(tmp_path), "--fleet"]
+    )
+    assert result.exit_code != 0
+    assert "--fleet/--trace-id needs --metrics-dir" in result.output
+
+
+# ---------------------------------------------------------------------------
+# fleet-status CLI
+# ---------------------------------------------------------------------------
+def test_fleet_status_against_seeded_file_queue(tmp_path):
+    from chunkflow_tpu.flow.cli import main
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    qdir = str(tmp_path / "q")
+    queue = open_queue(qdir)
+    queue.send_messages(["0-4_0-4_0-4", "4-8_0-4_0-4", "8-12_0-4_0-4"])
+    handle, _ = queue.receive()  # one task in flight
+    queue.dead_letter(handle, reason="poison")  # ...now dead-lettered
+    queue.receive()  # a second in flight
+
+    result = CliRunner().invoke(
+        main, ["fleet-status", "-q", qdir], catch_exceptions=False
+    )
+    assert result.exit_code == 0, result.output
+    assert "pending=1" in result.output
+    assert "in-flight=1" in result.output
+    assert "dead=1" in result.output
+    assert "receives=1" in result.output
+    assert "dead-letter tasks pending triage" in result.output
+
+
+def test_fleet_status_samples_live_worker(tmp_path):
+    from chunkflow_tpu.flow.cli import main
+    from chunkflow_tpu.parallel.queues import open_queue
+    from chunkflow_tpu.parallel.restapi import start_metrics_exporter
+
+    qdir = str(tmp_path / "q")
+    open_queue(qdir).send_messages(["0-4_0-4_0-4"])
+    server = start_metrics_exporter(0, host="127.0.0.1")
+    port = server.server_address[1]
+    try:
+        # NB: the CLI invocation resets the registry (one invocation =
+        # one run), so the exporter serves zeroed counters here — the
+        # counter round trip itself is covered in test_restapi.py; this
+        # test pins the dashboard wiring: scrape, format, dead-endpoint
+        # handling
+        result = CliRunner().invoke(
+            main,
+            ["fleet-status", "-q", qdir,
+             "-w", f"127.0.0.1:{port},127.0.0.1:1"],
+            catch_exceptions=False,
+        )
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert result.exit_code == 0, result.output
+    assert f"worker http://127.0.0.1:{port}:" in result.output
+    assert "committed=0" in result.output
+    assert "leases=0" in result.output
+    assert telemetry.worker_id() in result.output
+    # the dead endpoint renders as unreachable instead of crashing
+    assert "worker http://127.0.0.1:1: unreachable" in result.output
+
+
+# ---------------------------------------------------------------------------
+# device-memory gauges (satellite: sampled at drain time)
+# ---------------------------------------------------------------------------
+def test_device_memory_gauges_sampled(monkeypatch):
+    import jax
+
+    from chunkflow_tpu.flow import scheduler
+
+    class FakeDevice:
+        def __init__(self, in_use, peak):
+            self._stats = {"bytes_in_use": in_use,
+                           "peak_bytes_in_use": peak}
+
+        def memory_stats(self):
+            return self._stats
+
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda: [FakeDevice(100, 150), FakeDevice(50, 60)],
+    )
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    scheduler.sample_device_memory()
+    snap = telemetry.snapshot()
+    assert snap["gauges"]["device/bytes_in_use"] == 150
+    assert snap["gauges"]["device/peak_bytes"] == 210
+
+
+def test_device_memory_unsupported_backend_is_noop(monkeypatch):
+    import jax
+
+    from chunkflow_tpu.flow import scheduler
+
+    class NoStats:
+        def memory_stats(self):
+            return None
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [NoStats()])
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    scheduler.sample_device_memory()
+    assert "device/bytes_in_use" not in telemetry.snapshot()["gauges"]
+    # the probe marked itself unsupported: later calls are free no-ops
+    assert scheduler._DEVICE_MEM_UNSUPPORTED is True
+
+
+# ---------------------------------------------------------------------------
+# CloudWatch snapshot publisher (satellite: registry, not just timers)
+# ---------------------------------------------------------------------------
+class FakeCloudWatch:
+    def __init__(self):
+        self.calls = []
+
+    def put_metric_data(self, Namespace, MetricData):
+        self.calls.append((Namespace, MetricData))
+
+
+def test_cloud_watch_publishes_registry_snapshot():
+    from chunkflow_tpu.plugins.aws import cloud_watch
+
+    telemetry.inc("tasks/committed", 4)
+    telemetry.inc("queue/receives", 9)
+    telemetry.gauge("device/bytes_in_use", 2048)
+    with telemetry.task_context(None):
+        with telemetry.span("pipeline/drain"):
+            pass
+    client = FakeCloudWatch()
+    cloud_watch.execute(log={"timer": {"inference": 1.5}}, client=client)
+    assert client.calls
+    data = [d for _, batch in client.calls for d in batch]
+    by_name = {d["MetricName"]: d for d in data}
+    assert by_name["tasks/committed"]["Value"] == 4
+    assert by_name["tasks/committed"]["Unit"] == "Count"
+    assert by_name["queue/receives"]["Value"] == 9
+    assert by_name["device/bytes_in_use"]["Unit"] == "Bytes"
+    assert by_name["pipeline/drain-total"]["Unit"] == "Seconds"
+    # derived dominant-stall share rides along (the autoscaling signal)
+    assert by_name["stall/dominant_share"]["Value"] == pytest.approx(1.0)
+    # legacy timer dict still published for existing dashboards
+    assert by_name["inference-time"]["Value"] == 1.5
+    for d in data:
+        assert d["Dimensions"] == [
+            {"Name": "worker", "Value": telemetry.worker_id()}
+        ]
+    # CloudWatch caps batches at 20
+    for _, batch in client.calls:
+        assert len(batch) <= 20
+
+
+def test_cloud_watch_batches_over_twenty():
+    from chunkflow_tpu.plugins.aws import cloud_watch
+
+    for i in range(25):
+        telemetry.inc(f"c/{i}")
+    client = FakeCloudWatch()
+    cloud_watch.execute(client=client)
+    assert len(client.calls) >= 2
+    assert sum(len(batch) for _, batch in client.calls) >= 25

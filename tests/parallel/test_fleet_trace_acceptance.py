@@ -1,0 +1,219 @@
+"""ISSUE 6 acceptance: fleet observability over a chaos-accented run.
+
+A multi-task volume drains through the supervised lifecycle across TWO
+worker identities sharing one queue, with one injected mid-write kill
+(chaos at op/save-h5 — the classic worker-death-between-write-and-ack
+model) and one poison task. One task's input is missing during worker
+A's tenure, so its claim provably hops workers: A claims it, fails,
+and B — started after the input appears — retries and commits it.
+
+From the merged JSONL alone (no registry, no queue state) the test then
+reconstructs every task's full trace — submit → claim(s) → retry hop →
+commit or dead-letter — with one consistent trace_id per task across
+both workers, and checks that ``log-summary --fleet`` reports
+per-worker stall shares and retry counts matching each worker's live
+registry counters captured at exit.
+"""
+import itertools
+
+import numpy as np
+import pytest
+from click.testing import CliRunner
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.flow.log_summary import (
+    load_telemetry_dir,
+    summarize_fleet,
+    trace_timeline,
+)
+from chunkflow_tpu.parallel.lifecycle import FileLedger
+from chunkflow_tpu.parallel.queues import MemoryQueue
+from chunkflow_tpu.testing import chaos
+
+QUEUE = "memory://fleet-acceptance"
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    MemoryQueue._registry.pop("fleet-acceptance", None)
+    telemetry.reset()
+    chaos.reset()
+    yield
+    MemoryQueue._registry.pop("fleet-acceptance", None)
+    telemetry.reset()
+    chaos.reset()
+
+
+def _seed(tmp_path):
+    """8 task bboxes (7 inputs on disk, one — the hopper — deliberately
+    missing) + 1 poison body, FIFO-queued hopper-first so worker A is
+    guaranteed to claim and fail it."""
+    from chunkflow_tpu.chunk import Chunk
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    in_dir = tmp_path / "in"
+    in_dir.mkdir()
+    rng = np.random.default_rng(7)
+    chunks, bodies = {}, []
+    for zi, yi, xi in itertools.product(range(2), range(2), range(2)):
+        off = (zi * 8, yi * 16, xi * 16)
+        c = Chunk(rng.random((8, 16, 16)).astype(np.float32),
+                  voxel_offset=off)
+        bodies.append(c.bbox.string)
+        chunks[c.bbox.string] = c
+    hopper = bodies[0]
+    for body in bodies[1:]:
+        chunks[body].to_h5(str(in_dir) + "/")
+    # MemoryQueue delivers FIFO: the hopper is claimed first
+    queue = open_queue(QUEUE)
+    queue.retry_sleep = 0.01
+    queue.send_messages([hopper] + bodies[1:] + ["NOT_A_BBOX"])
+    return in_dir, bodies, hopper, chunks
+
+
+def _run_worker(tmp_path, worker, metrics_dir, in_dir, num=None):
+    from chunkflow_tpu.flow.cli import main
+
+    out_dir = tmp_path / "out"
+    out_dir.mkdir(exist_ok=True)
+    args = [
+        "--metrics-dir", str(metrics_dir),
+        "fetch-task-from-queue", "-q", QUEUE, "-r", "20",
+        "--max-retries", "50", "--lease-renew", "0.25",
+        "--backoff-base", "0.01", "--backoff-cap", "0.05",
+        "--ledger", str(tmp_path / "ledger"),
+    ]
+    if num is not None:
+        args += ["--num", str(num)]
+    args += [
+        "load-h5", "-f", str(in_dir) + "/",
+        "inference", "-s", "4", "8", "8", "-v", "1", "2", "2",
+        "-c", "1", "-f", "identity", "--no-crop-output-margin",
+        "--async-depth", "2",
+        "save-h5", "--file-name", str(out_dir) + "/",
+        "delete-task-in-queue",
+    ]
+    result = CliRunner().invoke(main, args, catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    # capture this worker's live registry counters before anything
+    # resets them — the --fleet report must agree with these
+    return out_dir, dict(telemetry.snapshot()["counters"])
+
+
+def test_fleet_trace_reconstruction(tmp_path, monkeypatch):
+    metrics_dir = tmp_path / "metrics"
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_SNAPSHOT_EVERY", "2")
+
+    # -- submit (the test process is the submitter worker) --------------
+    telemetry.configure(str(metrics_dir))
+    in_dir, bodies, hopper, chunks = _seed(tmp_path)
+    telemetry.flush()
+
+    # -- worker A: chaos mid-write kill, bounded tenure ------------------
+    monkeypatch.setenv("CHUNKFLOW_WORKER_ID", "worker-a")
+    chaos.configure("once=op/save-h5")
+    try:
+        _, counters_a = _run_worker(
+            tmp_path, "worker-a", metrics_dir, in_dir, num=5)
+        injected = chaos.injections()
+    finally:
+        chaos.reset()
+    assert injected.get("op/save-h5", 0) == 1  # the injected worker kill
+
+    # -- the hopper's input appears; worker B drains the rest ------------
+    chunks[hopper].to_h5(str(in_dir) + "/")
+    monkeypatch.setenv("CHUNKFLOW_WORKER_ID", "worker-b")
+    out_dir, counters_b = _run_worker(
+        tmp_path, "worker-b", metrics_dir, in_dir)
+
+    # -- the run converged: every bbox written + ledgered, only the
+    #    poison dead-lettered ---------------------------------------------
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    queue = open_queue(QUEUE)
+    assert len(queue) == 0 and not queue.invisible
+    assert sorted(FileLedger(str(tmp_path / "ledger")).keys()) \
+        == sorted(bodies)
+    outputs = sorted(p.name for p in out_dir.iterdir())
+    assert len(outputs) == 8
+    dead = queue.dead_letters()
+    assert len(dead) == 1
+    assert dead[0]["body"] == "NOT_A_BBOX"
+    assert "ValueError" in dead[0]["reason"]
+
+    # -- reconstruct every task's trace from merged JSONL alone ----------
+    events = load_telemetry_dir(str(metrics_dir))
+    submits = {e["body"]: e["trace_id"] for e in events
+               if e.get("name") == "queue/submit"}
+    assert sorted(submits) == sorted(bodies + ["NOT_A_BBOX"])
+    assert len(set(submits.values())) == 9  # one distinct trace per task
+
+    def timeline(body):
+        return trace_timeline(events, submits[body])
+
+    for body in bodies:
+        tl = timeline(body)
+        names = [e["name"] for e in tl]
+        assert names[0] == "queue/submit"
+        assert "lifecycle/claimed" in names
+        assert names.count("lifecycle/committed") == 1  # exactly-once
+        assert all(e["trace_id"] == submits[body]
+                   for e in tl if e.get("trace_id"))
+        # commit follows the last claim in time order
+        assert names.index("lifecycle/committed") \
+            > names.index("lifecycle/claimed")
+
+    # the hopper's trace spans BOTH workers: claimed + failed on A,
+    # retried, re-claimed and committed on B — one trace id throughout
+    tl = timeline(hopper)
+    claim_workers = [e["worker"] for e in tl
+                     if e["name"] == "lifecycle/claimed"]
+    assert "worker-a" in claim_workers and "worker-b" in claim_workers
+    assert any(e["name"] == "lifecycle/retry" for e in tl)
+    committed = [e for e in tl if e["name"] == "lifecycle/committed"]
+    assert [e["worker"] for e in committed] == ["worker-b"]
+
+    # the chaos-killed save retried somewhere: at least one retry event
+    # beyond the hopper's exists in the stream
+    retries = [e for e in events if e.get("name") == "lifecycle/retry"]
+    assert any(e["trace_id"] != submits[hopper] for e in retries)
+
+    # the poison task's trace ends in a dead-letter with its reason
+    tl = timeline("NOT_A_BBOX")
+    dead_events = [e for e in tl if e["name"] == "lifecycle/dead_letter"]
+    assert len(dead_events) == 1
+    assert "ValueError" in dead_events[0]["reason"]
+    assert not any(e["name"] == "lifecycle/committed" for e in tl)
+
+    # -- --fleet agrees with each worker's live registry ------------------
+    fleet = summarize_fleet(events)
+    assert "worker-a" in fleet and "worker-b" in fleet
+    for worker, counters in (("worker-a", counters_a),
+                             ("worker-b", counters_b)):
+        info = fleet[worker]
+        assert info["retries"] == counters.get("tasks/retried", 0)
+        assert info["committed"] == counters.get("tasks/committed", 0)
+        assert info["ledger_skips"] == counters.get("ledger/skips", 0)
+        # stall attribution present per worker, shares summing to 1
+        assert info["stall"], worker
+        assert sum(s["share"] for s in info["stall"].values()) \
+            == pytest.approx(1.0)
+    assert fleet["worker-a"]["retries"] >= 1  # chaos and/or hopper
+    # every pipelined task commits exactly once fleet-wide
+    assert fleet["worker-a"]["committed"] \
+        + fleet["worker-b"]["committed"] == 8
+
+    # -- and the CLI renders it -------------------------------------------
+    from chunkflow_tpu.flow.cli import main
+
+    result = CliRunner().invoke(
+        main,
+        ["log-summary", "--metrics-dir", str(metrics_dir), "--fleet",
+         "--trace-id", submits[hopper]],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "worker worker-a:" in result.output
+    assert "worker worker-b:" in result.output
+    assert f"trace {submits[hopper]}:" in result.output
+    assert "lifecycle/committed" in result.output

@@ -8,6 +8,7 @@ from chunkflow_tpu.parallel.queues import (
     MemoryQueue,
     SQSQueue,
     open_queue,
+    unpack_task,
 )
 
 
@@ -272,6 +273,7 @@ class FakeSQSClient:
             q["messages"].remove(body)
             handle = f"rh-{len(q['receives'])}-{body[:12]}"
             q["receives"][handle] = q["receives"].get(handle, 0) + 1
+            q.setdefault("inflight", {})[handle] = body
             messages.append({
                 "ReceiptHandle": handle, "Body": body,
                 "Attributes": {
@@ -282,10 +284,25 @@ class FakeSQSClient:
 
     def delete_message(self, QueueUrl, ReceiptHandle):
         self.queues[QueueUrl]["receives"].pop(ReceiptHandle, None)
+        self.queues[QueueUrl].get("inflight", {}).pop(ReceiptHandle, None)
 
     def change_message_visibility(self, QueueUrl, ReceiptHandle,
                                   VisibilityTimeout):
         self.last_visibility = (ReceiptHandle, VisibilityTimeout)
+        if VisibilityTimeout == 0:
+            # a real SQS nack makes the message deliverable again NOW;
+            # the fake otherwise consumes on receive
+            q = self.queues[QueueUrl]
+            body = q.get("inflight", {}).pop(ReceiptHandle, None)
+            if body is not None:
+                q["messages"].append(body)
+
+    def get_queue_attributes(self, QueueUrl, AttributeNames=()):
+        q = self.queues[QueueUrl]
+        return {"Attributes": {
+            "ApproximateNumberOfMessages": str(len(q["messages"])),
+            "ApproximateNumberOfMessagesNotVisible": str(len(q["receives"])),
+        }}
 
 
 class TestSQSQueue:
@@ -298,7 +315,11 @@ class TestSQSQueue:
         q.send_messages(["a", "b", "c"])
         # first call sends all three, retry call resends only Id 1
         assert client.send_batch_calls == [["0", "1", "2"], ["1"]]
-        assert sorted(client.queues[q.queue_url]["messages"]) == ["a", "b", "c"]
+        # stored bodies are the traced wire envelopes; the task payloads
+        # inside are intact
+        assert sorted(
+            unpack_task(m)[0] for m in client.queues[q.queue_url]["messages"]
+        ) == ["a", "b", "c"]
 
     def test_partial_batch_failure_raises_after_retry(self):
         client = FakeSQSClient(fail_batches=2, fail_ids={"0"})

@@ -1,12 +1,23 @@
-"""Coordination service: ID ranges + hierarchical task scheduling over HTTP."""
+"""Coordination service: ID ranges, task scheduling, live /metrics."""
 import json
+import re
 import threading
+import urllib.error
 import urllib.request
 
 import pytest
 
+from chunkflow_tpu.core import telemetry
 from chunkflow_tpu.core.bbox import BoundingBox
-from chunkflow_tpu.parallel.restapi import CoordinationService, serve
+from chunkflow_tpu.parallel.restapi import (
+    CoordinationService,
+    parse_prometheus,
+    prometheus_name,
+    render_prometheus,
+    scrape_worker,
+    serve,
+    start_metrics_exporter,
+)
 from chunkflow_tpu.parallel.task_tree import SpatialTaskTree
 
 
@@ -40,6 +51,131 @@ def test_handle_unknown_and_unclaimed():
     svc = CoordinationService(task_tree=make_tree())
     assert svc.handle("GET", "/nope")[0] == 404
     assert svc.handle("POST", "/task/0-4_0-4_0-4/done")[0] == 404
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (ISSUE 6)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def clean_telemetry(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def test_prometheus_name_mapping():
+    assert prometheus_name("pipeline/ring_occupancy") \
+        == "chunkflow_pipeline_ring_occupancy"
+    assert prometheus_name("tasks/dead_lettered") \
+        == "chunkflow_tasks_dead_lettered"
+    assert prometheus_name("op/save-h5") == "chunkflow_op_save_h5"
+
+
+def test_render_prometheus_golden():
+    """Exact exposition for a hand-built snapshot: counter/gauge/summary
+    typing, name mapping, label escaping, derived stall shares."""
+    snap = {
+        "counters": {"tasks/committed": 3},
+        "gauges": {"scheduler/depth/prefetch": 4},
+        "hists": {
+            "pipeline/drain": {"count": 2, "total": 1.5, "min": 0.5,
+                               "max": 1.0, "mean": 0.75},
+            "pipeline/compute": {"count": 2, "total": 0.5, "min": 0.1,
+                                 "max": 0.4, "mean": 0.25},
+        },
+    }
+    text = render_prometheus(snap, worker='host"1\\a\nb')
+    esc = 'host\\"1\\\\a\\nb'
+    assert text == (
+        "# TYPE chunkflow_tasks_committed_total counter\n"
+        f'chunkflow_tasks_committed_total{{worker="{esc}"}} 3\n'
+        "# TYPE chunkflow_scheduler_depth_prefetch gauge\n"
+        f'chunkflow_scheduler_depth_prefetch{{worker="{esc}"}} 4\n'
+        "# TYPE chunkflow_pipeline_compute summary\n"
+        f'chunkflow_pipeline_compute_count{{worker="{esc}"}} 2\n'
+        f'chunkflow_pipeline_compute_sum{{worker="{esc}"}} 0.5\n'
+        "# TYPE chunkflow_pipeline_drain summary\n"
+        f'chunkflow_pipeline_drain_count{{worker="{esc}"}} 2\n'
+        f'chunkflow_pipeline_drain_sum{{worker="{esc}"}} 1.5\n'
+        "# TYPE chunkflow_stall_share gauge\n"
+        f'chunkflow_stall_share{{worker="{esc}",phase="pipeline/compute"}}'
+        " 0.250000\n"
+        f'chunkflow_stall_share{{worker="{esc}",phase="pipeline/drain"}}'
+        " 0.750000\n"
+        "# TYPE chunkflow_stall_dominant_share gauge\n"
+        f'chunkflow_stall_dominant_share{{worker="{esc}",'
+        'phase="pipeline/drain"} 0.750000\n'
+    )
+
+
+def test_rendered_exposition_parses(clean_telemetry):
+    """Every sample line of a live-registry rendering must match the
+    Prometheus exposition grammar (metric names, label syntax, float
+    values) — parsed in-test, per the acceptance criteria."""
+    telemetry.inc("tasks/committed", 5)
+    telemetry.gauge("pipeline/ring_occupancy", 2)
+    with telemetry.span("pipeline/drain"):
+        pass
+    text = render_prometheus()
+    parsed = parse_prometheus(text)  # raises on any malformed line
+    assert parsed["chunkflow_tasks_committed_total"] == 5
+    assert parsed["chunkflow_pipeline_ring_occupancy"] == 2
+    assert parsed["chunkflow_pipeline_drain_count"] == 1
+    # strict grammar sweep over the raw text
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert re.fullmatch(
+                r"# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                r"(counter|gauge|summary)", line)
+        else:
+            assert re.fullmatch(
+                r'[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+na-]+',
+                line), line
+
+
+def test_metrics_and_healthz_roundtrip(clean_telemetry):
+    """/metrics + /healthz over real HTTP from the exporter thread."""
+    telemetry.inc("tasks/committed", 2)
+    server = start_metrics_exporter(0, host="127.0.0.1")
+    assert server is not None
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            parsed = parse_prometheus(resp.read().decode())
+        assert parsed["chunkflow_tasks_committed_total"] == 2
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz"
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["worker"] == telemetry.worker_id()
+        assert health["inflight_leases"] == 0
+        # the scrape helper fleet-status uses sees the same thing
+        sample = scrape_worker(f"127.0.0.1:{port}")
+        assert sample["error"] is None
+        assert sample["healthz"]["worker"] == telemetry.worker_id()
+        assert sample["metrics"]["chunkflow_tasks_committed_total"] == 2
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_kill_switch_creates_no_listener(monkeypatch):
+    """CHUNKFLOW_TELEMETRY=0 means no socket at all — the same
+    creates-nothing discipline as the JSONL sink."""
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    assert start_metrics_exporter(0) is None
+
+
+def test_scrape_worker_reports_unreachable():
+    sample = scrape_worker("127.0.0.1:1", timeout=0.2)  # nothing listens
+    assert sample["error"] is not None
+    assert sample["healthz"] is None and sample["metrics"] is None
 
 
 def test_http_server_roundtrip():

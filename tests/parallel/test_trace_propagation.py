@@ -1,0 +1,186 @@
+"""Trace-id propagation through the queue lifecycle (ISSUE 6).
+
+A trace id is minted once, at submission, and must survive every hop a
+task can take: claim, nack (preemption hand-back), re-claim by a second
+worker, dead-letter, and operator requeue — for all three queue
+backends. The wire envelope is invisible to consumers: bodies come back
+exactly as submitted.
+"""
+import pytest
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.parallel.queues import (
+    FileQueue,
+    MemoryQueue,
+    SQSQueue,
+    pack_task,
+    unpack_task,
+)
+from tests.parallel.test_queues import FakeSQSClient
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def make_queue(backend, tmp_path):
+    """A fresh queue plus a factory for a 'second worker' view of the
+    same queue (same storage, new client object where that's
+    meaningful)."""
+    if backend == "memory":
+        MemoryQueue._registry.pop("trace-test", None)
+        q = MemoryQueue.open("trace-test", visibility_timeout=600)
+        return q, lambda: MemoryQueue.open("trace-test")
+    if backend == "file":
+        path = str(tmp_path / "q")
+        return FileQueue(path, visibility_timeout=600), \
+            lambda: FileQueue(path, visibility_timeout=600)
+    client = FakeSQSClient()
+    q = SQSQueue("trace-test", client=client)
+    return q, lambda: SQSQueue("trace-test", client=client)
+
+
+def test_pack_unpack_roundtrip_and_idempotence():
+    wire = pack_task("0-4_0-4_0-4")
+    body, trace = unpack_task(wire)
+    assert body == "0-4_0-4_0-4"
+    assert trace is not None and len(trace) == 32
+    # idempotent: re-packing an envelope keeps its original trace id
+    assert pack_task(wire) == wire
+    # pre-envelope payloads (an old queue on disk) unwrap to themselves
+    assert unpack_task("plain-bbox") == ("plain-bbox", None)
+    assert unpack_task('{"not": "ours"}') == ('{"not": "ours"}', None)
+
+
+@pytest.mark.parametrize("backend", ["memory", "file", "sqs"])
+def test_trace_survives_nack_reclaim_dead_letter(backend, tmp_path):
+    """claim → nack → re-claim on a second worker → dead-letter: one
+    trace id throughout, and the listed dead-letter entry carries it."""
+    q, second_worker = make_queue(backend, tmp_path)
+    q.send_messages(["0-4_0-4_0-4"])
+
+    handle, body = q.receive()
+    assert body == "0-4_0-4_0-4"  # envelope is wire-only
+    trace = q.trace_id(handle)
+    assert trace is not None and len(trace) == 32
+
+    q.nack(handle)  # preempted worker hands the claim back
+
+    q2 = second_worker()
+    item = q2.receive()
+    assert item is not None
+    handle2, body2 = item
+    assert body2 == "0-4_0-4_0-4"
+    assert q2.trace_id(handle2) == trace  # the hop kept the identity
+
+    q2.dead_letter(handle2, reason="poison")
+    dead = q2.dead_letters()
+    assert len(dead) == 1
+    assert dead[0]["body"] == "0-4_0-4_0-4"
+    assert dead[0]["trace_id"] == trace
+    assert dead[0]["reason"] == "poison"
+
+
+@pytest.mark.parametrize("backend", ["memory", "file", "sqs"])
+def test_trace_survives_dead_letter_requeue(backend, tmp_path):
+    """An operator requeue (`chunkflow dead-letter --requeue`) must not
+    mint a new identity: the task's history stays one timeline."""
+    q, second_worker = make_queue(backend, tmp_path)
+    q.send_messages(["8-12_0-4_0-4"])
+    handle, _ = q.receive()
+    trace = q.trace_id(handle)
+    q.dead_letter(handle, reason="transient outage")
+    assert q.requeue_dead() == 1
+
+    q2 = second_worker()
+    item = q2.receive()
+    assert item is not None
+    handle2, body2 = item
+    assert body2 == "8-12_0-4_0-4"
+    assert q2.trace_id(handle2) == trace
+
+
+@pytest.mark.parametrize("backend", ["memory", "file"])
+def test_trace_survives_visibility_expiry(backend, tmp_path):
+    """The crashed-worker path: a claim that expires (no nack, no ack)
+    reappears with the same trace id — receive-side bookkeeping rides
+    the wire envelope, not worker memory."""
+    q, _ = make_queue(backend, tmp_path)
+    q.visibility_timeout = 0.05
+    q.send_messages(["16-20_0-4_0-4"])
+    handle, _ = q.receive()
+    trace = q.trace_id(handle)
+    import time
+
+    time.sleep(0.1)  # the worker "crashed"; the janitor requeues
+    item = q.receive()
+    assert item is not None
+    handle2, body = item
+    assert body == "16-20_0-4_0-4"
+    assert q.trace_id(handle2) == trace
+
+
+def test_submit_event_anchors_the_timeline(tmp_path):
+    """send_messages emits one queue/submit event per task (when a sink
+    is configured) carrying the minted trace id — the first entry of
+    every per-trace timeline."""
+    import json
+
+    path = telemetry.configure(str(tmp_path / "metrics"))
+    q = FileQueue(str(tmp_path / "q"))
+    q.send_messages(["0-4_0-4_0-4", "4-8_0-4_0-4"])
+    telemetry.flush()
+    events = [json.loads(line) for line in open(path) if line.strip()]
+    submits = [e for e in events if e.get("name") == "queue/submit"]
+    assert len(submits) == 2
+    assert {e["body"] for e in submits} == {"0-4_0-4_0-4", "4-8_0-4_0-4"}
+    for e in submits:
+        assert len(e["trace_id"]) == 32
+        assert e["worker"] == telemetry.worker_id()
+    # the claimed trace matches the submitted one
+    handle, body = q.receive()
+    submitted = {e["body"]: e["trace_id"] for e in submits}
+    assert q.trace_id(handle) == submitted[body]
+
+
+def test_queue_counters_ride_the_registry():
+    MemoryQueue._registry.pop("counter-test", None)
+    q = MemoryQueue.open("counter-test")
+    q.send_messages(["a", "b"])
+    q.receive()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["queue/sent"] == 2
+    assert snap["counters"]["queue/receives"] == 1
+
+
+def test_stats_surface(tmp_path):
+    """queue.stats() is the fleet-status substrate: pending / in-flight
+    / dead / receives for every backend."""
+    # memory
+    MemoryQueue._registry.pop("stats-test", None)
+    q = MemoryQueue.open("stats-test")
+    q.send_messages(["a", "b", "c"])
+    h, _ = q.receive()
+    q.dead_letter(h, reason="x")
+    h2, _ = q.receive()
+    # receives tracks live handles only: the dead-lettered task's count
+    # moved into its dead-letter entry
+    assert q.stats() == {"pending": 1, "inflight": 1, "dead": 1,
+                         "receives": 1}
+    # file
+    fq = FileQueue(str(tmp_path / "statsq"))
+    fq.send_messages(["a", "b"])
+    fq.receive()
+    s = fq.stats()
+    assert (s["pending"], s["inflight"], s["dead"], s["receives"]) \
+        == (1, 1, 0, 1)
+    # sqs (fake client reports approximate depths)
+    sq = SQSQueue("stats-test", client=FakeSQSClient())
+    sq.send_messages(["a", "b"])
+    sq.receive()
+    s = sq.stats()
+    assert s["pending"] == 1 and s["inflight"] == 1 and s["receives"] == 1

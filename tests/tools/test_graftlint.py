@@ -353,6 +353,23 @@ def test_gl007_ignores_host_side_telemetry():
     assert "GL007" not in codes(findings)
 
 
+def test_gl007_covers_task_context_helpers():
+    """The ISSUE 6 fleet helpers (task_context, worker_id,
+    current_trace_id) resolve under chunkflow_tpu.core.telemetry.* like
+    every other telemetry call, so trace stamping can never leak into a
+    jitted function — stamping belongs around the dispatch, not in it."""
+    findings, _ = run("""\
+        import jax
+        from chunkflow_tpu.core import telemetry
+
+        @jax.jit
+        def f(x):
+            with telemetry.task_context(telemetry.current_trace_id()):
+                return x * telemetry.worker_id().__len__()
+    """)
+    assert codes(findings).count("GL007") == 3
+
+
 def test_gl007_module_alias_and_traced_callee():
     # `telemetry.inc` via module import, inside a lax.scan callback
     findings, _ = run("""\
