@@ -925,6 +925,125 @@ def run_resilience_overhead(
     }
 
 
+def run_fleet_smoke(n_tasks: int = 6) -> dict:
+    """Chaos smoke of the fleet supervisor (ISSUE 7, CI gate): a REAL
+    multi-process fleet drains a small volume while one worker is
+    SIGKILLed mid-run and one spot-drill preemption fires. The run must
+    converge — every task committed exactly once (ledger markers ==
+    bodies), outputs present, queue drained, nothing dead-lettered —
+    or this raises and run_tests.sh goes red. This is the wiring test
+    the unit suite cannot give: real subprocesses, real /healthz
+    probes, real lease recovery across process boundaries."""
+    import shutil
+    import tempfile
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.parallel.fleet import FleetSupervisor
+    from chunkflow_tpu.parallel.lifecycle import FileLedger
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    telemetry.reset()
+    scratch = tempfile.mkdtemp(prefix="chunkflow-fleet-smoke-")
+    in_dir = os.path.join(scratch, "in")
+    out_dir = os.path.join(scratch, "out")
+    metrics = os.path.join(scratch, "metrics")
+    for d in (in_dir, out_dir, metrics):
+        os.makedirs(d)
+    rng = np.random.default_rng(2)
+    bodies = []
+    for i in range(n_tasks):
+        c = Chunk(rng.random((8, 16, 16), dtype=np.float32),
+                  voxel_offset=(i * 8, 0, 0))
+        c.to_h5(in_dir + "/")
+        bodies.append(c.bbox.string)
+    qdir = os.path.join(scratch, "q")
+    open_queue(qdir).send_messages(bodies)
+    slow = os.path.join(scratch, "slow.py")
+    with open(slow, "w") as f:  # a kill window on any box
+        f.write("import time\n\n\ndef execute(chunk):\n"
+                "    time.sleep(0.3)\n    return chunk\n")
+    ledger_dir = os.path.join(scratch, "ledger")
+    worker_args = [
+        "fetch-task-from-queue", "-q", qdir, "-v", "4", "-r", "8",
+        "--poll-interval", "0.25", "--max-retries", "50",
+        "--lease-renew", "1.0", "--backoff-base", "0.01",
+        "--backoff-cap", "0.1", "--ledger", ledger_dir,
+        "load-h5", "-f", in_dir + "/",
+        "plugin", "--name", slow,
+        "inference", "-s", "4", "8", "8", "-v", "1", "2", "2",
+        "-c", "1", "-f", "identity", "--no-crop-output-margin",
+        "--async-depth", "2",
+        "save-h5", "--file-name", out_dir + "/",
+        "delete-task-in-queue",
+    ]
+    sup = FleetSupervisor(
+        qdir, worker_args, min_workers=1, max_workers=2, interval=0.5,
+        scale_up_backlog=2.0, idle_ticks=2, probe_misses=6,
+        probe_timeout=2.0, startup_grace=90.0, term_grace=20.0,
+        crash_limit=5, metrics_dir=metrics, seed=1,
+        visibility_timeout=4.0,
+        worker_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
+    )
+    summary = {}
+    runner = threading.Thread(
+        target=lambda: summary.update(sup.run(max_runtime=240.0,
+                                              settle_ticks=3)),
+        daemon=True,
+    )
+    ledger = FileLedger(ledger_dir)
+    t0 = time.perf_counter()
+    try:
+        runner.start()
+
+        def live():
+            return [w for w in sup.workers
+                    if w.active and w.proc.poll() is None]
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if len(ledger.keys()) >= 2 and live():
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("fleet smoke: no commits within 120s")
+        os.kill(live()[0].proc.pid, signal.SIGKILL)  # crash-shaped death
+        sup.request_drill()  # and one spot-drill preemption
+        runner.join(timeout=240)
+        if runner.is_alive():
+            raise RuntimeError("fleet smoke: run did not converge")
+    finally:
+        sup.stop()
+        runner.join(timeout=30)
+        sup.shutdown()
+    wall_s = time.perf_counter() - t0
+    marks = ledger.keys()
+    if sorted(marks) != sorted(bodies):
+        raise RuntimeError(
+            f"fleet smoke: ledger incomplete {len(marks)}/{n_tasks}")
+    outs = [n for n in os.listdir(out_dir) if n.endswith(".h5")]
+    if len(outs) != n_tasks:
+        raise RuntimeError(
+            f"fleet smoke: {len(outs)}/{n_tasks} outputs written")
+    queue = open_queue(qdir)
+    stats = queue.stats()
+    if stats["pending"] or stats["inflight"] or queue.dead_letters():
+        raise RuntimeError(f"fleet smoke: queue not clean: {stats}")
+    shutil.rmtree(scratch, ignore_errors=True)
+    return {
+        "metric": "fleet_smoke",
+        "value": 1.0,
+        "unit": "converged",
+        "tasks": n_tasks,
+        "wall_s": round(wall_s, 2),
+        "sessions": summary.get("spawned"),
+        "worker_deaths": summary.get("worker_deaths"),
+        "drill_preemptions": summary.get("drill_preemptions"),
+        "evictions": summary.get("evictions"),
+        "gate_pass": True,
+    }
+
+
 def _check_pallas_oracle():
     """Identity-engine oracle at toy size: catches a miscompiled pallas
     scatter kernel (wrong results, not just crashes) before it can taint
@@ -1274,7 +1393,7 @@ def parent_main() -> int:
 def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] in (
         "pipeline_overlap", "telemetry_overhead", "e2e_overlap",
-        "resilience_overhead", "export_overhead",
+        "resilience_overhead", "export_overhead", "fleet_smoke",
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -1300,6 +1419,11 @@ def main() -> int:
             # a lock/fsync on the per-task path is a real regression,
             # shared-box scheduling noise is not
             return 0 if result["value"] < 15.0 else 4
+        if sys.argv[1] == "fleet_smoke":
+            # binary gate: a multi-process chaos run either converges
+            # (every task exactly once despite a SIGKILL and a drill)
+            # or run_fleet_smoke raises and the process exits nonzero
+            return _emit(run_fleet_smoke())
         if sys.argv[1] == "export_overhead":
             result = run_export_overhead()
             _emit(result)

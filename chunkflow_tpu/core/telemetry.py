@@ -250,7 +250,7 @@ class _Registry:
             pass
         try:
             os.replace(self.sink_path, self.sink_path + ".1")
-            self.sink = open(self.sink_path, "a")
+            self.sink = open(self.sink_path, "a", buffering=1)
             self.sink_bytes = 0
         except OSError:
             self.sink = None  # unrotatable sink: stop emitting, keep computing
@@ -281,7 +281,12 @@ def configure(metrics_dir: Optional[str]) -> Optional[str]:
         ch if ch.isalnum() or ch in "._-" else "_" for ch in worker_id()
     )
     path = os.path.join(metrics_dir, f"telemetry-{safe}.jsonl")
-    sink = open(path, "a")
+    # line-buffered: each event line reaches the OS page cache as it is
+    # emitted (no fsync — this is cheap), so a worker that dies by
+    # SIGKILL / spot preemption still leaves its span and task events on
+    # disk for crash-recovery trace reconstruction (parallel/fleet.py;
+    # a block-buffered sink would lose the tail silently)
+    sink = open(path, "a", buffering=1)
     try:
         existing = os.path.getsize(path)
     except OSError:

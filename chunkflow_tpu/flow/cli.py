@@ -104,7 +104,13 @@ def main(mip, dry_run, verbose, profile_dir, metrics_dir, metrics_port):
       fetch-task-from-queue --max-retries/--lease-renew/--ledger runs
       the worker supervised (contained retries, dead-letter, resume);
       CHUNKFLOW_CHAOS injects seeded stage kills for drill runs
-      (testing/chaos.py).
+      (testing/chaos.py; action=kill for true SIGKILL process death).
+
+    \b
+    Fleet supervision (docs/fault_tolerance.md "Running a fleet"):
+      fleet-run spawns/monitors/scales/evicts worker processes from
+      live telemetry; CHUNKFLOW_FLEET=0 pins a static fleet size and
+      bypasses the scaling controller (liveness replacement stays).
     """
     from chunkflow_tpu.core import telemetry
 
@@ -494,7 +500,18 @@ def prefetch_cmd(depth, to_device):
 @click.option("--visibility-timeout", "-v", type=int, default=1800)
 @click.option("--retry-times", "-r", type=int, default=30,
               help="empty-queue polls before giving up (reference "
-                   "sqs_queue.py:115-130)")
+                   "sqs_queue.py:115-130). Keep this MODERATE for "
+                   "fleet workers: the pipeline flushes its buffered "
+                   "tail when this generator finishes, so a worker that "
+                   "polls an empty queue for long holds its last "
+                   "async-depth tasks claimed-but-unacked the whole "
+                   "time (docs/fault_tolerance.md \"Running a fleet\")")
+@click.option("--poll-interval", type=float, default=None,
+              help="seconds between empty-queue polls (default: the "
+                   "backend's own cadence). retry-times * poll-interval "
+                   "is how long an idle worker lingers before flushing "
+                   "its buffered tail and exiting — the drain-session "
+                   "knob fleet workers tune down")
 @click.option("--num", type=int, default=-1, help="max tasks to process (-1: drain)")
 @click.option("--max-retries", type=int, default=None,
               help="supervised mode (docs/fault_tolerance.md): a task "
@@ -516,9 +533,9 @@ def prefetch_cmd(depth, to_device):
               help="first-retry backoff ceiling in seconds (doubles per "
                    "attempt, full jitter, capped at --backoff-cap)")
 @click.option("--backoff-cap", type=float, default=60.0)
-def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
-                   max_retries, lease_renew, ledger, backoff_base,
-                   backoff_cap):
+def fetch_task_cmd(queue_name, visibility_timeout, retry_times,
+                   poll_interval, num, max_retries, lease_renew, ledger,
+                   backoff_base, backoff_cap):
     """Pull bbox tasks from a queue; ack via delete-task-in-queue.
 
     With --max-retries / --lease-renew / --ledger the fetch runs under
@@ -583,6 +600,8 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times, num,
 
         queue = open_queue(queue_name, visibility_timeout=visibility_timeout)
         queue.max_empty_retries = retry_times
+        if poll_interval is not None:
+            queue.retry_sleep = max(0.01, poll_interval)
 
         if supervised and not crosshost:
             from chunkflow_tpu.parallel import lifecycle
@@ -723,15 +742,26 @@ def dead_letter_cmd(queue_name, requeue):
                    "(host:port or full URLs) to sample live")
 @click.option("--timeout", type=float, default=1.0,
               help="per-worker scrape timeout in seconds")
-def fleet_status_cmd(queue_name, workers, timeout):
+@click.option("--fleet-state", type=str, default=None,
+              help="a fleet-run state file: its workers are sampled "
+                   "too, and unreachable/dead ones report last-seen "
+                   "time and exit code instead of a bare 'unreachable' "
+                   "(default: fleet-state.json next to --metrics-dir)")
+def fleet_status_cmd(queue_name, workers, timeout, fleet_state):
     """Live fleet dashboard: queue depth, in-flight leases, receive and
     dead-letter counts, plus each reachable worker's /healthz identity
-    and a few headline /metrics samples — exactly the signal surface the
-    future autoscaling supervisor will poll
-    (docs/observability.md "Fleet view")."""
+    and a few headline /metrics samples — the same signal surface the
+    fleet supervisor polls (docs/observability.md "Fleet view"). With a
+    fleet-run state file (--fleet-state), supervisor-owned workers are
+    included automatically and dead ones keep their post-mortem."""
 
     @generator
     def stage(task):
+        import json
+        import os
+        import time as _time
+
+        from chunkflow_tpu.core import telemetry
         from chunkflow_tpu.parallel.queues import open_queue
         from chunkflow_tpu.parallel.restapi import scrape_worker
 
@@ -753,14 +783,60 @@ def fleet_status_cmd(queue_name, workers, timeout):
                 "  -> dead-letter tasks pending triage: inspect with "
                 f"`chunkflow dead-letter -q {queue_name}`"
             )
-        for endpoint in (workers or "").split(","):
-            endpoint = endpoint.strip()
-            if not endpoint:
+
+        # supervisor-owned workers from the fleet-run state file: the
+        # post-mortem source for anything a live scrape cannot answer
+        state_path = fleet_state
+        if state_path is None and telemetry.configured_path():
+            candidate = os.path.join(
+                os.path.dirname(telemetry.configured_path()),
+                "fleet-state.json")
+            if os.path.exists(candidate):
+                state_path = candidate
+        records = {}
+        if state_path:
+            try:
+                with open(state_path) as f:
+                    fleet = json.load(f)
+                for rec in fleet.get("workers", []):
+                    if rec.get("endpoint"):
+                        records[rec["endpoint"]] = rec
+                print(
+                    f"fleet {state_path}: target={fleet.get('target')} "
+                    f"{'static' if fleet.get('static') else 'elastic'} "
+                    f"[{fleet.get('min_workers')}..{fleet.get('max_workers')}]"
+                )
+            except (OSError, ValueError) as exc:
+                print(f"fleet-state {state_path}: unreadable ({exc})",
+                      file=sys.stderr)
+
+        def age(t):
+            return "never" if not t else f"{_time.time() - t:.1f}s ago"
+
+        endpoints = [e.strip() for e in (workers or "").split(",")
+                     if e.strip()]
+        endpoints += [e for e in records if e not in endpoints]
+        for endpoint in endpoints:
+            rec = records.get(endpoint) or {}
+            label = f" [{rec['worker']}]" if rec.get("worker") else ""
+            if rec.get("state") == "exited":
+                # supervisor-owned and already reaped: report the exit
+                # code and last-seen time — no point scraping a corpse
+                code = rec.get("exit_code")
+                note = f"exit code {code}"
+                if isinstance(code, int) and code < 0:
+                    note += f" (signal {-code})"
+                print(f"worker {endpoint}{label}: exited, {note}, "
+                      f"last seen {age(rec.get('last_seen'))}")
                 continue
             sample = scrape_worker(endpoint, timeout=timeout)
             if sample["error"] is not None:
-                print(f"worker {sample['endpoint']}: unreachable "
-                      f"({sample['error']})")
+                line = (f"worker {sample['endpoint']}{label}: "
+                        f"unreachable ({sample['error']})")
+                if rec:
+                    line += (f", state={rec.get('state', '?')}, "
+                             f"last seen {age(rec.get('last_seen'))}")
+                print(line)
                 continue
             health = sample["healthz"] or {}
             metrics = sample["metrics"] or {}
@@ -768,7 +844,7 @@ def fleet_status_cmd(queue_name, workers, timeout):
             retried = metrics.get("chunkflow_tasks_retried_total", 0)
             dominant = metrics.get("chunkflow_stall_dominant_share")
             line = (
-                f"worker {sample['endpoint']}: "
+                f"worker {sample['endpoint']}{label}: "
                 f"{health.get('worker', '?')} "
                 f"leases={health.get('inflight_leases', '?')} "
                 f"committed={committed:g} retried={retried:g}"
@@ -776,6 +852,135 @@ def fleet_status_cmd(queue_name, workers, timeout):
             if dominant is not None:
                 line += f" dominant-stall-share={dominant:.0%}"
             print(line)
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
+@main.command("fleet-run")
+@click.option("--queue-name", "-q", type=str, required=True)
+@click.option("--worker-args", "-w", "worker_args_str", type=str,
+              required=True,
+              help="quoted pipeline stages each worker runs after its "
+                   "supervised fetch stage, ending in "
+                   "delete-task-in-queue — e.g. \"load-h5 -f in/ "
+                   "inference ... save-h5 --file-name out/ "
+                   "delete-task-in-queue\"")
+@click.option("--min-workers", type=int, default=1)
+@click.option("--max-workers", type=int, default=4)
+@click.option("--interval", type=float, default=2.0,
+              help="decision-tick interval in seconds")
+@click.option("--scale-up-backlog", type=float, default=4.0,
+              help="pending tasks per active worker above which a "
+                   "compute-bound fleet grows by one worker per tick")
+@click.option("--idle-ticks", type=int, default=3,
+              help="consecutive idle ticks (pending=in-flight=0) "
+                   "before draining back to --min-workers")
+@click.option("--probe-misses", type=int, default=3,
+              help="consecutive failed /healthz probes before a worker "
+                   "is quarantined (SIGKILL + lease force-nack)")
+@click.option("--term-grace", type=float, default=10.0,
+              help="seconds a SIGTERM'd worker gets to nack and flush "
+                   "before SIGKILL")
+@click.option("--mem-watermark-gb", type=float, default=2.0,
+              help="host MemAvailable floor: scale-up is held when one "
+                   "more worker would dip below it")
+@click.option("--drill-rate", type=float, default=0.0,
+              help="spot-preemption drill: per-tick probability of "
+                   "reclaiming a random live worker through the "
+                   "SIGTERM path (prove preemption recovery "
+                   "continuously; 0 disables)")
+@click.option("--seed", type=int, default=None,
+              help="seed for the drill/eviction rng (reproducible "
+                   "drill runs)")
+@click.option("--max-runtime", type=float, default=86400.0)
+@click.option("--state-file", type=str, default=None,
+              help="fleet-state JSON for fleet-status (default: "
+                   "fleet-state.json under --metrics-dir)")
+@click.option("--visibility-timeout", "-v", type=int, default=300)
+@click.option("--retry-times", "-r", type=int, default=10,
+              help="per-session empty-poll budget (drain sessions: an "
+                   "idle worker flushes and exits; the supervisor "
+                   "respawns while it owes the target size)")
+@click.option("--poll-interval", type=float, default=1.0)
+@click.option("--max-retries", type=int, default=10,
+              help="failed-delivery budget per task. memory/file "
+                   "queues hand preemption nacks back without charging "
+                   "it; on SQS every delivery counts (ApproximateReceive"
+                   "Count cannot be decremented), so size generously "
+                   "for a drill-heavy fleet")
+@click.option("--lease-renew", type=float, default=None,
+              help="lease heartbeat interval (default: "
+                   "visibility-timeout / 3)")
+@click.option("--ledger", type=str, default=None,
+              help="completion ledger passed to every worker "
+                   "(REQUIRED for exactly-once effects under kills; "
+                   "strongly recommended)")
+def fleet_run_cmd(queue_name, worker_args_str, min_workers, max_workers,
+                  interval, scale_up_backlog, idle_ticks, probe_misses,
+                  term_grace, mem_watermark_gb, drill_rate, seed,
+                  max_runtime, state_file, visibility_timeout,
+                  retry_times, poll_interval, max_retries, lease_renew,
+                  ledger):
+    """Run an elastic, preemption-native worker fleet over a queue.
+
+    Spawns supervised fetch-task-from-queue workers as subprocesses,
+    scales them from live telemetry (queue depth, dominant stall,
+    dead-letter rate) between --min-workers and --max-workers under a
+    host-memory watermark, quarantines workers that stop answering
+    /healthz (their leases are force-nacked so the fleet picks the work
+    up immediately), drains gracefully on scale-down, and optionally
+    runs spot-preemption drills. CHUNKFLOW_FLEET=0 pins a static size
+    and bypasses the controller (docs/fault_tolerance.md "Running a
+    fleet")."""
+    import shlex
+
+    @generator
+    def stage(task):
+        import os
+
+        from chunkflow_tpu.core import telemetry
+        from chunkflow_tpu.parallel.fleet import FleetSupervisor
+
+        renew = (visibility_timeout / 3.0
+                 if lease_renew is None else lease_renew)
+        worker_args = [
+            "fetch-task-from-queue", "-q", queue_name,
+            "-v", str(visibility_timeout), "-r", str(retry_times),
+            "--poll-interval", str(poll_interval),
+            "--max-retries", str(max_retries),
+            "--lease-renew", str(renew),
+        ]
+        if ledger:
+            worker_args += ["--ledger", ledger]
+        worker_args += shlex.split(worker_args_str)
+        metrics_dir = (
+            os.path.dirname(telemetry.configured_path())
+            if telemetry.configured_path() else None
+        )
+        supervisor = FleetSupervisor(
+            queue_name, worker_args,
+            min_workers=min_workers, max_workers=max_workers,
+            interval=interval, scale_up_backlog=scale_up_backlog,
+            idle_ticks=idle_ticks, probe_misses=probe_misses,
+            term_grace=term_grace, mem_watermark_gb=mem_watermark_gb,
+            drill_rate=drill_rate, seed=seed, metrics_dir=metrics_dir,
+            state_path=state_file,
+            visibility_timeout=visibility_timeout,
+        )
+        summary = supervisor.run(max_runtime=max_runtime)
+        print(
+            f"fleet drained: {summary['spawned']} worker session(s), "
+            f"{summary['scale_ups']:g} scale-up(s), "
+            f"{summary['scale_downs']:g} scale-down(s), "
+            f"{summary['evictions']:g} eviction(s), "
+            f"{summary['worker_deaths']:g} unexpected death(s), "
+            f"{summary['drill_preemptions']:g} drill preemption(s)"
+            + (" [static]" if summary["static"] else "")
+        )
+        if supervisor.state_path:
+            print(f"fleet state: {supervisor.state_path}")
         return
         yield  # pragma: no cover
 

@@ -40,8 +40,18 @@ STALL_PHASES = (
 LIFECYCLE_COUNTERS = (
     "tasks/committed", "tasks/retried", "tasks/surrendered",
     "tasks/dead_lettered", "tasks/preempted", "ledger/skips",
-    "lease/renewals", "lease/renew_failures", "pipeline/chain_rebuilds",
-    "chaos/injected",
+    "lease/renewals", "lease/renew_failures", "lifecycle/renew_errors",
+    "pipeline/chain_rebuilds", "chaos/injected",
+)
+
+#: fleet-supervisor counters (parallel/fleet.py), reported as their own
+#: block: on an elastic fleet, "how many workers were spawned / evicted
+#: / drill-preempted and why scale-up was held" is the ops story
+FLEET_COUNTERS = (
+    "fleet/spawns", "fleet/scale_up", "fleet/scale_down",
+    "fleet/scale_down_drains", "fleet/evictions", "fleet/worker_deaths",
+    "fleet/drill_preemptions", "fleet/probe_failures",
+    "fleet/leases_nacked", "fleet/holds", "fleet/crash_backoffs",
 )
 
 
@@ -307,6 +317,24 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
             print(
                 "  -> dead-lettered tasks pending triage: inspect with "
                 "`chunkflow dead-letter -q <queue>`"
+            )
+    fleet = {
+        name: agg["counters"][name]
+        for name in FLEET_COUNTERS if agg["counters"].get(name)
+    }
+    if fleet:
+        print('fleet supervisor (docs/fault_tolerance.md "Running a '
+              'fleet"):')
+        for name in FLEET_COUNTERS:
+            if name in fleet:
+                print(f"  {name:<24} {fleet[name]:>7g}")
+        workers_gauge = agg["gauges"].get("fleet/workers")
+        target_gauge = agg["gauges"].get("fleet/target")
+        if workers_gauge or target_gauge:
+            print(
+                f"  final size: {(workers_gauge or {}).get('last', 0):g}"
+                f" worker(s), target "
+                f"{(target_gauge or {}).get('last', 0):g}"
             )
     occupancy = agg["gauges"].get("pipeline/ring_occupancy")
     if occupancy:

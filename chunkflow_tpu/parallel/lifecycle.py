@@ -212,6 +212,31 @@ def open_ledger(spec: str) -> LedgerBase:
 # ---------------------------------------------------------------------------
 # lease heartbeats
 # ---------------------------------------------------------------------------
+def _renew_with_retry(queue: QueueBase, handle: str,
+                      timeout: Optional[float] = None,
+                      attempts: int = 3, base: float = 0.05) -> bool:
+    """One heartbeat renewal, retried in place on transient transport
+    errors (SQS throttle, network blip) with short exponential backoff.
+    A single raised renew must not cost the whole heartbeat — on a busy
+    fleet that silently forfeits every lease this thread guards. Each
+    failed attempt counts ``lifecycle/renew_errors``; only giving up
+    after ``attempts`` counts ``lease/renew_failures`` (the lease may
+    genuinely be lost — another worker owns the task now — and the
+    ledger makes the duplicate effect-free)."""
+    for attempt in range(1, attempts + 1):
+        try:
+            with telemetry.span("lifecycle/renew"):
+                queue.renew(handle, timeout)
+            telemetry.inc("lease/renewals")
+            return True
+        except Exception:
+            telemetry.inc("lifecycle/renew_errors")
+            if attempt < attempts:
+                time.sleep(base * (2 ** (attempt - 1)))
+    telemetry.inc("lease/renew_failures")
+    return False
+
+
 class LeaseRenewer:
     """Daemon thread extending a claimed task's visibility lease every
     ``interval`` seconds while compute runs, so a slow chunk is not
@@ -239,13 +264,8 @@ class LeaseRenewer:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
-            try:
-                with telemetry.span("lifecycle/renew"):
-                    self.queue.renew(self.handle, self.timeout)
+            if _renew_with_retry(self.queue, self.handle, self.timeout):
                 self.renewals += 1
-                telemetry.inc("lease/renewals")
-            except Exception:
-                telemetry.inc("lease/renew_failures")
 
     def stop(self) -> None:
         self._stop.set()
@@ -274,18 +294,20 @@ class _Heartbeat:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
-            for lc in inflight():
-                if lc.supervisor is not self.supervisor or lc.done:
-                    continue
-                try:
-                    with telemetry.span("lifecycle/renew"):
-                        self.supervisor.queue.renew(lc.handle)
-                    telemetry.inc("lease/renewals")
-                except Exception:
-                    # the lease may already be lost (task re-claimed
-                    # elsewhere); this attempt's commit still runs and
-                    # the ledger de-duplicates the effects
-                    telemetry.inc("lease/renew_failures")
+            try:
+                for lc in inflight():
+                    if lc.supervisor is not self.supervisor or lc.done:
+                        continue
+                    # retried in place with backoff: a transient renew
+                    # error must not forfeit the whole heartbeat tick,
+                    # and nothing here may kill the only renewal thread
+                    _renew_with_retry(self.supervisor.queue, lc.handle)
+            except Exception:
+                # belt-and-braces: an error OUTSIDE the per-lease retry
+                # (registry iteration, exotic queue state) would
+                # otherwise end this daemon thread silently, losing all
+                # lease renewal for the rest of the run
+                telemetry.inc("lifecycle/renew_errors")
 
     def stop(self) -> None:
         self._stop.set()

@@ -171,8 +171,36 @@ class QueueBase:
     def nack(self, handle: str) -> None:
         """Release the claim immediately: the task becomes visible to
         other workers right away (preemption / fast retry) instead of
-        after the visibility timeout."""
+        after the visibility timeout.
+
+        A nacked delivery is a *handback*, not a failure: backends
+        that can (memory, file) decrement the receive count so
+        preemption / bystander-surrender / supervisor-force-release
+        hops do not burn the retry budget — under frequent spot
+        preemption a healthy task would otherwise be dead-lettered as
+        a "crash loop" without ever failing. SQS cannot decrement
+        ``ApproximateReceiveCount``; size ``--max-retries`` generously
+        there (the SQS redrive-policy convention)."""
         raise NotImplementedError
+
+    def force_release(self, handles) -> int:
+        """Third-party nack: release claims a DEAD worker is still
+        holding, by handle, so its tasks reappear now instead of after
+        the visibility timeout. The fleet supervisor calls this when it
+        evicts or reaps a worker, using the lease handles the worker
+        last reported over ``/healthz`` (parallel/fleet.py). Per-handle
+        errors are swallowed — a handle may have expired, been janitored
+        back, or belong to a re-claimed task, all of which mean the work
+        is already safe. Returns how many releases were attempted
+        without error."""
+        released = 0
+        for handle in handles or ():
+            try:
+                self.nack(handle)
+                released += 1
+            except Exception:
+                continue
+        return released
 
     def receive_count(self, handle: str) -> int:
         """How many times the claimed task has been delivered, this
@@ -282,6 +310,10 @@ class MemoryQueue(QueueBase):
         entry = self.invisible.pop(handle, None)
         if entry is not None:
             self.pending[handle] = entry[0]
+            # a handback is not a failed attempt (see QueueBase.nack)
+            count = self.receives.get(handle, 0)
+            if count > 0:
+                self.receives[handle] = count - 1
 
     def receive_count(self, handle: str) -> int:
         return self.receives.get(handle, 0)
@@ -437,7 +469,17 @@ class FileQueue(QueueBase):
             os.rename(os.path.join(self.claimed_dir, handle),
                       os.path.join(self.pending_dir, handle))
         except OSError:
-            pass  # the janitor beat us to it
+            return  # the janitor beat us to it: the count stands
+        # a handback is not a failed attempt (see QueueBase.nack);
+        # janitor requeues after a CRASH never pass here, so crash
+        # deliveries keep counting toward the crash-loop bound
+        count = self._read_count(handle)
+        if count > 0:
+            try:
+                with open(os.path.join(self.counts_dir, handle), "w") as f:
+                    f.write(str(count - 1))
+            except OSError:
+                pass
 
     def receive_count(self, handle: str) -> int:
         return self._read_count(handle)

@@ -137,15 +137,25 @@ def parse_prometheus(text: str) -> Dict[str, float]:
     return out
 
 
+_STARTED = time.time()
+
+
 def worker_health() -> dict:
-    """The /healthz payload: worker identity + live lease state."""
+    """The /healthz payload: worker identity + live lease state. The
+    lease HANDLES ride along (capped) so a fleet supervisor that has to
+    SIGKILL this worker can force-nack exactly the claims it was
+    holding (``QueueBase.force_release``) instead of waiting out the
+    visibility timeout."""
     from chunkflow_tpu.parallel import lifecycle
 
+    leases = lifecycle.inflight()
     return {
         "status": "ok",
         "worker": telemetry.worker_id(),
         "pid": os.getpid(),
-        "inflight_leases": len(lifecycle.inflight()),
+        "inflight_leases": len(leases),
+        "inflight_handles": [lc.handle for lc in leases[:64]],
+        "uptime_s": time.time() - _STARTED,
         "telemetry_enabled": telemetry.enabled(),
         "metrics_path": telemetry.configured_path(),
         "t": time.time(),
@@ -269,22 +279,45 @@ def exporter_port_from_env() -> Optional[int]:
         return None
 
 
+_DOMINANT_RE = re.compile(
+    r'^chunkflow_stall_dominant_share\{[^}]*phase="([^"]*)"[^}]*\}\s+'
+    r"(-?[0-9.eE+-]+)$", re.MULTILINE,
+)
+
+
+def dominant_stall(text: str) -> Optional[dict]:
+    """``{"phase", "share"}`` from an exposition's labeled
+    ``chunkflow_stall_dominant_share`` sample (None when the worker has
+    no stall window yet). :func:`parse_prometheus` drops labels, but the
+    *phase* is the payload here — it is what tells the fleet supervisor
+    whether a deep queue means compute-bound (add workers) or
+    storage-bound (adding workers just thrashes the volume store)."""
+    m = _DOMINANT_RE.search(text)
+    if m is None:
+        return None
+    return {"phase": m.group(1), "share": float(m.group(2))}
+
+
 def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
-    """Sample one worker's observability endpoints for ``fleet-status``:
-    ``{"endpoint", "healthz": dict|None, "metrics": {name: value}|None,
+    """Sample one worker's observability endpoints for ``fleet-status``
+    and the fleet supervisor: ``{"endpoint", "healthz": dict|None,
+    "metrics": {name: value}|None, "dominant_stall": dict|None,
     "error": str|None}``. ``endpoint`` is ``host:port`` or a full URL;
     unreachable workers report the error instead of raising — a fleet
     dashboard must render around dead workers."""
     base = endpoint if "://" in endpoint else f"http://{endpoint}"
     base = base.rstrip("/")
-    out = {"endpoint": base, "healthz": None, "metrics": None, "error": None}
+    out = {"endpoint": base, "healthz": None, "metrics": None,
+           "dominant_stall": None, "error": None}
     try:
         with urllib.request.urlopen(f"{base}/healthz",
                                     timeout=timeout) as resp:
             out["healthz"] = json.loads(resp.read())
         with urllib.request.urlopen(f"{base}/metrics",
                                     timeout=timeout) as resp:
-            out["metrics"] = parse_prometheus(resp.read().decode())
+            text = resp.read().decode()
+        out["metrics"] = parse_prometheus(text)
+        out["dominant_stall"] = dominant_stall(text)
     except Exception as exc:  # noqa: BLE001 — any failure = unreachable
         out["error"] = f"{type(exc).__name__}: {exc}"
     return out

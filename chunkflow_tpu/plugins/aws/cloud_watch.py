@@ -15,9 +15,12 @@ attributable per worker.
 Published, namespace ``chunkflow-tpu``:
 
 * counters (``tasks/committed``, ``tasks/retried``, ``queue/receives``,
-  ``compile_cache/*``...) as Count;
+  ``compile_cache/*``, ``fleet/spawns``/``fleet/evictions``...) as
+  Count;
 * gauges (``scheduler/depth/*``, ``device/bytes_in_use``...) as None/
-  Bytes;
+  Bytes — the fleet supervisor's sizing gauges (``fleet/workers``,
+  ``fleet/target``, ``fleet/pending``, ``fleet/inflight``) as Count, so
+  a CloudWatch alarm on fleet size or queue depth gets a sane unit;
 * per-phase span totals as Seconds, plus the derived per-phase stall
   shares and the dominant-stall share (``stall/dominant_share``) — the
   autoscaling signal;
@@ -38,6 +41,11 @@ _BATCH = 20
 
 #: gauges measured in bytes get the proper CloudWatch unit
 _BYTE_GAUGES = ("device/bytes_in_use", "device/peak_bytes")
+
+#: gauges that count discrete things (workers, queued tasks): Count,
+#: so fleet-size / queue-depth alarms read naturally
+_COUNT_GAUGES = ("fleet/workers", "fleet/target", "fleet/pending",
+                 "fleet/inflight")
 
 
 def snapshot_metric_data(snap: Optional[dict] = None,
@@ -62,7 +70,13 @@ def snapshot_metric_data(snap: Optional[dict] = None,
     for name, value in sorted((snap.get("counters") or {}).items()):
         add(name, value, "Count")
     for name, value in sorted((snap.get("gauges") or {}).items()):
-        add(name, value, "Bytes" if name in _BYTE_GAUGES else "None")
+        if name in _BYTE_GAUGES:
+            unit = "Bytes"
+        elif name in _COUNT_GAUGES:
+            unit = "Count"
+        else:
+            unit = "None"
+        add(name, value, unit)
     hists = snap.get("hists") or {}
     for name, h in sorted(hists.items()):
         add(f"{name}-total", h["total"], "Seconds")

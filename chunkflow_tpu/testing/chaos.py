@@ -25,6 +25,16 @@ point names::
     CHUNKFLOW_CHAOS="seed=7:rate=0.5:points=lifecycle/claim:max=3"
         stop injecting after 3 kills total
 
+    CHUNKFLOW_CHAOS="once=op/save-h5:action=kill"
+        TRUE process death: on strike, the process is SIGKILLed on the
+        spot (``os.kill(getpid(), SIGKILL)``; ``os._exit(137)`` where
+        SIGKILL is unavailable) instead of raising. Nothing unwinds —
+        no ``finally``, no nack, no flush — exactly the crash shape a
+        preempted spot VM or an OOM-killed worker leaves behind. The
+        fleet supervisor (parallel/fleet.py) and the queue's visibility
+        timeout are what make such a death survivable; ``action=raise``
+        (the default) keeps the polite :class:`ChaosError` path.
+
 Well-known points (grep ``chaos_point`` for the current set):
 ``lifecycle/claim`` (task claimed, before compute),
 ``op/<operator-name>`` (every runtime operator body),
@@ -41,6 +51,7 @@ from __future__ import annotations
 
 import os
 import random
+import signal
 import threading
 from fnmatch import fnmatchcase
 from typing import Dict, List, Optional
@@ -63,6 +74,7 @@ class _Plan:
         self.points: List[str] = []
         self.once: List[str] = []
         self.max_kills: Optional[int] = None
+        self.action = "raise"
         for field in spec.split(":"):
             field = field.strip()
             if not field:
@@ -79,10 +91,17 @@ class _Plan:
                 self.once = [p for p in value.split(",") if p]
             elif key == "max":
                 self.max_kills = int(value)
+            elif key == "action":
+                if value not in ("raise", "kill"):
+                    raise ValueError(
+                        f"bad CHUNKFLOW_CHAOS action {value!r} "
+                        "(want raise or kill)"
+                    )
+                self.action = value
             else:
                 raise ValueError(
                     f"bad CHUNKFLOW_CHAOS field {field!r} "
-                    "(want seed=/rate=/points=/once=/max=)"
+                    "(want seed=/rate=/points=/once=/max=/action=)"
                 )
         self.rng = random.Random(self.seed)
         self.fired_once: set = set()
@@ -149,10 +168,24 @@ def active() -> bool:
     return _current_plan() is not None
 
 
+def _die(name: str) -> None:  # pragma: no cover — the process is gone
+    """``action=kill``: die NOW, the way a preempted VM does. SIGKILL is
+    uncatchable — no ``finally``, no atexit, no telemetry flush runs —
+    so the surviving record is whatever already hit the disk and the
+    queue's lease state, which is precisely what crash-recovery must be
+    able to work from."""
+    try:
+        os.kill(os.getpid(), signal.SIGKILL)
+    except (OSError, AttributeError):
+        pass
+    os._exit(137)  # 128 + SIGKILL: platforms without kill()
+
+
 def chaos_point(name: str) -> None:
     """Declare a kill-able stage boundary. No-op without a plan; raises
-    :class:`ChaosError` when the plan strikes. Never call inside jit —
-    it is host-side control flow by definition."""
+    :class:`ChaosError` when the plan strikes (or SIGKILLs the process
+    under ``action=kill``). Never call inside jit — it is host-side
+    control flow by definition."""
     plan = _current_plan()
     if plan is None:
         return
@@ -160,6 +193,8 @@ def chaos_point(name: str) -> None:
         from chunkflow_tpu.core import telemetry
 
         telemetry.inc("chaos/injected")
+        if plan.action == "kill":
+            _die(name)
         raise ChaosError(
             f"chaos injected at {name} "
             f"(kill #{sum(plan.kills.values())}, spec {plan.spec!r})"

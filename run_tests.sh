@@ -59,6 +59,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py telemetry_overhead || rc=$((rc == 0 ? 1 : rc))
 stage_time "telemetry overhead gate"
 
+# --- pipeline overlap gate --------------------------------------------------
+# Serial vs double-buffered executor on the synthetic chunk workload
+# (docs/performance.md). The in-suite copy of this ratio gate is marked
+# slow/bench (it flips under full-suite load on a 1-core box — ISSUE 7
+# satellite); this standalone run, on a quiet interpreter, is the gate
+# of record. The run itself raises on bit-divergence.
+echo "== pipeline overlap gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py pipeline_overlap || rc=$((rc == 0 ? 1 : rc))
+stage_time "pipeline overlap gate"
+
 # --- e2e overlap gate ------------------------------------------------------
 # Serial vs adaptive-scheduler wall time over the full task lifecycle
 # (load → compute → post → write, docs/performance.md "Adaptive
@@ -90,4 +101,15 @@ echo "== export overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py export_overhead || rc=$((rc == 0 ? 1 : rc))
 stage_time "export overhead gate"
+
+# --- fleet chaos smoke ------------------------------------------------------
+# A REAL multi-process fleet (parallel/fleet.py) drains a small volume
+# while one worker is SIGKILLed mid-run and one spot-drill preemption
+# fires (docs/fault_tolerance.md "Running a fleet"). Binary gate: the
+# run either converges — every task committed exactly once, queue
+# clean — or the process exits nonzero.
+echo "== fleet chaos smoke =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py fleet_smoke || rc=$((rc == 0 ? 1 : rc))
+stage_time "fleet chaos smoke"
 exit $rc

@@ -279,6 +279,48 @@ def test_cloud_watch_publishes_registry_snapshot():
         assert len(batch) <= 20
 
 
+def test_cloud_watch_fleet_gauges_ride_with_count_unit():
+    """ISSUE 7: the fleet supervisor's counters/gauges flow to
+    CloudWatch like every other subsystem, with sizing gauges as Count
+    (a fleet-size alarm needs a sane unit, not None)."""
+    from chunkflow_tpu.plugins.aws import cloud_watch
+
+    telemetry.inc("fleet/spawns", 5)
+    telemetry.inc("fleet/evictions", 1)
+    telemetry.gauge("fleet/workers", 3)
+    telemetry.gauge("fleet/target", 3)
+    telemetry.gauge("fleet/pending", 17)
+    client = FakeCloudWatch()
+    cloud_watch.execute(client=client)
+    data = [d for _, batch in client.calls for d in batch]
+    by_name = {d["MetricName"]: d for d in data}
+    assert by_name["fleet/spawns"]["Unit"] == "Count"
+    assert by_name["fleet/workers"]["Unit"] == "Count"
+    assert by_name["fleet/workers"]["Value"] == 3
+    assert by_name["fleet/target"]["Unit"] == "Count"
+    assert by_name["fleet/pending"]["Unit"] == "Count"
+    assert by_name["fleet/pending"]["Value"] == 17
+
+
+def test_log_summary_prints_fleet_block(tmp_path, capsys):
+    """ISSUE 7: fleet/* counters get their own log-summary block."""
+    from chunkflow_tpu.flow.log_summary import print_telemetry_summary
+
+    telemetry.configure(str(tmp_path))
+    telemetry.inc("fleet/spawns", 4)
+    telemetry.inc("fleet/evictions", 1)
+    telemetry.inc("fleet/drill_preemptions", 2)
+    telemetry.gauge("fleet/workers", 2)
+    telemetry.gauge("fleet/target", 2)
+    telemetry.flush()
+    agg = print_telemetry_summary(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "fleet supervisor" in out
+    assert "fleet/spawns" in out and "fleet/drill_preemptions" in out
+    assert "final size: 2 worker(s), target 2" in out
+    assert agg["counters"]["fleet/spawns"] == 4
+
+
 def test_cloud_watch_batches_over_twenty():
     from chunkflow_tpu.plugins.aws import cloud_watch
 

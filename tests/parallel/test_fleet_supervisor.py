@@ -1,0 +1,844 @@
+"""ISSUE 7: the elastic, preemption-native fleet supervisor.
+
+Two layers of coverage:
+
+* **Controller units** (stub launcher/scraper, no subprocesses): every
+  scale/evict/drill/backoff decision is exercised deterministically —
+  deep queue adds a worker within one decision interval, sustained idle
+  drains to min, the memory watermark and storage-bound/dead-letter
+  holds gate scale-up, probe misses quarantine a worker and force-nack
+  its leases, ``CHUNKFLOW_FLEET=0`` bypasses the controller.
+* **Real multi-process runs** (bottom of the file): a chaos-accented
+  supervised fleet over a real volume — workers SIGKILLed mid-task and
+  spot-drilled while the output must stay bit-identical with exactly
+  one ledger marker per bbox — plus the no-supervisor crash-recovery
+  satellite (chaos ``action=kill`` self-SIGKILL, lease expiry, another
+  worker completes exactly once, the trace hop reconstructs from merged
+  JSONL alone).
+"""
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.parallel.fleet import (
+    FleetSupervisor,
+    WorkerHandle,
+    fleet_disabled,
+    host_available_gb,
+)
+from chunkflow_tpu.parallel.lifecycle import FileLedger
+from chunkflow_tpu.parallel.queues import MemoryQueue, QueueBase, open_queue
+from chunkflow_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.reset()
+    chaos.reset()
+    yield
+    telemetry.reset()
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# stubs
+# ---------------------------------------------------------------------------
+class StubProc:
+    """Popen-alike whose death is scripted: SIGTERM exits 143 (the
+    graceful-preemption contract), kill() exits -9."""
+
+    _pids = itertools.count(40000)
+
+    def __init__(self, die_immediately=False):
+        self.pid = next(self._pids)
+        self.returncode = -9 if die_immediately else None
+        self.signals = []
+
+    def poll(self):
+        return self.returncode
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        if sig == signal.SIGTERM and self.returncode is None:
+            self.returncode = 143
+
+    def kill(self):
+        self.signals.append(signal.SIGKILL)
+        if self.returncode is None:
+            self.returncode = -9
+
+    def terminate(self):
+        self.send_signal(signal.SIGTERM)
+
+    def wait(self, timeout=None):
+        return self.returncode
+
+
+class ScriptedQueue(QueueBase):
+    """stats() plays back a script (last entry repeats); nack records."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.i = 0
+        self.nacked = []
+
+    def stats(self):
+        stats = self.script[min(self.i, len(self.script) - 1)]
+        self.i += 1
+        return dict(stats)
+
+    def nack(self, handle):
+        self.nacked.append(handle)
+
+
+def make_supervisor(tmp_path, script=None, *, procs=None, scrape=None,
+                    **kw):
+    """A supervisor wired to stubs: no subprocess is ever spawned."""
+    spawned = []
+
+    def launcher(cmd, env):
+        proc = (procs.pop(0) if procs else StubProc())
+        spawned.append((cmd, env, proc))
+        return proc
+
+    def scraper(endpoint, timeout=1.0):
+        if scrape is None:
+            return {"endpoint": endpoint, "healthz": {"inflight_leases": 0},
+                    "metrics": {}, "dominant_stall": None, "error": None}
+        return scrape(endpoint)
+
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 3)
+    kw.setdefault("interval", 0.05)
+    kw.setdefault("startup_grace", 0.0)
+    kw.setdefault("mem_probe", lambda: None)
+    kw.setdefault("state_path", str(tmp_path / "fleet-state.json"))
+    sup = FleetSupervisor(
+        "memory://fleet-stub", ["fetch-task-from-queue", "-q", "x",
+                                "delete-task-in-queue"],
+        launcher=launcher, scraper=scraper, **kw,
+    )
+    if script is not None:
+        sup.queue = ScriptedQueue(script)
+    sup._spawned = spawned
+    return sup
+
+
+DEEP = {"pending": 20, "inflight": 0, "dead": 0, "receives": 0}
+IDLE = {"pending": 0, "inflight": 0, "dead": 0, "receives": 0}
+
+
+# ---------------------------------------------------------------------------
+# controller units
+# ---------------------------------------------------------------------------
+def test_deep_queue_scales_up_within_one_interval(tmp_path):
+    """ISSUE 7 acceptance: deep queue -> worker added within ONE
+    decision interval, one per tick, capped at max_workers."""
+    sup = make_supervisor(tmp_path, [DEEP], min_workers=1, max_workers=3,
+                          scale_up_backlog=4.0)
+    sup.step()
+    assert sup.target == 2  # min + 1 after a single interval
+    assert sum(1 for w in sup.workers if w.active) == 2
+    sup.step()
+    sup.step()
+    assert sup.target == 3  # clamped at max from then on
+    assert sum(1 for w in sup.workers if w.active) == 3
+    counters = telemetry.snapshot()["counters"]
+    assert counters["fleet/scale_up"] == 2
+    assert counters["fleet/spawns"] == 3
+
+
+def test_idle_queue_drains_to_min_gracefully(tmp_path):
+    """ISSUE 7 acceptance: sustained-idle queue -> drained to min via
+    SIGTERM (graceful preemption), not SIGKILL."""
+    sup = make_supervisor(tmp_path, [DEEP, DEEP, DEEP, IDLE],
+                          min_workers=1, max_workers=3, idle_ticks=2)
+    for _ in range(3):
+        sup.step()
+    assert sum(1 for w in sup.workers if w.active) == 3
+    sup.step()  # idle tick 1: nothing happens yet
+    assert sup.target == 3
+    sup.step()  # idle tick 2: drain to min
+    assert sup.target == 1
+    assert sum(1 for w in sup.workers if w.active) == 1
+    drained = [w for w in sup.workers if w.state in ("draining", "exited")]
+    assert len(drained) == 2
+    for w in drained:
+        assert signal.SIGTERM in w.proc.signals
+        assert signal.SIGKILL not in w.proc.signals
+    assert telemetry.snapshot()["counters"]["fleet/scale_down"] == 1
+
+
+def test_memory_watermark_gates_scale_up(tmp_path):
+    sup = make_supervisor(tmp_path, [DEEP], mem_probe=lambda: 1.5,
+                          mem_watermark_gb=2.0)
+    for _ in range(3):
+        sup.step()
+    assert sup.target == 1  # deep queue, but no headroom: held at min
+    counters = telemetry.snapshot()["counters"]
+    assert "fleet/scale_up" not in counters
+    assert counters["fleet/holds"] >= 3
+    events = [e for e in _fleet_events(sup) if e["name"] == "fleet/hold"]
+    assert events and events[0]["reason"] == "memory-watermark"
+
+
+def test_storage_bound_fleet_holds_scale_up(tmp_path):
+    """A deep queue whose workers are write-bound must NOT scale: more
+    workers would only multiply pressure on the shared volume store."""
+    def scrape(endpoint):
+        return {"endpoint": endpoint, "healthz": {"inflight_leases": 1},
+                "metrics": {},
+                "dominant_stall": {"phase": "scheduler/write",
+                                   "share": 0.8},
+                "error": None}
+
+    # IDLE first: the min worker spawns and is probed (its dominant
+    # stall becomes known) before the queue deepens
+    sup = make_supervisor(tmp_path, [IDLE, DEEP], scrape=scrape)
+    for _ in range(3):
+        sup.step()
+    assert sup.target == 1
+    assert telemetry.snapshot()["counters"]["fleet/holds"] >= 2
+    holds = [e["reason"] for e in _fleet_events(sup)
+             if e["name"] == "fleet/hold"]
+    assert "storage-bound:scheduler/write" in holds
+
+
+def test_compute_bound_fleet_does_scale(tmp_path):
+    def scrape(endpoint):
+        return {"endpoint": endpoint, "healthz": {"inflight_leases": 1},
+                "metrics": {},
+                "dominant_stall": {"phase": "pipeline/compute",
+                                   "share": 0.9},
+                "error": None}
+
+    sup = make_supervisor(tmp_path, [IDLE, DEEP], scrape=scrape)
+    sup.step()  # spawn the min worker
+    sup.step()  # probed compute-bound + deep queue -> scale
+    assert sup.target == 2
+
+
+def test_dead_letter_surge_holds_scale_up(tmp_path):
+    """A dead-letter flood means the workload is poisoned — adding
+    workers would just dead-letter faster."""
+    script = [dict(DEEP, dead=0), dict(DEEP, dead=3), dict(DEEP, dead=6),
+              dict(DEEP, dead=9)]
+    sup = make_supervisor(tmp_path, script, dead_letter_surge=3)
+    for _ in range(4):
+        sup.step()
+    # first tick scaled (no surge yet); after the surge no further ups
+    assert sup.target == 2
+    holds = [e["reason"] for e in _fleet_events(sup)
+             if e["name"] == "fleet/hold"]
+    assert "dead-letter-surge" in holds
+
+
+def test_probe_misses_quarantine_and_force_nack(tmp_path):
+    """Health probation: a worker that stops answering /healthz is
+    SIGKILLed, the leases it last reported are force-nacked so the
+    work reappears NOW, and a replacement is spawned."""
+    MemoryQueue._registry.pop("fleet-evict", None)
+    queue = MemoryQueue.open("fleet-evict", visibility_timeout=600)
+    queue.send_messages(["t1", "t2"])
+    h1, _ = queue.receive()
+    h2, _ = queue.receive()
+    assert queue.stats()["pending"] == 0
+
+    calls = {"n": 0}
+
+    def scrape(endpoint):
+        calls["n"] += 1
+        if calls["n"] == 1:  # one healthy probe reporting its leases
+            return {"endpoint": endpoint,
+                    "healthz": {"inflight_leases": 2,
+                                "inflight_handles": [h1, h2]},
+                    "metrics": {}, "dominant_stall": None, "error": None}
+        return {"endpoint": endpoint, "healthz": None, "metrics": None,
+                "dominant_stall": None, "error": "URLError: wedged"}
+
+    sup = make_supervisor(tmp_path, [IDLE], scrape=scrape, probe_misses=2,
+                          min_workers=1, max_workers=2)
+    sup.queue = queue
+    sup.step()  # spawn
+    sup.step()  # healthy probe: leases reported
+    assert sup.workers[0].handles == [h1, h2]
+    sup.step()  # miss 1
+    sup.step()  # miss 2 -> quarantined + SIGKILL
+    assert sup.workers[0].state in ("quarantined", "exited")
+    assert signal.SIGKILL in sup.workers[0].proc.signals
+    sup.step()  # reap: force-nack + replacement
+    assert sup.workers[0].state == "exited"
+    assert queue.stats()["pending"] == 2  # both leases handed back NOW
+    counters = telemetry.snapshot()["counters"]
+    assert counters["fleet/evictions"] == 1
+    assert counters["fleet/probe_failures"] >= 2
+    assert counters["fleet/leases_nacked"] == 2
+    assert sum(1 for w in sup.workers if w.active) == 1  # replaced
+
+
+def test_crash_loop_backs_off_respawns(tmp_path):
+    """Workers dying instantly (poisoned image / broken mount) must not
+    spin the host: after crash_limit deaths inside crash_window the
+    supervisor stops respawning for crash_backoff seconds."""
+    procs = [StubProc(die_immediately=True) for _ in range(10)]
+    sup = make_supervisor(tmp_path, [DEEP], procs=procs, min_workers=1,
+                          max_workers=2, crash_limit=3, crash_window=60.0,
+                          crash_backoff=3600.0)
+    for _ in range(8):
+        sup.step()
+    counters = telemetry.snapshot()["counters"]
+    assert counters["fleet/crash_backoffs"] >= 1
+    assert counters["fleet/worker_deaths"] >= 3
+    # respawning stopped well short of the 2-per-step it would burn
+    # without probation (2 spawned on each of the first two ticks,
+    # then the backoff gate holds)
+    assert counters["fleet/spawns"] <= 4
+
+
+def test_spot_drill_preempts_one_live_worker(tmp_path):
+    sup = make_supervisor(tmp_path, [DEEP], min_workers=2, max_workers=3,
+                          seed=7)
+    sup.step()  # spawn 2 (+1 scale-up -> 3)
+    sup.step()  # probes mark them live
+    sup.request_drill()
+    sup.step()
+    drilled = [w for w in sup.workers if w.drill]
+    assert len(drilled) == 1
+    assert signal.SIGTERM in drilled[0].proc.signals
+    assert telemetry.snapshot()["counters"]["fleet/drill_preemptions"] == 1
+    sup.step()  # reap (exit 143 is expected) + replace
+    counters = telemetry.snapshot()["counters"]
+    assert "fleet/worker_deaths" not in counters  # a drill is not a crash
+    assert sum(1 for w in sup.workers if w.active) == sup.target
+
+
+def test_static_mode_bypasses_controller(tmp_path, monkeypatch):
+    """CHUNKFLOW_FLEET=0: fixed size, no telemetry-driven decisions —
+    but replace-the-dead liveness stays."""
+    monkeypatch.setenv("CHUNKFLOW_FLEET", "0")
+    assert fleet_disabled()
+    sup = make_supervisor(tmp_path, [DEEP], min_workers=2, max_workers=4)
+    assert sup.static
+    for _ in range(4):
+        sup.step()
+    assert sup.target == 2
+    assert sum(1 for w in sup.workers if w.active) == 2
+    counters = telemetry.snapshot()["counters"]
+    assert "fleet/scale_up" not in counters
+    assert "fleet/holds" not in counters
+    # liveness: SIGKILL one, it is replaced at the static size
+    sup.workers[0].proc.kill()
+    sup.step()
+    sup.step()
+    assert sum(1 for w in sup.workers if w.active) == 2
+
+
+def test_state_file_reports_exit_code_and_last_seen(tmp_path):
+    sup = make_supervisor(tmp_path, [IDLE], min_workers=1, max_workers=2)
+    sup.step()
+    sup.step()  # probe marks it live (last_seen set)
+    sup.workers[0].proc.kill()  # simulated external SIGKILL
+    sup.step()  # reap + replace
+    state = json.loads((tmp_path / "fleet-state.json").read_text())
+    assert state["queue"] == "memory://fleet-stub"
+    dead = [w for w in state["workers"] if w["state"] == "exited"]
+    assert len(dead) == 1
+    assert dead[0]["exit_code"] == -9
+    assert dead[0]["last_seen"] is not None
+    assert dead[0]["endpoint"].startswith("127.0.0.1:")
+    live = [w for w in state["workers"] if w["state"] != "exited"]
+    assert len(live) == 1 and live[0]["exit_code"] is None
+
+
+def test_worker_handle_record_shape():
+    w = WorkerHandle("fleet-w001", 12345, StubProc(), ["cmd"])
+    rec = w.to_record()
+    assert rec["worker"] == "fleet-w001"
+    assert rec["state"] == "starting"
+    assert rec["exit_code"] is None
+
+
+def test_bounds_validation():
+    with pytest.raises(ValueError, match="min_workers"):
+        FleetSupervisor("memory://x", ["delete-task-in-queue"],
+                        min_workers=3, max_workers=2)
+
+
+def test_host_available_gb_readable():
+    gb = host_available_gb()
+    if gb is None:
+        pytest.skip("no /proc/meminfo on this platform")
+    assert gb > 0
+
+
+def _fleet_events(sup):
+    """Fleet events captured in the telemetry JSONL (events only hit
+    disk when a sink is configured, so route through a temp dir)."""
+    return sup._events
+
+
+# capture fleet events without a JSONL sink: monkeypatch-free shim —
+# telemetry.event is a no-op without a sink, so record via a tiny hook
+@pytest.fixture(autouse=True)
+def _capture_fleet_events(monkeypatch):
+    events = []
+    real_event = telemetry.event
+
+    def recording_event(kind, name, **attrs):
+        if kind == "fleet":
+            events.append(dict(attrs, kind=kind, name=name))
+        return real_event(kind, name, **attrs)
+
+    monkeypatch.setattr(telemetry, "event", recording_event)
+    # expose on every supervisor created in the test
+    real_init = FleetSupervisor.__init__
+
+    def patched_init(self, *a, **kw):
+        real_init(self, *a, **kw)
+        self._events = events
+
+    monkeypatch.setattr(FleetSupervisor, "__init__", patched_init)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# real multi-process runs
+# ---------------------------------------------------------------------------
+def _seed_volume(tmp_path, tag, grid=(3, 2, 2), seed=11):
+    """``prod(grid)`` distinct random input chunks + a file queue
+    holding their bboxes (file://, so real worker subprocesses share
+    it)."""
+    from chunkflow_tpu.chunk import Chunk
+
+    in_dir = tmp_path / f"in-{tag}"
+    in_dir.mkdir()
+    rng = np.random.default_rng(seed)
+    bodies, chunks = [], {}
+    for zi, yi, xi in itertools.product(*(range(g) for g in grid)):
+        off = (zi * 8, yi * 16, xi * 16)
+        c = Chunk(rng.random((8, 16, 16)).astype(np.float32),
+                  voxel_offset=off)
+        c.to_h5(str(in_dir) + "/")
+        bodies.append(c.bbox.string)
+        chunks[c.bbox.string] = c
+    qdir = str(tmp_path / f"q-{tag}")
+    open_queue(qdir).send_messages(bodies)
+    return qdir, in_dir, bodies
+
+
+def _pipeline_args(in_dir, out_dir, slow_plugin=None):
+    args = ["load-h5", "-f", str(in_dir) + "/"]
+    if slow_plugin is not None:
+        # a deterministic per-task delay: keeps the run alive long
+        # enough to kill workers genuinely mid-volume on any box
+        args += ["plugin", "--name", str(slow_plugin)]
+    args += [
+        "inference", "-s", "4", "8", "8", "-v", "1", "2", "2",
+        "-c", "1", "-f", "identity", "--no-crop-output-margin",
+        "--async-depth", "2",
+        "save-h5", "--file-name", str(out_dir) + "/",
+        "delete-task-in-queue",
+    ]
+    return args
+
+
+def _worker_args(qdir, ledger, in_dir, out_dir, *, vis=4, retry_times=10,
+                 poll=0.25, slow_plugin=None):
+    # drain-session workers (parallel/fleet.py): a moderate empty-poll
+    # budget so an idle worker flushes its buffered pipeline tail,
+    # acks, and exits 0 — the supervisor respawns sessions while it
+    # still owes the target size
+    return [
+        "fetch-task-from-queue", "-q", qdir, "-v", str(vis),
+        "-r", str(retry_times), "--poll-interval", str(poll),
+        "--max-retries", "50",
+        "--lease-renew", "1.0", "--backoff-base", "0.01",
+        "--backoff-cap", "0.1", "--ledger", str(ledger),
+    ] + _pipeline_args(in_dir, out_dir, slow_plugin)
+
+
+def _reference_outputs(tmp_path, tag, grid=(3, 2, 2), seed=11):
+    """Fault-free single-process reference leg (in-process CLI)."""
+    from click.testing import CliRunner
+
+    from chunkflow_tpu.flow.cli import main
+
+    qdir, in_dir, bodies = _seed_volume(
+        tmp_path, f"{tag}-ref", grid=grid, seed=seed)
+    out_dir = tmp_path / f"out-{tag}-ref"
+    out_dir.mkdir()
+    args = ["fetch-task-from-queue", "-q", qdir, "-r", "2",
+            ] + _pipeline_args(in_dir, out_dir)
+    result = CliRunner().invoke(main, args, catch_exceptions=False)
+    assert result.exit_code == 0, result.output
+    telemetry.reset()  # the reference leg's counters are not the fleet's
+    return _load_outputs(out_dir), bodies
+
+
+def _load_outputs(out_dir):
+    import h5py
+
+    outputs = {}
+    for path in sorted(out_dir.iterdir()):
+        with h5py.File(path, "r") as f:
+            outputs[path.name] = np.asarray(f["main"][:])
+    return outputs
+
+
+def _slow_plugin(tmp_path, seconds=0.25):
+    path = tmp_path / "slow_identity.py"
+    path.write_text(
+        "import time\n\n\n"
+        f"def execute(chunk):\n    time.sleep({seconds})\n"
+        "    return chunk\n"
+    )
+    return path
+
+
+def _wait_for(cond, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out after {timeout}s waiting for {msg}")
+
+
+def _committed_per_trace(metrics_dir):
+    from chunkflow_tpu.flow.log_summary import load_telemetry_dir
+
+    events = load_telemetry_dir(str(metrics_dir))
+    commits = {}
+    for e in events:
+        if e.get("name") == "lifecycle/committed" and e.get("trace_id"):
+            commits[e["trace_id"]] = commits.get(e["trace_id"], 0) + 1
+    return events, commits
+
+
+def test_multiprocess_sigkill_crash_recovery(tmp_path):
+    """ISSUE 7 satellite: a REAL worker subprocess is SIGKILLed
+    mid-task (chaos ``action=kill`` at op/save-h5 — true process death,
+    nothing unwinds), its lease expires, a second worker completes the
+    task exactly once, and the cross-worker hop reconstructs from the
+    merged JSONL alone."""
+    mdir = tmp_path / "metrics"
+    reference, _ = _reference_outputs(tmp_path, "cr", grid=(2, 2, 1),
+                                      seed=5)
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "cr", grid=(2, 2, 1),
+                                        seed=5)
+    out_dir = tmp_path / "out-cr"
+    out_dir.mkdir()
+    ledger = tmp_path / "ledger-cr"
+    cli = [sys.executable, "-m", "chunkflow_tpu.flow.cli",
+           "--metrics-dir", str(mdir)]
+    # B's poll budget (12 x 0.5s) must outlast A's lease expiry (2s)
+    args = _worker_args(qdir, ledger, in_dir, out_dir, vis=2,
+                        retry_times=12, poll=0.5)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    base_env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        PYTHONPATH=root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    base_env.pop("XLA_FLAGS", None)
+
+    # worker A: self-SIGKILLs at its first save-h5 — mid-task by
+    # construction (the task is claimed, computed, not yet written)
+    env_a = dict(base_env, CHUNKFLOW_WORKER_ID="mp-a",
+                 CHUNKFLOW_CHAOS="once=op/save-h5:action=kill")
+    proc_a = subprocess.run(cli + args, env=env_a, capture_output=True,
+                            text=True, timeout=180)
+    assert proc_a.returncode in (-9, 137), (
+        proc_a.returncode, proc_a.stdout[-2000:], proc_a.stderr[-2000:])
+    assert len(FileLedger(str(ledger)).keys()) < len(bodies)
+
+    # worker B: a clean worker drains the rest; the dead claim expires
+    # (visibility 2s) and is janitored back to pending on B's receive
+    env_b = dict(base_env, CHUNKFLOW_WORKER_ID="mp-b")
+    proc_b = subprocess.run(cli + args, env=env_b, capture_output=True,
+                            text=True, timeout=180)
+    assert proc_b.returncode == 0, (
+        proc_b.stdout[-2000:], proc_b.stderr[-2000:])
+
+    # the volume converged bit-identically, exactly one marker per bbox
+    assert sorted(FileLedger(str(ledger)).keys()) == sorted(bodies)
+    outputs = _load_outputs(out_dir)
+    assert sorted(outputs) == sorted(reference)
+    for name in reference:
+        assert np.array_equal(outputs[name], reference[name]), name
+    queue = open_queue(qdir)
+    assert queue.stats()["pending"] == 0
+    assert queue.stats()["inflight"] == 0
+    assert queue.dead_letters() == []
+
+    # the hop reconstructs from merged JSONL alone: some trace was
+    # claimed by BOTH workers (A died holding it), committed exactly
+    # once — by B; and every commit fleet-wide is exactly-once
+    events, commits = _committed_per_trace(mdir)
+    assert len(commits) == len(bodies)
+    assert set(commits.values()) == {1}
+
+    def claim_workers(trace_id):
+        return {e["worker"] for e in events
+                if e.get("trace_id") == trace_id
+                and e.get("name") == "lifecycle/claimed"}
+
+    hops = [t for t in commits if {"mp-a", "mp-b"} <= claim_workers(t)]
+    assert hops, "no task hopped from the SIGKILLed worker to the survivor"
+    for t in hops:
+        committed_by = [e["worker"] for e in events
+                        if e.get("trace_id") == t
+                        and e.get("name") == "lifecycle/committed"]
+        assert committed_by == ["mp-b"]
+
+
+def test_fleet_chaos_acceptance(tmp_path):
+    """ISSUE 7 acceptance: a supervisor-managed multi-process run over
+    a 16-task volume (+1 deliberate poison task) with two workers
+    SIGKILLed mid-volume and one spot-drill preemption. The supervisor
+    replaces them; the output is bit-identical to the fault-free
+    reference, the ledger holds exactly one marker per bbox, only the
+    poison task dead-letters, and the supervisor ends with the target
+    worker count alive."""
+    mdir = tmp_path / "metrics"
+    mdir.mkdir()
+    reference, _ = _reference_outputs(tmp_path, "fa", grid=(4, 2, 2))
+    telemetry.configure(str(mdir))
+
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "fa", grid=(4, 2, 2))
+    open_queue(qdir).send_messages(["NOT_A_BBOX"])  # the poison task
+    out_dir = tmp_path / "out-fa"
+    out_dir.mkdir()
+    ledger_dir = tmp_path / "ledger-fa"
+    slow = _slow_plugin(tmp_path, seconds=0.4)
+
+    sup = FleetSupervisor(
+        qdir,
+        _worker_args(qdir, ledger_dir, in_dir, out_dir, vis=4,
+                     slow_plugin=slow),
+        min_workers=2, max_workers=3, interval=0.5,
+        scale_up_backlog=2.0, idle_ticks=2, probe_misses=6,
+        probe_timeout=2.0, startup_grace=90.0, term_grace=20.0,
+        crash_limit=5, crash_window=30.0,
+        metrics_dir=str(mdir), seed=3, visibility_timeout=4.0,
+        worker_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
+    )
+    result = {}
+    # idle_ticks (2) < settle_ticks (4): the idle-drain decision fires
+    # before the run declares itself drained, so the fleet is back at
+    # min size when run() returns
+    thread = threading.Thread(
+        target=lambda: result.update(
+            sup.run(max_runtime=300.0, settle_ticks=4,
+                    shutdown_on_drain=False)),
+        daemon=True,
+    )
+    ledger = FileLedger(str(ledger_dir))
+    killed = []
+    try:
+        thread.start()
+
+        def live_pids():
+            return [w.proc.pid for w in sup.workers
+                    if w.active and w.proc.poll() is None
+                    and w.proc.pid not in killed]
+
+        # first SIGKILL: mid-volume (some tasks done, most remaining)
+        _wait_for(lambda: len(ledger.keys()) >= 2 and live_pids(),
+                  180, "first commits + live workers")
+        assert len(ledger.keys()) < len(bodies)
+        victim = live_pids()[0]
+        os.kill(victim, signal.SIGKILL)
+        killed.append(victim)
+
+        # second SIGKILL, later in the volume, still mid-run
+        _wait_for(lambda: len(ledger.keys()) >= 6 and live_pids(),
+                  180, "mid-volume commits + live workers")
+        victim = live_pids()[0]
+        os.kill(victim, signal.SIGKILL)
+        killed.append(victim)
+
+        # one spot-drill preemption through the SIGTERM contract
+        sup.request_drill()
+
+        thread.join(timeout=300)
+        assert not thread.is_alive(), "fleet run did not converge"
+    finally:
+        sup.stop()
+        thread.join(timeout=30)
+        if not result:
+            sup.shutdown()
+
+    assert len(killed) >= 2, killed  # two workers truly SIGKILLed
+    assert result["drill_preemptions"] >= 1
+    assert result["worker_deaths"] >= len(killed)
+    assert result["scale_ups"] >= 1  # the deep queue scaled the fleet
+
+    # ISSUE 7 acceptance: the supervisor ends with the target worker
+    # count alive (drained back to min by the idle queue)
+    assert result["alive"] == sup.target
+    assert sup.target == sup.min_workers
+
+    # bit-identical convergence, exactly one ledger marker per bbox
+    outputs = _load_outputs(out_dir)
+    assert sorted(outputs) == sorted(reference)
+    for name in reference:
+        assert np.array_equal(outputs[name], reference[name]), name
+    assert sorted(ledger.keys()) == sorted(bodies)
+
+    # only the deliberate poison task dead-lettered, with its reason
+    queue = open_queue(qdir)
+    stats = queue.stats()
+    assert stats["pending"] == 0 and stats["inflight"] == 0
+    dead = queue.dead_letters()
+    assert len(dead) == 1, dead
+    assert dead[0]["body"] == "NOT_A_BBOX"
+    assert "ValueError" in dead[0]["reason"]
+
+    # exactly-once across the whole fleet, from merged JSONL alone
+    _, commits = _committed_per_trace(mdir)
+    assert len(commits) == len(bodies)
+    assert set(commits.values()) == {1}
+
+    sup.shutdown()
+    assert all(not w.running for w in sup.workers)
+    # the state file survives for post-mortem fleet-status
+    state = json.loads((mdir / "fleet-state.json").read_text())
+    assert any(w["exit_code"] not in (None, 0) for w in state["workers"])
+
+
+def test_fleet_run_cli_and_fleet_status(tmp_path):
+    """The operational surface: `chunkflow fleet-run` drains a volume
+    end-to-end and leaves a state file that `fleet-status` renders —
+    including exit codes and last-seen times for dead workers."""
+    from click.testing import CliRunner
+
+    from chunkflow_tpu.flow.cli import main
+
+    mdir = tmp_path / "metrics"
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "cli", grid=(2, 2, 1),
+                                        seed=3)
+    out_dir = tmp_path / "out-cli"
+    out_dir.mkdir()
+    pipeline = (
+        f"load-h5 -f {in_dir}/ "
+        "inference -s 4 8 8 -v 1 2 2 -c 1 -f identity "
+        "--no-crop-output-margin --async-depth 2 "
+        f"save-h5 --file-name {out_dir}/ delete-task-in-queue"
+    )
+    result = CliRunner().invoke(
+        main,
+        ["--metrics-dir", str(mdir), "fleet-run", "-q", qdir,
+         "--min-workers", "1", "--max-workers", "2",
+         "--interval", "0.5", "--idle-ticks", "2",
+         "-v", "10", "-r", "6", "--poll-interval", "0.25",
+         "--ledger", str(tmp_path / "ledger-cli"),
+         "--max-runtime", "180", "-w", pipeline],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "fleet drained:" in result.output
+    assert "fleet state:" in result.output
+    assert sorted(_load_outputs(out_dir)) == sorted(
+        f"{b}.h5" for b in bodies)
+    assert (mdir / "fleet-state.json").exists()
+
+    # fleet-status picks the state file up via --metrics-dir and gives
+    # the shut-down workers a post-mortem, not a bare "unreachable"
+    status = CliRunner().invoke(
+        main,
+        ["--metrics-dir", str(mdir), "fleet-status", "-q", qdir],
+        catch_exceptions=False,
+    )
+    assert status.exit_code == 0, status.output
+    assert "pending=0" in status.output
+    assert "exited, exit code" in status.output
+    assert "last seen" in status.output
+
+
+def test_fleet_status_enriches_unreachable_from_state(tmp_path):
+    """Satellite: an unreachable-but-supposedly-live worker reports its
+    state and last-seen age from the fleet state file."""
+    from click.testing import CliRunner
+
+    from chunkflow_tpu.flow.cli import main
+
+    MemoryQueue._registry.pop("fs-enrich", None)
+    MemoryQueue.open("fs-enrich")
+    state = {
+        "t": time.time(), "queue": "memory://fs-enrich", "static": False,
+        "target": 2, "min_workers": 1, "max_workers": 3,
+        "workers": [
+            {"worker": "fleet-w001", "pid": 1, "port": 1,
+             "endpoint": "127.0.0.1:1", "state": "live",
+             "started": time.time() - 60,
+             "last_seen": time.time() - 12.5, "exit_code": None,
+             "inflight_leases": 1},
+            {"worker": "fleet-w002", "pid": 2, "port": 2,
+             "endpoint": "127.0.0.1:2", "state": "exited",
+             "started": time.time() - 60,
+             "last_seen": time.time() - 30.0, "exit_code": -9,
+             "inflight_leases": 0},
+        ],
+    }
+    state_path = tmp_path / "fleet-state.json"
+    state_path.write_text(json.dumps(state))
+    result = CliRunner().invoke(
+        main,
+        ["fleet-status", "-q", "memory://fs-enrich",
+         "--fleet-state", str(state_path), "--timeout", "0.2"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "target=2 elastic [1..3]" in result.output
+    # the live-per-state but unreachable worker: state + last-seen age
+    assert "[fleet-w001]: unreachable" in result.output
+    assert "state=live" in result.output and "s ago" in result.output
+    # the reaped worker: exit code with signal decode, no scrape
+    assert "[fleet-w002]: exited, exit code -9 (signal 9)" \
+        in result.output
+
+
+def test_fleet_static_mode_bit_identical_run(tmp_path, monkeypatch):
+    """ISSUE 7 acceptance: CHUNKFLOW_FLEET=0 bypasses the controller
+    bit-identically — a real static-size fleet drains the same volume
+    to the same bytes with zero scale decisions."""
+    monkeypatch.setenv("CHUNKFLOW_FLEET", "0")
+    mdir = tmp_path / "metrics"
+    mdir.mkdir()
+    reference, _ = _reference_outputs(tmp_path, "st", grid=(2, 2, 1),
+                                      seed=9)
+    qdir, in_dir, bodies = _seed_volume(tmp_path, "st", grid=(2, 2, 1),
+                                        seed=9)
+    out_dir = tmp_path / "out-st"
+    out_dir.mkdir()
+    sup = FleetSupervisor(
+        qdir,
+        _worker_args(qdir, tmp_path / "ledger-st", in_dir, out_dir,
+                     vis=30),
+        min_workers=2, max_workers=4, interval=0.5, idle_ticks=3,
+        startup_grace=90.0, term_grace=20.0, metrics_dir=str(mdir),
+        visibility_timeout=30.0,
+        worker_env={"JAX_PLATFORMS": "cpu", "XLA_FLAGS": ""},
+    )
+    assert sup.static
+    result = sup.run(max_runtime=240.0, settle_ticks=3)
+    assert result["static"] is True
+    assert result["scale_ups"] == 0 and result["scale_downs"] == 0
+    assert result["holds"] == 0
+    assert sup.target == 2
+
+    outputs = _load_outputs(out_dir)
+    assert sorted(outputs) == sorted(reference)
+    for name in reference:
+        assert np.array_equal(outputs[name], reference[name]), name
+    assert open_queue(qdir).stats()["pending"] == 0

@@ -151,10 +151,72 @@ def test_lease_renewer_survives_renew_failure():
             raise OSError("queue gone")
 
     renewer = LeaseRenewer(BrokenQueue(), "h", interval=0.05).start()
-    time.sleep(0.15)
+    time.sleep(0.3)
     renewer.stop()  # must not have died with an unhandled exception
     assert renewer.renewals == 0
-    assert telemetry.snapshot()["counters"]["lease/renew_failures"] >= 1
+    counters = telemetry.snapshot()["counters"]
+    assert counters["lease/renew_failures"] >= 1
+    # every failed attempt (3 per give-up) is individually counted
+    assert counters["lifecycle/renew_errors"] \
+        >= 3 * counters["lease/renew_failures"]
+
+
+def test_renew_retry_recovers_from_transient_error():
+    """A throttled/blipped renew is retried in place with backoff: two
+    transient failures then success must still land the renewal — the
+    heartbeat loses nothing — with the attempts visible in
+    ``lifecycle/renew_errors`` and no ``lease/renew_failures``."""
+    from chunkflow_tpu.parallel.lifecycle import _renew_with_retry
+
+    class FlakyQueue(QueueBase):
+        def __init__(self):
+            self.calls = 0
+
+        def renew(self, handle, timeout=None):
+            self.calls += 1
+            if self.calls <= 2:
+                raise IOError("SQS throttle")
+
+    q = FlakyQueue()
+    assert _renew_with_retry(q, "h", base=0.001) is True
+    assert q.calls == 3
+    counters = telemetry.snapshot()["counters"]
+    assert counters["lifecycle/renew_errors"] == 2
+    assert counters["lease/renewals"] == 1
+    assert "lease/renew_failures" not in counters
+
+
+def test_heartbeat_thread_survives_registry_error(monkeypatch):
+    """Nothing may kill the supervisor's single heartbeat thread: even
+    an error OUTSIDE the per-lease renew (registry iteration blowing
+    up) is swallowed and counted, and the thread keeps renewing on the
+    next tick."""
+    import chunkflow_tpu.parallel.lifecycle as lifecycle_mod
+
+    q = MemoryQueue("hb-survive", visibility_timeout=0.15)
+    q.send_messages(["t"])
+    sup = LifecycleSupervisor(q, lease_renew=0.05)
+    blown = {"n": 0}
+    real_inflight = lifecycle_mod.inflight
+
+    def exploding_inflight():
+        if blown["n"] < 2:
+            blown["n"] += 1
+            raise RuntimeError("registry iteration exploded")
+        return real_inflight()
+
+    monkeypatch.setattr(lifecycle_mod, "inflight", exploding_inflight)
+    gen = sup.tasks(num=1)
+    lc = next(gen)
+    try:
+        time.sleep(0.5)  # two exploding ticks, then renewals resume
+        assert q.receive() is None  # lease still held past the timeout
+        counters = telemetry.snapshot()["counters"]
+        assert counters["lifecycle/renew_errors"] >= 2
+        assert counters["lease/renewals"] >= 1
+        lc.commit()
+    finally:
+        gen.close()
 
 
 # ---------------------------------------------------------------------------
@@ -174,13 +236,16 @@ def test_claim_skips_ledgered_task_idempotently():
 
 def test_claim_dead_letters_crash_loop():
     """Redelivered past the retry budget with no recorded failure: the
-    worker died mid-compute every time — dead-letter at claim."""
+    worker died mid-compute every time — dead-letter at claim. Crash
+    deliveries are modeled as lease EXPIRY (a dead worker never nacks);
+    a polite nack is a handback and does not burn the budget."""
     q = MemoryQueue("claim-loop", visibility_timeout=100)
     q.send_messages(["0-4_0-4_0-4"])
     sup = LifecycleSupervisor(q, max_retries=2)
     for _ in range(2):  # two crashed deliveries
         handle, body = q.receive()
-        q.nack(handle)  # redeliverable, count retained
+        wire, _deadline = q.invisible[handle]
+        q.invisible[handle] = (wire, 0.0)  # worker died: lease runs out
     handle, body = q.receive()  # third delivery: receives=3 > 2
     assert sup.claim(handle, body) is None
     assert len(q) == 0

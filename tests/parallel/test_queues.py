@@ -136,6 +136,12 @@ class TestMemoryQueueLifecycle:
         q.send_messages(["task"])
         handle, _ = q.receive()
         assert q.receive_count(handle) == 1
+        # a crash-shaped redelivery (lease expiry) burns an attempt...
+        wire, _deadline = q.invisible[handle]
+        q.invisible[handle] = (wire, 0.0)
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 2
+        # ...but a polite nack is a handback and refunds it
         q.nack(handle)
         handle, _ = q.receive()
         assert q.receive_count(handle) == 2

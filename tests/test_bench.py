@@ -51,10 +51,19 @@ def test_pallas_config_fails_loudly_on_cpu(tiny_bench):
         tiny_bench.run_config(cfg)
 
 
+@pytest.mark.bench
+@pytest.mark.slow
 def test_pipeline_overlap_microbench(tmp_path):
     """The double-buffered executor must beat the serial chunk loop on
     the synthetic CPU workload (ISSUE 2 acceptance: >= 1.2x) and stay
     bit-identical — run_pipeline_overlap itself raises on divergence.
+
+    Marked slow/bench (ISSUE 7 satellite): this speedup-RATIO gate is
+    load-sensitive — it flips in full tier-1 runs on the 1-core CI box
+    even at commits where it passes in isolation (verified in PR 6 by
+    stash-and-rerun), so tier-1 (-m 'not slow') no longer reports it as
+    a false regression. Coverage is kept by run_tests.sh, which runs
+    the same workload as a standalone gate after pytest.
 
     Measured in a FRESH SUBPROCESS under the benchmark's actual
     contract (`python bench.py pipeline_overlap` from a shell): inside
@@ -95,11 +104,17 @@ def test_pipeline_overlap_microbench(tmp_path):
     ), best.get("telemetry_jsonl")
 
 
+@pytest.mark.bench
+@pytest.mark.slow
 def test_e2e_overlap_microbench(tmp_path):
     """The adaptive scheduler must beat the serial full-lifecycle loop
     (load → compute → post → write) on the calibrated synthetic CPU
     workload (ISSUE 4 acceptance: >= 1.4x) and stay bit-identical —
     run_e2e_overlap itself raises on divergence or broken task order.
+
+    Marked slow/bench (ISSUE 7 satellite): load-sensitive ratio gate —
+    see test_pipeline_overlap_microbench; run_tests.sh runs the same
+    workload as a standalone gate after pytest.
 
     Fresh-subprocess pattern from the pipeline_overlap gate: inside the
     suite's interpreter the ratio is contaminated by conftest's 8-device

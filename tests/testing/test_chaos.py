@@ -84,6 +84,44 @@ def test_bad_spec_raises():
         chaos.configure("bogus=1")
 
 
+def test_bad_action_raises():
+    with pytest.raises(ValueError, match="bad CHUNKFLOW_CHAOS action"):
+        chaos.configure("once=a/b:action=explode")
+
+
+def test_kill_action_parses_and_defaults_to_raise():
+    chaos.configure("once=a/b:action=kill")
+    assert chaos._current_plan().action == "kill"
+    chaos.configure("once=a/b")
+    assert chaos._current_plan().action == "raise"
+
+
+def test_kill_action_dies_by_sigkill():
+    """``action=kill`` must be TRUE process death: no exception
+    unwinding, no finally blocks — the child is SIGKILLed on the spot
+    (exit by signal 9), and a non-matching point leaves it alive."""
+    import subprocess
+    import sys
+
+    prog = (
+        "from chunkflow_tpu.testing import chaos\n"
+        "chaos.configure('once=op/x:action=kill')\n"
+        "chaos.chaos_point('op/other')\n"  # no match: survives
+        "try:\n"
+        "    chaos.chaos_point('op/x')\n"
+        "finally:\n"
+        "    print('FINALLY RAN')\n"  # must never appear
+        "print('SURVIVED')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        timeout=60,
+    )
+    assert proc.returncode in (-9, 137), (proc.returncode, proc.stderr)
+    assert "FINALLY RAN" not in proc.stdout
+    assert "SURVIVED" not in proc.stdout
+
+
 def test_chaos_error_is_transient():
     from chunkflow_tpu.parallel.lifecycle import classify_error
 
