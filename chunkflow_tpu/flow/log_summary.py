@@ -51,7 +51,8 @@ FLEET_COUNTERS = (
     "fleet/spawns", "fleet/scale_up", "fleet/scale_down",
     "fleet/scale_down_drains", "fleet/evictions", "fleet/worker_deaths",
     "fleet/drill_preemptions", "fleet/probe_failures",
-    "fleet/leases_nacked", "fleet/holds", "fleet/crash_backoffs",
+    "fleet/leases_nacked", "fleet/handles_truncated", "fleet/holds",
+    "fleet/crash_backoffs",
 )
 
 

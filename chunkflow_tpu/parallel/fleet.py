@@ -159,6 +159,7 @@ class WorkerHandle:
         self.exit_code: Optional[int] = None
         self.exited_at: Optional[float] = None
         self.handles: List[str] = []
+        self.handles_truncated = False
         self.inflight_leases = 0
         self.dominant_stall: Optional[dict] = None
         self.drill = False
@@ -367,6 +368,8 @@ class FleetSupervisor:
             worker.misses = 0
             worker.inflight_leases = int(health.get("inflight_leases", 0))
             worker.handles = list(health.get("inflight_handles") or [])
+            worker.handles_truncated = bool(
+                health.get("inflight_handles_truncated"))
             worker.dominant_stall = sample.get("dominant_stall")
             return
         if worker.state == "starting" and \
@@ -459,11 +462,28 @@ class FleetSupervisor:
             # whatever it still held goes back NOW — for an evicted or
             # crashed worker this is the difference between immediate
             # pickup and waiting out the visibility timeout; for a clean
-            # drain the handle list is empty (it nacked on SIGTERM)
+            # drain the worker nacked (with refund) on SIGTERM itself,
+            # so these releases are no-ops and count zero. The receive
+            # count is NOT refunded here (force_release refund=False):
+            # a crash/quarantine delivery must keep counting, or the
+            # lifecycle crash-loop bound could never dead-letter a
+            # poison task that kills every worker it lands on.
             released = self.queue.force_release(worker.handles)
             if released:
                 telemetry.inc("fleet/leases_nacked", released)
+            if worker.handles_truncated:
+                # /healthz capped the handle list: the leases past the
+                # cap were NOT force-nacked and will ride out the full
+                # visibility timeout — surface it instead of silently
+                # breaking the immediate-pickup guarantee
+                telemetry.inc("fleet/handles_truncated")
+                telemetry.event(
+                    "fleet", "fleet/handles_truncated",
+                    fleet_worker=worker.ident, released=released,
+                    inflight_leases=worker.inflight_leases,
+                )
             worker.handles = []
+            worker.handles_truncated = False
             worker.inflight_leases = 0
             telemetry.event(
                 "fleet", "fleet/exit", fleet_worker=worker.ident,
@@ -655,9 +675,29 @@ class FleetSupervisor:
         pending = stats.get("pending")
         inflight = stats.get("inflight")
         if inflight is None:  # backend can't say: use the probed leases
+            if not self.probing:
+                # telemetry off AND a blind backend: claimed-but-unacked
+                # tasks are invisible to us entirely, so pending == 0 is
+                # a guess — run() demands it persist for extra ticks
+                # (_settle_target) instead of assuming zero leases
+                return pending == 0
+            # draining/quarantined workers keep their last probed lease
+            # count until reaped, so sum over every running worker, not
+            # just the active ones
             inflight = sum(w.inflight_leases for w in self.workers
-                           if w.active)
+                           if w.running)
         return pending == 0 and inflight == 0
+
+    def _settle_target(self, stats: dict, settle_ticks: int) -> int:
+        """Consecutive drained ticks required before declaring the
+        queue done. When the backend cannot report inflight and probing
+        is off, in-flight leases are invisible — pending hits 0 the
+        moment the LAST tasks are claimed, not when they finish — so
+        demand a much longer quiet period before SIGTERMing workers
+        that may still be mid-compute."""
+        if stats.get("inflight") is not None or self.probing:
+            return settle_ticks
+        return max(3 * settle_ticks, settle_ticks + 3)
 
     def run(self, max_runtime: float = 3600.0, settle_ticks: int = 2,
             shutdown_on_drain: bool = True) -> dict:
@@ -677,7 +717,7 @@ class FleetSupervisor:
             while not self._stop.is_set() and time.time() < deadline:
                 stats = self.step()
                 settled = settled + 1 if self._drained(stats) else 0
-                if settled >= settle_ticks:
+                if settled >= self._settle_target(stats, settle_ticks):
                     break
                 self._stop.wait(self.interval)
         except BaseException:

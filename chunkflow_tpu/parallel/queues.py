@@ -168,36 +168,50 @@ class QueueBase:
         timeout) from now. The lease heartbeat for in-compute tasks."""
         raise NotImplementedError
 
-    def nack(self, handle: str) -> None:
+    def nack(self, handle: str, refund: bool = True) -> bool:
         """Release the claim immediately: the task becomes visible to
         other workers right away (preemption / fast retry) instead of
-        after the visibility timeout.
+        after the visibility timeout. Returns whether a claim was
+        actually released (False when the handle already expired, was
+        acked, or was janitored back — the work is safe elsewhere).
 
-        A nacked delivery is a *handback*, not a failure: backends
-        that can (memory, file) decrement the receive count so
-        preemption / bystander-surrender / supervisor-force-release
-        hops do not burn the retry budget — under frequent spot
-        preemption a healthy task would otherwise be dead-lettered as
-        a "crash loop" without ever failing. SQS cannot decrement
-        ``ApproximateReceiveCount``; size ``--max-retries`` generously
-        there (the SQS redrive-policy convention)."""
+        With ``refund=True`` (the default, for *first-party* nacks) a
+        nacked delivery is a *handback*, not a failure: backends that
+        can (memory, file) decrement the receive count so preemption /
+        bystander-surrender hops do not burn the retry budget — under
+        frequent spot preemption a healthy task would otherwise be
+        dead-lettered as a "crash loop" without ever failing.
+        ``refund=False`` requeues while *preserving* the count
+        (janitor-style): the third-party release path for workers that
+        died or wedged, whose deliveries must keep counting toward the
+        crash-loop bound. SQS cannot decrement
+        ``ApproximateReceiveCount`` either way; size ``--max-retries``
+        generously there (the SQS redrive-policy convention)."""
         raise NotImplementedError
 
-    def force_release(self, handles) -> int:
+    def force_release(self, handles, refund: bool = False) -> int:
         """Third-party nack: release claims a DEAD worker is still
         holding, by handle, so its tasks reappear now instead of after
         the visibility timeout. The fleet supervisor calls this when it
         evicts or reaps a worker, using the lease handles the worker
-        last reported over ``/healthz`` (parallel/fleet.py). Per-handle
-        errors are swallowed — a handle may have expired, been janitored
-        back, or belong to a re-claimed task, all of which mean the work
-        is already safe. Returns how many releases were attempted
-        without error."""
+        last reported over ``/healthz`` (parallel/fleet.py).
+
+        ``refund`` defaults to False: an unexpected or quarantined exit
+        is a crash-shaped delivery, and refunding its receive count
+        would make the crash-loop bound (lifecycle: ``receives >
+        max_retries``) unreachable — a poison task that kills every
+        worker it lands on would be redelivered forever. Keep the
+        refund for first-party preemption/surrender nacks only.
+
+        Per-handle errors are swallowed — a handle may have expired,
+        been janitored back, or belong to a re-claimed task, all of
+        which mean the work is already safe. Returns how many claims
+        were actually released (no-op nacks are not counted)."""
         released = 0
         for handle in handles or ():
             try:
-                self.nack(handle)
-                released += 1
+                if self.nack(handle, refund=refund):
+                    released += 1
             except Exception:
                 continue
         return released
@@ -306,14 +320,19 @@ class MemoryQueue(QueueBase):
         timeout = self.visibility_timeout if timeout is None else timeout
         self.invisible[handle] = (entry[0], time.time() + timeout)
 
-    def nack(self, handle: str) -> None:
+    def nack(self, handle: str, refund: bool = True) -> bool:
         entry = self.invisible.pop(handle, None)
-        if entry is not None:
-            self.pending[handle] = entry[0]
-            # a handback is not a failed attempt (see QueueBase.nack)
+        if entry is None:
+            return False  # already acked or expired: nothing to release
+        self.pending[handle] = entry[0]
+        if refund:
+            # a first-party handback is not a failed attempt (see
+            # QueueBase.nack); third-party force_release preserves the
+            # count so crash deliveries accrue
             count = self.receives.get(handle, 0)
             if count > 0:
                 self.receives[handle] = count - 1
+        return True
 
     def receive_count(self, handle: str) -> int:
         return self.receives.get(handle, 0)
@@ -397,27 +416,41 @@ class FileQueue(QueueBase):
                     os.rename(path, os.path.join(self.pending_dir, name))
             except OSError:
                 pass  # another janitor/worker won the race
-        # a sender that crashed mid-send_messages leaves .tmp-* staging
-        # files in the queue root forever; sweep the stale ones (older
-        # than the visibility timeout, so an in-progress send is safe)
-        for name in os.listdir(self.dir):
-            if not name.startswith(".tmp-"):
-                continue
-            path = os.path.join(self.dir, name)
+        # a writer that crashed mid-stage leaves .tmp-* files behind
+        # forever (queue root: send_messages; counts dir: _write_count);
+        # sweep the stale ones (older than the visibility timeout, so
+        # an in-progress write is safe)
+        for d in (self.dir, self.counts_dir):
+            for name in os.listdir(d):
+                if not name.startswith(".tmp-"):
+                    continue
+                path = os.path.join(d, name)
+                try:
+                    if now - os.path.getmtime(path) > self.visibility_timeout:
+                        os.remove(path)
+                except OSError:
+                    pass
+
+    def _write_count(self, name: str, count: int) -> bool:
+        """Atomically (re)write a delivery-count sidecar — staged to a
+        temp file then renamed, so a concurrent reader never sees a
+        half-written (empty) count."""
+        tmp = os.path.join(self.counts_dir, f".tmp-{uuid.uuid4().hex}")
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(count))
+            os.rename(tmp, os.path.join(self.counts_dir, name))
+        except OSError:
             try:
-                if now - os.path.getmtime(path) > self.visibility_timeout:
-                    os.remove(path)
+                os.remove(tmp)
             except OSError:
                 pass
+            return False
+        return True
 
     def _bump_count(self, name: str) -> int:
-        path = os.path.join(self.counts_dir, name)
         count = self._read_count(name) + 1
-        try:
-            with open(path, "w") as f:
-                f.write(str(count))
-        except OSError:
-            pass
+        self._write_count(name, count)
         return count
 
     def _read_count(self, name: str) -> int:
@@ -464,22 +497,29 @@ class FileQueue(QueueBase):
         except OSError:
             pass  # expired and re-claimed elsewhere: lease is lost
 
-    def nack(self, handle: str) -> None:
+    def nack(self, handle: str, refund: bool = True) -> bool:
+        # a first-party handback is not a failed attempt (see
+        # QueueBase.nack); janitor requeues after a CRASH never pass
+        # here, and third-party force_release passes refund=False, so
+        # crash deliveries keep counting toward the crash-loop bound.
+        # The refund lands BEFORE the rename makes the task visible
+        # again: while the claim file exists no other worker can
+        # re-claim and bump, so this read-modify-write cannot overwrite
+        # a newer delivery's count (decrement-after-rename raced
+        # exactly that way).
+        refunded = False
+        if refund:
+            count = self._read_count(handle)
+            if count > 0:
+                refunded = self._write_count(handle, count - 1)
         try:
             os.rename(os.path.join(self.claimed_dir, handle),
                       os.path.join(self.pending_dir, handle))
         except OSError:
-            return  # the janitor beat us to it: the count stands
-        # a handback is not a failed attempt (see QueueBase.nack);
-        # janitor requeues after a CRASH never pass here, so crash
-        # deliveries keep counting toward the crash-loop bound
-        count = self._read_count(handle)
-        if count > 0:
-            try:
-                with open(os.path.join(self.counts_dir, handle), "w") as f:
-                    f.write(str(count - 1))
-            except OSError:
-                pass
+            if refunded:  # the janitor beat us to it: the count stands
+                self._bump_count(handle)
+            return False
+        return True
 
     def receive_count(self, handle: str) -> int:
         return self._read_count(handle)
@@ -532,6 +572,8 @@ class FileQueue(QueueBase):
         self._requeue_expired()
         receives = 0
         for name in os.listdir(self.counts_dir):
+            if name.startswith(".tmp-"):  # a writer died mid-stage
+                continue
             receives += self._read_count(name)
         return {
             "pending": len(os.listdir(self.pending_dir)),
@@ -653,8 +695,11 @@ class SQSQueue(QueueBase):
             VisibilityTimeout=int(timeout),
         )
 
-    def nack(self, handle: str) -> None:
+    def nack(self, handle: str, refund: bool = True) -> bool:
+        # SQS cannot decrement ApproximateReceiveCount: `refund` is
+        # accepted for protocol compatibility but has no effect
         self.renew(handle, 0)
+        return True
 
     def receive_count(self, handle: str) -> int:
         return self._receive_counts.get(handle, 0)

@@ -149,12 +149,17 @@ def worker_health() -> dict:
     from chunkflow_tpu.parallel import lifecycle
 
     leases = lifecycle.inflight()
+    handles = [lc.handle for lc in leases[:64]]
     return {
         "status": "ok",
         "worker": telemetry.worker_id(),
         "pid": os.getpid(),
         "inflight_leases": len(leases),
-        "inflight_handles": [lc.handle for lc in leases[:64]],
+        "inflight_handles": handles,
+        # the cap keeps the payload bounded at huge --async-depth; when
+        # it bites, the supervisor must know the excess leases will
+        # ride out the visibility timeout instead of being force-nacked
+        "inflight_handles_truncated": len(leases) > len(handles),
         "uptime_s": time.time() - _STARTED,
         "telemetry_enabled": telemetry.enabled(),
         "metrics_path": telemetry.configured_path(),

@@ -96,8 +96,9 @@ class ScriptedQueue(QueueBase):
         self.i += 1
         return dict(stats)
 
-    def nack(self, handle):
+    def nack(self, handle, refund=True):
         self.nacked.append(handle)
+        return True
 
 
 def make_supervisor(tmp_path, script=None, *, procs=None, scrape=None,
@@ -283,6 +284,82 @@ def test_probe_misses_quarantine_and_force_nack(tmp_path):
     assert counters["fleet/probe_failures"] >= 2
     assert counters["fleet/leases_nacked"] == 2
     assert sum(1 for w in sup.workers if w.active) == 1  # replaced
+    # the force-release preserved the receive count (crash-shaped
+    # handback, no refund): the next claim is delivery #2, so a task
+    # that wedges every worker it lands on still walks into the
+    # lifecycle crash-loop bound instead of cycling forever
+    h, _ = queue.receive()
+    assert queue.receive_count(h) == 2
+
+
+def test_reap_flags_truncated_handle_list(tmp_path):
+    """/healthz caps inflight_handles; when the cap bit, the leases
+    past it were NOT force-nacked and ride out the visibility timeout
+    — the supervisor must say so instead of silently breaking the
+    immediate-pickup guarantee."""
+    MemoryQueue._registry.pop("fleet-trunc", None)
+    queue = MemoryQueue.open("fleet-trunc", visibility_timeout=600)
+    queue.send_messages(["t1"])
+    h1, _ = queue.receive()
+
+    def scrape(endpoint):
+        return {"endpoint": endpoint,
+                "healthz": {"inflight_leases": 65,
+                            "inflight_handles": [h1],
+                            "inflight_handles_truncated": True},
+                "metrics": {}, "dominant_stall": None, "error": None}
+
+    sup = make_supervisor(tmp_path, [IDLE], scrape=scrape)
+    sup.queue = queue
+    sup.step()  # spawn
+    sup.step()  # probe: truncated handle list recorded
+    assert sup.workers[0].handles_truncated
+    sup.workers[0].proc.kill()  # unexpected death
+    sup.step()  # reap: force-nack what we know, flag the rest
+    counters = telemetry.snapshot()["counters"]
+    assert counters["fleet/leases_nacked"] == 1
+    assert counters["fleet/handles_truncated"] == 1
+    events = [e for e in _fleet_events(sup)
+              if e["name"] == "fleet/handles_truncated"]
+    assert events and events[0]["released"] == 1
+
+
+def test_blind_drain_requires_longer_settle(tmp_path):
+    """With telemetry off AND a backend that cannot report inflight,
+    claimed-but-unacked tasks are invisible: the fleet must not declare
+    the queue drained (and SIGTERM workers mid-compute) on the normal
+    settle budget."""
+    sup = make_supervisor(tmp_path, [IDLE])
+    blind = {"pending": 0, "inflight": None, "dead": None,
+             "receives": None}
+    # sighted (backend reports inflight, or probing fills the gap):
+    # the caller's settle_ticks stand
+    assert sup._settle_target(IDLE, 2) == 2
+    sup.probing = True
+    assert sup._settle_target(blind, 2) == 2
+    assert sup._drained(blind)  # probed leases say zero
+    # blind: pending==0 alone is a guess — demand a longer quiet period
+    sup.probing = False
+    assert sup._drained(blind)
+    assert sup._settle_target(blind, 2) > 2
+
+
+def test_drained_counts_draining_workers_leases(tmp_path):
+    """A draining worker still holds its last probed leases until it
+    is reaped — _drained must not ignore them just because the worker
+    no longer counts toward capacity."""
+    sup = make_supervisor(tmp_path, [IDLE])
+    sup.probing = True
+    sup.step()  # spawn
+    sup.step()  # probe live
+    worker = sup.workers[0]
+    worker.inflight_leases = 1
+    worker.state = "draining"
+    blind = {"pending": 0, "inflight": None, "dead": None,
+             "receives": None}
+    assert not sup._drained(blind)
+    worker.inflight_leases = 0
+    assert sup._drained(blind)
 
 
 def test_crash_loop_backs_off_respawns(tmp_path):

@@ -148,6 +148,33 @@ class TestMemoryQueueLifecycle:
         q.delete(handle)
         assert q.receive_count(handle) == 0  # budget cleared with the ack
 
+    def test_force_release_preserves_receive_count(self):
+        """Supervisor force-release of a dead worker's lease is a
+        crash-shaped handback: the receive count must keep accruing so
+        a poison task that kills every worker still walks into the
+        crash-loop bound instead of being redelivered forever."""
+        q = MemoryQueue("force", visibility_timeout=100)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 1
+        assert q.force_release([handle]) == 1
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 2  # delivery accrued
+        # the first-party refund path still exists for preemption
+        assert q.nack(handle, refund=True) is True
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 2
+
+    def test_force_release_counts_only_real_releases(self):
+        """A nack on an already-acked/expired handle is a no-op and
+        must not inflate the released count (fleet/leases_nacked)."""
+        q = MemoryQueue("force-noop", visibility_timeout=100)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        q.delete(handle)
+        assert q.nack(handle) is False
+        assert q.force_release([handle, "ghost"]) == 0
+
     def test_dead_letter_and_requeue(self):
         q = MemoryQueue("dead", visibility_timeout=100)
         q.send_messages(["poison"])
@@ -185,6 +212,50 @@ class TestFileQueueLifecycle:
         q.nack(handle)
         assert len(q) == 1
         assert q.receive()[1] == "task"
+
+    def test_force_release_preserves_receive_count(self, tmp_path):
+        """Same crash-loop substrate as the memory backend: a
+        third-party release keeps the sidecar count."""
+        q = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 1
+        assert q.force_release([handle]) == 1
+        handle, _ = q.receive()
+        assert q.receive_count(handle) == 2
+
+    def test_nack_refund_lands_before_release(self, tmp_path,
+                                              monkeypatch):
+        """The refund is written while the claim file still exists, so
+        no other worker can re-claim (and bump) mid-decrement — the
+        old decrement-after-rename overwrote a new delivery's count
+        with the stale value, silently erasing retry-budget burns."""
+        q = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        seen = {}
+        real_rename = os.rename
+
+        def spy(src, dst):
+            if (os.path.dirname(src) == q.claimed_dir
+                    and os.path.basename(src) == handle):
+                seen["count_at_release"] = q._read_count(handle)
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", spy)
+        assert q.nack(handle) is True
+        assert seen["count_at_release"] == 0  # refunded pre-visibility
+
+    def test_nack_on_lost_claim_rolls_refund_back(self, tmp_path):
+        """When the janitor (or an ack elsewhere) already took the
+        claim, the handback never happened: nack reports False and the
+        pre-applied refund is restored."""
+        q = FileQueue(str(tmp_path / "q"), visibility_timeout=100)
+        q.send_messages(["task"])
+        handle, _ = q.receive()
+        os.remove(os.path.join(q.claimed_dir, handle))  # claim lost
+        assert q.nack(handle) is False
+        assert q._read_count(handle) == 1  # the count stands
 
     def test_receive_count_survives_crash_requeue(self, tmp_path):
         """The sidecar count survives a janitor requeue, so retry

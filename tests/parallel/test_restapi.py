@@ -165,6 +165,30 @@ def test_metrics_and_healthz_roundtrip(clean_telemetry):
         server.server_close()
 
 
+def test_worker_health_marks_truncated_handle_list(monkeypatch):
+    """Past the 64-handle cap, /healthz must say the list is truncated
+    so the fleet supervisor knows the excess leases will ride out the
+    visibility timeout instead of being force-nacked."""
+    from chunkflow_tpu.parallel import lifecycle, restapi
+
+    class FakeLease:
+        def __init__(self, i):
+            self.handle = f"h{i}"
+
+    monkeypatch.setattr(
+        lifecycle, "inflight", lambda: [FakeLease(i) for i in range(70)])
+    health = restapi.worker_health()
+    assert health["inflight_leases"] == 70
+    assert len(health["inflight_handles"]) == 64
+    assert health["inflight_handles_truncated"] is True
+
+    monkeypatch.setattr(
+        lifecycle, "inflight", lambda: [FakeLease(0)])
+    health = restapi.worker_health()
+    assert health["inflight_handles"] == ["h0"]
+    assert health["inflight_handles_truncated"] is False
+
+
 def test_kill_switch_creates_no_listener(monkeypatch):
     """CHUNKFLOW_TELEMETRY=0 means no socket at all — the same
     creates-nothing discipline as the JSONL sink."""
