@@ -360,6 +360,244 @@ def _bench_metrics_dir() -> str:
     )
 
 
+# ---------------------------------------------------------------------------
+# bench regression ledger (ISSUE 8): every gate measurement appended as one
+# JSONL row stamped with the commit it measured, so `bench.py compare` can
+# diff a fresh run against the rolling median of PRIOR FRESH rows — and
+# loudly refuse cached: rows (a tunnel-down fallback measuring OLD code,
+# like the stale 1.79 Mvox/s/chip headline) as a baseline.
+# ---------------------------------------------------------------------------
+_LEDGER_FILE: "str | None" = None  # set by --ledger[=PATH] / env
+
+
+def _default_ledger_path() -> str:
+    return os.environ.get(
+        "CHUNKFLOW_BENCH_LEDGER",
+        os.path.join(_bench_metrics_dir(), "bench_ledger.jsonl"),
+    )
+
+
+def _git_commit() -> str:
+    """Short commit hash of the measured tree, best-effort: a ledger row
+    that cannot say what code it measured must say so explicitly."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=_HERE, capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return "unknown"
+    if proc.returncode != 0:
+        return "unknown"
+    return proc.stdout.strip() or "unknown"
+
+
+def _append_ledger(payload: dict) -> None:
+    """Append one measurement row to the ledger (active only under
+    --ledger). Cached fallbacks are stamped ``cached: true`` AND keep
+    the commit the cached number was measured at — compare refuses them
+    as baselines either way."""
+    if _LEDGER_FILE is None:
+        return
+    if not isinstance(payload.get("metric"), str) \
+            or not isinstance(payload.get("value"), (int, float)):
+        return
+    cached = bool(payload.get("cached"))
+    row = {
+        "t": time.time(),
+        "commit": (payload.get("measured_at_commit") if cached
+                   else _git_commit()),
+        "metric": payload["metric"],
+        "value": payload["value"],
+        "unit": payload.get("unit"),
+        "config": payload.get("config"),
+        "cached": cached,
+    }
+    if payload.get("gate_pass") is not None:
+        row["gate_pass"] = payload["gate_pass"]
+    try:
+        os.makedirs(os.path.dirname(_LEDGER_FILE), exist_ok=True)
+        with open(_LEDGER_FILE, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError as e:
+        print(f"bench ledger unwritable ({_LEDGER_FILE}): {e}",
+              file=sys.stderr)
+
+
+def load_ledger(path: str) -> list:
+    """Parse a bench ledger; torn trailing lines are skipped."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and isinstance(
+                        row.get("metric"), str):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def _median(values: list) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    mid = n // 2
+    return (ordered[mid] if n % 2
+            else (ordered[mid - 1] + ordered[mid]) / 2.0)
+
+
+def compare_ledger(rows: list, threshold_pct: float = 25.0) -> dict:
+    """Diff the newest row of every metric against the rolling median of
+    its PRIOR FRESH rows.
+
+    Rules of evidence:
+
+    * ``cached: true`` rows never enter a baseline — a cached number is
+      a tunnel-down fallback measuring whatever commit the chip last
+      saw, and comparing fresh code against it is exactly the stale-
+      headline trap this ledger exists to kill. Refusals are loud
+      (listed per metric in ``refused``).
+    * A cached CURRENT row is not a measurement of this commit at all:
+      reported as ``status: cached-current``, never compared.
+    * Hard regressions (``regressions``) need fresh-vs-fresh evidence:
+      a fresh current row, >= 2 prior fresh rows, and a drop past
+      ``threshold_pct`` on a higher-is-better metric. Percentage-unit
+      metrics (overhead gates) are warn-only — on a loaded 1-core box
+      their relative deltas are noise-dominated.
+    """
+    by_metric: dict = {}
+    for row in rows:
+        by_metric.setdefault(row["metric"], []).append(row)
+    report = {"metrics": {}, "regressions": [], "warnings": []}
+    for metric, series in sorted(by_metric.items()):
+        current = series[-1]
+        prior = series[:-1]
+        refused = [r for r in prior if r.get("cached")]
+        prior_fresh = [
+            r for r in prior
+            if not r.get("cached")
+            and isinstance(r.get("value"), (int, float))
+        ]
+        info = {
+            "current": current,
+            "prior_fresh": len(prior_fresh),
+            "refused_cached": len(refused),
+            "baseline": None,
+            "delta_pct": None,
+            "status": "ok",
+        }
+        report["metrics"][metric] = info
+        if current.get("cached"):
+            info["status"] = "cached-current"
+            report["warnings"].append(
+                f"{metric}: current row is cached "
+                f"({current.get('config')}) — a fallback measuring "
+                f"commit {current.get('commit') or 'unknown'}, not this "
+                f"tree; re-measure fresh before reading it as a result"
+            )
+            continue
+        if not prior_fresh:
+            info["status"] = "no-baseline"
+            if refused:
+                report["warnings"].append(
+                    f"{metric}: REFUSING {len(refused)} cached row(s) as "
+                    f"baseline (cached numbers measure old code); no "
+                    f"fresh baseline yet"
+                )
+            continue
+        baseline = _median([r["value"] for r in prior_fresh])
+        info["baseline"] = baseline
+        if refused:
+            report["warnings"].append(
+                f"{metric}: REFUSING {len(refused)} cached row(s) as "
+                f"baseline; using the {len(prior_fresh)} fresh row(s)"
+            )
+        unit = str(current.get("unit") or "")
+        lower_better = "pct" in unit
+        if baseline == 0:
+            info["status"] = "no-baseline"
+            continue
+        if lower_better:
+            delta = (current["value"] - baseline) / abs(baseline) * 100.0
+        else:
+            delta = (baseline - current["value"]) / abs(baseline) * 100.0
+        info["delta_pct"] = round(delta, 2)
+        if delta <= threshold_pct:
+            continue
+        if lower_better:
+            info["status"] = "warn"
+            report["warnings"].append(
+                f"{metric}: {current['value']:g} vs fresh median "
+                f"{baseline:g} (+{delta:.0f}% overhead; warn-only — "
+                f"percentage gates are load-sensitive)"
+            )
+        elif len(prior_fresh) >= 2:
+            info["status"] = "regression"
+            report["regressions"].append(
+                f"{metric}: {current['value']:g} vs fresh median "
+                f"{baseline:g} (-{delta:.0f}%, threshold "
+                f"{threshold_pct:g}%, {len(prior_fresh)} fresh "
+                f"baseline rows)"
+            )
+        else:
+            info["status"] = "warn"
+            report["warnings"].append(
+                f"{metric}: {current['value']:g} vs single fresh row "
+                f"{baseline:g} (-{delta:.0f}%; need >= 2 fresh rows "
+                f"for a hard verdict)"
+            )
+    return report
+
+
+def compare_main(argv: list) -> int:
+    """``bench.py compare [--ledger=PATH] [--threshold PCT]``: rc 0 on
+    ok/warnings, 4 on a fresh-vs-fresh regression past the threshold."""
+    path = _default_ledger_path()
+    threshold = 25.0
+    it = iter(argv)
+    for arg in it:
+        if arg.startswith("--ledger="):
+            path = arg.split("=", 1)[1]
+        elif arg == "--ledger":
+            path = next(it, path)
+        elif arg.startswith("--threshold="):
+            threshold = float(arg.split("=", 1)[1])
+        elif arg == "--threshold":
+            threshold = float(next(it, threshold))
+    rows = load_ledger(path)
+    if not rows:
+        print(f"bench compare: no ledger rows at {path} (run the gates "
+              f"with --ledger first)")
+        return 0
+    report = compare_ledger(rows, threshold_pct=threshold)
+    print(f"bench compare: {len(rows)} row(s) from {path} "
+          f"(threshold {threshold:g}%)")
+    for metric, info in report["metrics"].items():
+        cur = info["current"]
+        line = (f"  {metric:<32} {cur.get('value'):>8g} "
+                f"[{cur.get('commit') or '?'}]")
+        if info["baseline"] is not None:
+            line += f" vs median {info['baseline']:g}"
+        if info["delta_pct"] is not None:
+            line += f" ({info['delta_pct']:+g}% worse)" \
+                if info["delta_pct"] > 0 \
+                else f" ({-info['delta_pct']:+g}% better)"
+        line += f" {info['status']}"
+        print(line)
+    for warning in report["warnings"]:
+        print(f"  WARN {warning}")
+    for regression in report["regressions"]:
+        print(f"  REGRESSION {regression}")
+    return 4 if report["regressions"] else 0
+
+
 def run_telemetry_overhead(
     n_chunks: int = 6,
     chunk_size=(64, 256, 256),
@@ -1304,6 +1542,7 @@ def _probe_backend(timeout_s: float):
 
 def _emit(payload: dict) -> int:
     print(json.dumps(payload))
+    _append_ledger(payload)
     return 0
 
 
@@ -1391,6 +1630,21 @@ def parent_main() -> int:
 
 
 def main() -> int:
+    global _LEDGER_FILE
+    argv = list(sys.argv[1:])
+    if argv and argv[0] == "compare":
+        return compare_main(argv[1:])  # reads the ledger, never appends
+    # --ledger[=PATH]: append every emitted measurement to the bench
+    # regression ledger (CHUNKFLOW_BENCH_LEDGER env enables it too and
+    # sets the path); consumed here so subcommand dispatch stays simple
+    for arg in [a for a in argv if a == "--ledger"
+                or a.startswith("--ledger=")]:
+        _LEDGER_FILE = (arg.split("=", 1)[1] if "=" in arg
+                        else _default_ledger_path())
+        argv.remove(arg)
+    if _LEDGER_FILE is None and os.environ.get("CHUNKFLOW_BENCH_LEDGER"):
+        _LEDGER_FILE = _default_ledger_path()
+    sys.argv = [sys.argv[0]] + argv
     if len(sys.argv) > 1 and sys.argv[1] in (
         "pipeline_overlap", "telemetry_overhead", "e2e_overlap",
         "resilience_overhead", "export_overhead", "fleet_smoke",

@@ -35,7 +35,7 @@ import threading
 import warnings
 from typing import Callable, Hashable, Optional
 
-from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core import profiling, telemetry
 
 # Donation is best-effort by design: a chunk buffer that cannot alias the
 # program's output is simply dropped, and the warning would otherwise fire
@@ -168,8 +168,16 @@ class ProgramCache:
             return hit
         # build outside the lock: builders jit-trace, which can re-enter
         # (a fold program build may consult the same Inferencer)
-        with telemetry.span("compile_cache/build", label=self.label):
+        with telemetry.span("compile_cache/build", label=self.label) as sp:
             program = build()
+        # cost ledger (core/profiling.py): the wrapper times the first
+        # invocation — the one that pays trace + XLA compile — and
+        # captures the program's XLA cost analysis; a no-op passthrough
+        # under CHUNKFLOW_TELEMETRY=0 or for non-jit cache entries
+        program = profiling.instrument_program(
+            program, key, label=self.label,
+            build_s=getattr(sp, "duration", 0.0),
+        )
         raced = False
         with self._lock:
             if key not in self._entries:
@@ -197,6 +205,10 @@ class ProgramCache:
             return
         self._warned = True
         telemetry.inc("compile_cache/retrace_warnings")
+        # a retrace-per-chunk in flight is the highest-value moment for
+        # device evidence: one bounded profiler window (cooldown-gated,
+        # core/profiling.py) captures what the extra compiles cost
+        profiling.note_retrace(self.label)
         warnings.warn(
             f"ProgramCache[{self.label}]: {self.builds} program builds "
             f"exceed the expected bucket count "

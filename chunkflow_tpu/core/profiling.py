@@ -1,0 +1,665 @@
+"""Device-performance plane: program cost ledger, roofline accounting,
+and bounded profiler capture.
+
+The host-side observability stack (core/telemetry.py, PR 3/6) attributes
+*wall-clock*; this module attributes the *device*. Three instruments,
+all riding the telemetry registry and its kill switch
+(``CHUNKFLOW_TELEMETRY=0`` ⇒ no ledger, no files, no capture threads,
+no ``/profile`` route — nothing):
+
+1. **Program cost ledger.** Every :class:`~chunkflow_tpu.core.
+   compile_cache.ProgramCache` build passes through
+   :func:`instrument_program`: the jit program is wrapped so its FIRST
+   invocation (the one that pays trace + XLA compile) is timed as
+   ``compile_s``, and the lowered computation's XLA
+   ``cost_analysis()`` — FLOPs and bytes accessed — is captured
+   best-effort *without compiling twice* (``Lowered.cost_analysis``
+   runs on the unoptimized HLO). Results land in ``program/*``
+   counters, one ``compile``-kind telemetry event per program, and a
+   per-run ``programs.json`` catalog written at flush time.
+
+2. **Roofline accounting.** At catalog time each program's cost is
+   scored against a small peak-FLOPs/HBM-bandwidth table keyed on
+   ``jax.devices()[0].device_kind`` (env-overridable via
+   ``CHUNKFLOW_PEAK_FLOPS`` / ``CHUNKFLOW_PEAK_BW``; a conservative CPU
+   fallback keeps the math defined on the test mesh):
+   ``roofline_s = max(flops/peak_flops, bytes/peak_bw)`` and
+   ``roofline_util = roofline_s / exec_s``. ``exec_s`` is the mean
+   post-compile *dispatch wall* — under async dispatch that is a lower
+   bound on device time, so the utilisation figure is an upper bound;
+   it answers "which program family is worth a kernel" (the Pallas
+   blend / multi-chip question), not "publishable MXU utilisation"
+   (that stays tools/tpu_validation.py's ``profile_flagship``).
+
+3. **Bounded profiler capture.** The whole-run ``--profile-dir`` trace
+   is replaced by a task window (:func:`start_task_window`: first N
+   tasks, ``CHUNKFLOW_PROFILE_TASKS`` default 4), and two *automatic*
+   triggers capture one bounded ``jax.profiler`` window each — the
+   retrace watchdog firing (:func:`note_retrace`) and a dominant stall
+   share holding above ``CHUNKFLOW_PROFILE_STALL_SHARE`` for
+   ``CHUNKFLOW_PROFILE_STALL_TICKS`` controller intervals
+   (:func:`note_stall`) — with a cooldown
+   (``CHUNKFLOW_PROFILE_COOLDOWN``, default 300 s) so an anomaly storm
+   cannot fill the disk with traces. A fleet operator can also demand a
+   window from a live worker: ``POST /profile?seconds=N``
+   (parallel/restapi.py). Captures land under the metrics dir
+   (``profile-<reason>-<n>/``) and are summarised offline by
+   ``tools/analyze_trace.py`` through ``log-summary``.
+
+Design rules inherited from core/telemetry.py: never inside jit
+(GL007 — every clock here wraps the program from the host side), zero
+when off, zero dependencies beyond jax itself (imported lazily, only
+on paths that already run jax programs).
+
+See docs/observability.md "Device program view".
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional, Tuple
+
+from chunkflow_tpu.core import telemetry
+
+__all__ = [
+    "instrument_program", "catalog", "write_catalog", "device_peaks",
+    "capture", "maybe_capture", "note_retrace", "note_stall",
+    "start_task_window", "note_task_done", "wait_for_captures",
+    "capture_base_dir",
+]
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+# ---------------------------------------------------------------------------
+# roofline peak table
+# ---------------------------------------------------------------------------
+#: (device_kind substring, (peak FLOP/s, peak HBM bytes/s)) — matched
+#: case-insensitively, first hit wins, most specific first. Values are
+#: published bf16 peaks per chip (the inference dtype of record); the
+#: ``cpu`` row is a deliberately conservative host fallback so the
+#: roofline math stays defined on the CI mesh (override with
+#: CHUNKFLOW_PEAK_FLOPS / CHUNKFLOW_PEAK_BW for a calibrated host).
+DEVICE_PEAKS = (
+    ("tpu v6", (918e12, 1640e9)),   # Trillium
+    ("tpu v5p", (459e12, 2765e9)),
+    ("tpu v5 lite", (197e12, 819e9)),
+    ("tpu v5e", (197e12, 819e9)),
+    ("tpu v4", (275e12, 1228e9)),
+    ("tpu v3", (123e12, 900e9)),
+    ("cpu", (1e11, 5e10)),
+)
+
+_CPU_FALLBACK = (1e11, 5e10)
+
+
+def device_peaks(device_kind: str) -> dict:
+    """Peak FLOP/s + bytes/s for a device kind: env overrides first
+    (``CHUNKFLOW_PEAK_FLOPS`` / ``CHUNKFLOW_PEAK_BW``), then the
+    substring table, then the CPU fallback. ``source`` says which."""
+    env_flops = _env_float("CHUNKFLOW_PEAK_FLOPS", 0.0)
+    env_bw = _env_float("CHUNKFLOW_PEAK_BW", 0.0)
+    kind = (device_kind or "").lower()
+    flops, bw, source = None, None, "fallback"
+    for needle, (f, b) in DEVICE_PEAKS:
+        if needle in kind:
+            flops, bw, source = f, b, f"table:{needle}"
+            break
+    if flops is None:
+        flops, bw = _CPU_FALLBACK
+    if env_flops > 0:
+        flops, source = env_flops, "env"
+    if env_bw > 0:
+        bw, source = env_bw, "env"
+    return {"flops_per_s": flops, "bytes_per_s": bw, "source": source}
+
+
+# ---------------------------------------------------------------------------
+# program cost ledger
+# ---------------------------------------------------------------------------
+class _ProgramRecord:
+    """One ProgramCache build's cost story. ``compile_s`` is None until
+    the program's first invocation pays trace + XLA compile."""
+
+    __slots__ = (
+        "family", "key", "label", "build_s", "compile_s", "flops",
+        "bytes_accessed", "optimal_s", "calls", "dispatch_s",
+        "platform", "device_kind", "lock",
+    )
+
+    def __init__(self, family: str, key: str, label: str, build_s: float):
+        self.family = family
+        self.key = key
+        self.label = label
+        self.build_s = build_s
+        self.compile_s: Optional[float] = None
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.optimal_s: Optional[float] = None
+        self.calls = 0
+        self.dispatch_s = 0.0  # post-compile dispatch wall, cumulative
+        self.platform = ""
+        self.device_kind = ""
+        self.lock = threading.Lock()
+
+
+_LEDGER_LOCK = threading.Lock()
+_LEDGER: dict = {}  # (family, key) -> _ProgramRecord
+
+
+def _device_identity() -> Tuple[str, str]:
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return dev.platform, dev.device_kind
+    except Exception:
+        return "unknown", "unknown"
+
+
+def _cost_analysis(program, args, kwargs) -> dict:
+    """Best-effort XLA cost analysis of the program at these argument
+    shapes, via ``Lowered.cost_analysis()`` (no second compile). Returns
+    {} when the backend / program doesn't expose it."""
+    try:
+        cost = program.lower(*args, **kwargs).cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    return cost if isinstance(cost, dict) else {}
+
+
+class _InstrumentedProgram:
+    """Transparent wrapper around one cached jit program: first call
+    timed as compile, later calls accumulate dispatch wall; attribute
+    access (``lower``, ``_cache_size``, ...) forwards to the program."""
+
+    __slots__ = ("_fn", "_rec")
+
+    def __init__(self, fn, rec: _ProgramRecord):
+        self._fn = fn
+        self._rec = rec
+
+    def __call__(self, *args, **kwargs):
+        rec = self._rec
+        if rec.compile_s is None:
+            return self._first_call(args, kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        with rec.lock:
+            rec.calls += 1
+            rec.dispatch_s += dt
+        return out
+
+    def _first_call(self, args, kwargs):
+        rec = self._rec
+        # cost analysis BEFORE dispatch: afterwards a donated input
+        # buffer is dead, and lowering only needs shapes anyway
+        cost = _cost_analysis(self._fn, args, kwargs)
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        first = False
+        with rec.lock:
+            if rec.compile_s is None:
+                first = True
+                rec.compile_s = dt
+                rec.platform, rec.device_kind = _device_identity()
+                flops = cost.get("flops")
+                nbytes = cost.get("bytes accessed")
+                optimal = cost.get("optimal_seconds")
+                rec.flops = float(flops) if flops is not None else None
+                rec.bytes_accessed = (
+                    float(nbytes) if nbytes is not None else None
+                )
+                rec.optimal_s = (
+                    float(optimal) if optimal is not None else None
+                )
+            else:  # raced: the other thread's call was the compile
+                rec.calls += 1
+                rec.dispatch_s += dt
+        if first:
+            telemetry.inc("program/builds")
+            telemetry.inc("program/compile_seconds", dt)
+            if rec.flops:
+                telemetry.inc("program/flops_total", rec.flops)
+            if rec.bytes_accessed:
+                telemetry.inc("program/bytes_total", rec.bytes_accessed)
+            telemetry.event(
+                "compile", f"program/{rec.family}",
+                family=rec.family, key=rec.key, label=rec.label,
+                build_s=round(rec.build_s, 4),
+                compile_s=round(dt, 4),
+                flops=rec.flops, bytes_accessed=rec.bytes_accessed,
+                device=rec.device_kind, platform=rec.platform,
+            )
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def _family_of(key, label: str) -> Tuple[str, str]:
+    """(family, shape-ish remainder) from a ProgramCache key. Keys are
+    tuples like ``("scatter",)`` / ``("fold", (8, 32, 32))``; anything
+    else falls back to the cache label."""
+    if isinstance(key, tuple) and key:
+        family = str(key[0])
+        rest = ",".join(str(part) for part in key[1:])
+    else:
+        family = label or str(key)
+        rest = "" if isinstance(key, tuple) else str(key)
+    return family, rest
+
+
+def instrument_program(program, key, label: str = "",
+                       build_s: float = 0.0):
+    """Wrap a freshly built cached program into the cost ledger; returns
+    the program untouched when telemetry is off (kill switch: the plane
+    does not exist) or when the object is not a lowerable jit program
+    (tests cache plain sentinels)."""
+    if not telemetry.enabled():
+        return program
+    if not callable(program) or not hasattr(program, "lower"):
+        return program
+    family, rest = _family_of(key, label)
+    rec = _ProgramRecord(family=family, key=rest, label=label,
+                         build_s=build_s)
+    with _LEDGER_LOCK:
+        _LEDGER[(family, rest, id(rec))] = rec
+    return _InstrumentedProgram(program, rec)
+
+
+def catalog() -> list:
+    """The cost ledger with roofline derivations, one dict per program:
+    compile seconds, FLOPs / bytes accessed (when XLA exposed them),
+    post-compile dispatch stats, and — against :func:`device_peaks` —
+    ``roofline_s`` (the cost-model floor per call) and
+    ``roofline_util`` (floor / mean dispatch wall; an *upper bound*
+    under async dispatch, see module docstring)."""
+    with _LEDGER_LOCK:
+        records = list(_LEDGER.values())
+    out = []
+    for rec in records:
+        with rec.lock:
+            entry = {
+                "family": rec.family,
+                "key": rec.key,
+                "label": rec.label,
+                "build_s": round(rec.build_s, 4),
+                "compile_s": (
+                    round(rec.compile_s, 4)
+                    if rec.compile_s is not None else None
+                ),
+                "flops": rec.flops,
+                "bytes_accessed": rec.bytes_accessed,
+                "optimal_s": rec.optimal_s,
+                "calls": rec.calls + (1 if rec.compile_s is not None else 0),
+                "dispatch_total_s": round(rec.dispatch_s, 4),
+                "platform": rec.platform,
+                "device_kind": rec.device_kind,
+            }
+            calls, dispatch_s = rec.calls, rec.dispatch_s
+            flops, nbytes = rec.flops, rec.bytes_accessed
+            kind = rec.device_kind
+        peaks = device_peaks(kind)
+        entry["peak_flops_per_s"] = peaks["flops_per_s"]
+        entry["peak_bytes_per_s"] = peaks["bytes_per_s"]
+        entry["peak_source"] = peaks["source"]
+        roofline_s = None
+        if flops is not None or nbytes is not None:
+            roofline_s = max(
+                (flops or 0.0) / peaks["flops_per_s"],
+                (nbytes or 0.0) / peaks["bytes_per_s"],
+            )
+        entry["roofline_s"] = roofline_s
+        exec_s = dispatch_s / calls if calls else None
+        entry["exec_mean_s"] = round(exec_s, 6) if exec_s else None
+        entry["roofline_util"] = (
+            round(roofline_s / exec_s, 4)
+            if roofline_s and exec_s else None
+        )
+        entry["achieved_flops_per_s"] = (
+            round(flops / exec_s, 2) if flops and exec_s else None
+        )
+        out.append(entry)
+    out.sort(key=lambda e: -(e["compile_s"] or 0.0))
+    return out
+
+
+def write_catalog(metrics_dir: Optional[str] = None) -> Optional[str]:
+    """Write the per-run ``programs.json`` catalog (and emit a
+    ``programs``-kind event carrying the same entries) under
+    ``metrics_dir`` — default: the telemetry sink's directory. No-op
+    (returns None) with telemetry off, an empty ledger, or nowhere to
+    write. Registered as a telemetry flush hook, so every run that
+    flushes a sink gets its catalog for free."""
+    if not telemetry.enabled():
+        return None
+    entries = catalog()
+    if not entries:
+        return None
+    if metrics_dir is None:
+        path = telemetry.configured_path()
+        metrics_dir = os.path.dirname(path) if path else None
+    if metrics_dir is None:
+        return None
+    telemetry.event("programs", "program/catalog", programs=entries)
+    payload = {
+        "worker": telemetry.worker_id(),
+        "t": time.time(),
+        "programs": entries,
+    }
+    target = os.path.join(metrics_dir, "programs.json")
+    try:
+        os.makedirs(metrics_dir, exist_ok=True)
+        tmp = target + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, target)
+    except OSError:
+        return None
+    return target
+
+
+# ---------------------------------------------------------------------------
+# bounded profiler capture (anomaly-triggered + operator-requested)
+# ---------------------------------------------------------------------------
+_STATE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False  # one jax profiler session at a time, window or capture
+_LAST_CAPTURE_T: Optional[float] = None  # monotonic, automatic cooldown clock
+_CAPTURE_SEQ = 0
+_CAPTURE_THREADS: list = []
+_STALL_PHASE: Optional[str] = None
+_STALL_TICKS = 0
+_WINDOW = None
+
+
+def capture_base_dir() -> Optional[str]:
+    """Where captures land: the telemetry sink's directory, else
+    ``CHUNKFLOW_PROFILE_DIR``, else None (captures disabled)."""
+    path = telemetry.configured_path()
+    if path:
+        return os.path.dirname(path)
+    return os.environ.get("CHUNKFLOW_PROFILE_DIR") or None
+
+
+def _anomaly_capture_enabled() -> bool:
+    return os.environ.get(
+        "CHUNKFLOW_PROFILE_ON_ANOMALY", "1"
+    ).lower() not in ("0", "off", "false", "no")
+
+
+def _acquire_trace() -> bool:
+    global _TRACE_ACTIVE
+    with _STATE_LOCK:
+        if _TRACE_ACTIVE:
+            return False
+        _TRACE_ACTIVE = True
+        return True
+
+
+def _release_trace() -> None:
+    global _TRACE_ACTIVE
+    with _STATE_LOCK:
+        _TRACE_ACTIVE = False
+
+
+def _safe_name(reason: str) -> str:
+    return "".join(
+        ch if ch.isalnum() or ch in "._-" else "-" for ch in reason
+    )[:48]
+
+
+def _run_capture(target: str, seconds: float, reason: str) -> bool:
+    """One bounded profiler window into ``target``; the caller holds the
+    trace flag. Never raises — a failed capture is an event, not a
+    pipeline death."""
+    try:
+        import jax
+
+        os.makedirs(target, exist_ok=True)
+        jax.profiler.start_trace(target)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    except Exception as exc:
+        telemetry.inc("profile/capture_errors")
+        telemetry.event("profile", "profile/capture_error",
+                        reason=reason, error=str(exc)[:300])
+        return False
+    finally:
+        _release_trace()
+    telemetry.inc("profile/captures")
+    telemetry.event("profile", "profile/capture", dir=target,
+                    seconds=seconds, reason=reason)
+    return True
+
+
+def capture(seconds: float, reason: str, force: bool = False,
+            background: bool = False) -> Tuple[Optional[str], Optional[str]]:
+    """One bounded profiler window; returns ``(trace_dir, error)``.
+
+    ``force=True`` (operator request, the ``/profile`` route) bypasses
+    the automatic-capture cooldown but never the one-session-at-a-time
+    exclusion. ``background=True`` runs the window in a daemon thread
+    (anomaly triggers must not stall the pipeline for the window's
+    duration). Disabled telemetry or no capture dir ⇒ ``(None, why)``.
+    """
+    global _TRACE_ACTIVE, _LAST_CAPTURE_T, _CAPTURE_SEQ
+    if not telemetry.enabled():
+        return None, "telemetry disabled (CHUNKFLOW_TELEMETRY=0)"
+    base = capture_base_dir()
+    if base is None:
+        return None, ("no capture dir: run with --metrics-dir or set "
+                      "CHUNKFLOW_PROFILE_DIR")
+    seconds = min(max(float(seconds), 0.05),
+                  _env_float("CHUNKFLOW_PROFILE_MAX_SECONDS", 60.0))
+    cooldown = _env_float("CHUNKFLOW_PROFILE_COOLDOWN", 300.0)
+    with _STATE_LOCK:
+        if _TRACE_ACTIVE:
+            return None, "a profiler session is already active"
+        if not force and _LAST_CAPTURE_T is not None \
+                and time.monotonic() - _LAST_CAPTURE_T < cooldown:
+            return None, "capture cooldown in effect"
+        _TRACE_ACTIVE = True
+        _LAST_CAPTURE_T = time.monotonic()
+        _CAPTURE_SEQ += 1
+        seq = _CAPTURE_SEQ
+    target = os.path.join(base, f"profile-{_safe_name(reason)}-{seq}")
+    if background:
+        thread = threading.Thread(
+            target=_run_capture, args=(target, seconds, reason),
+            name=f"chunkflow-profile-{seq}", daemon=True,
+        )
+        _CAPTURE_THREADS.append(thread)
+        thread.start()
+        return target, None
+    ok = _run_capture(target, seconds, reason)
+    return (target, None) if ok else (None, "capture failed (see events)")
+
+
+def maybe_capture(reason: str) -> bool:
+    """Automatic (anomaly) capture: bounded window in a background
+    thread, honoring the cooldown and the anomaly kill switch
+    (``CHUNKFLOW_PROFILE_ON_ANOMALY=0``). Returns True when a capture
+    was started."""
+    if not telemetry.enabled() or not _anomaly_capture_enabled():
+        return False
+    seconds = _env_float("CHUNKFLOW_PROFILE_SECONDS", 3.0)
+    target, err = capture(seconds, reason, force=False, background=True)
+    if target is None:
+        if err not in ("capture cooldown in effect",):
+            telemetry.event("profile", "profile/capture_skipped",
+                            reason=reason, why=err)
+        return False
+    return True
+
+
+def note_retrace(label: str) -> None:
+    """The retrace watchdog fired (core/compile_cache.py): the pipeline
+    is paying an unplanned XLA compile per chunk — exactly the moment a
+    bounded trace is worth its cost."""
+    maybe_capture(f"retrace-{_safe_name(label)}")
+
+
+def note_stall(phase: str, share: float) -> None:
+    """One depth-controller tick's dominant stall sample
+    (flow/scheduler.py). A share at or above
+    ``CHUNKFLOW_PROFILE_STALL_SHARE`` (default 0.8) for
+    ``CHUNKFLOW_PROFILE_STALL_TICKS`` (default 3) *consecutive* ticks
+    on the SAME phase triggers one bounded capture — a persistent
+    bottleneck the depth controller could not widen away."""
+    global _STALL_PHASE, _STALL_TICKS
+    threshold = _env_float("CHUNKFLOW_PROFILE_STALL_SHARE", 0.8)
+    need = _env_int("CHUNKFLOW_PROFILE_STALL_TICKS", 3)
+    with _STATE_LOCK:
+        if share < threshold:
+            _STALL_PHASE, _STALL_TICKS = None, 0
+            return
+        if phase != _STALL_PHASE:
+            _STALL_PHASE, _STALL_TICKS = phase, 1
+        else:
+            _STALL_TICKS += 1
+        if _STALL_TICKS < need:
+            return
+        _STALL_PHASE, _STALL_TICKS = None, 0
+    maybe_capture(f"stall-{_safe_name(phase)}")
+
+
+def wait_for_captures(timeout: float = 10.0) -> None:
+    """Join outstanding background capture threads (tests, teardown)."""
+    deadline = time.monotonic() + timeout
+    for thread in list(_CAPTURE_THREADS):
+        thread.join(timeout=max(0.0, deadline - time.monotonic()))
+    _CAPTURE_THREADS[:] = [
+        t for t in _CAPTURE_THREADS if t.is_alive()
+    ]
+
+
+# ---------------------------------------------------------------------------
+# windowed --profile-dir capture (first N tasks)
+# ---------------------------------------------------------------------------
+class _TaskWindow:
+    """A profiler session covering the first N pipeline tasks (N<=0:
+    the whole run — the historical behavior, now opt-in)."""
+
+    def __init__(self, trace_dir: str, tasks: int):
+        self.trace_dir = trace_dir
+        self.remaining = tasks
+        self.active = False
+        self._lock = threading.Lock()
+
+    def _start(self) -> bool:
+        if not _acquire_trace():
+            return False
+        try:
+            import jax
+
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception as exc:
+            _release_trace()
+            telemetry.event("profile", "profile/window_error",
+                            error=str(exc)[:300])
+            return False
+        self.active = True
+        telemetry.event("profile", "profile/window_start",
+                        dir=self.trace_dir, tasks=self.remaining)
+        return True
+
+    def note_task(self) -> None:
+        with self._lock:
+            if not self.active or self.remaining <= 0:
+                return  # whole-run window: only close() stops it
+            self.remaining -= 1
+            if self.remaining > 0:
+                return
+            self._stop()
+
+    def close(self) -> None:
+        with self._lock:
+            if self.active:
+                self._stop()
+
+    def _stop(self) -> None:
+        """Caller holds self._lock (or is single-threaded teardown)."""
+        self.active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as exc:
+            telemetry.event("profile", "profile/window_error",
+                            error=str(exc)[:300])
+        finally:
+            _release_trace()
+        telemetry.inc("profile/windows")
+        telemetry.event("profile", "profile/window_stop",
+                        dir=self.trace_dir)
+
+
+def start_task_window(trace_dir: str,
+                      tasks: Optional[int] = None) -> Optional[_TaskWindow]:
+    """Start the windowed ``--profile-dir`` trace: the profiler runs
+    from now until ``tasks`` pipeline tasks complete
+    (``CHUNKFLOW_PROFILE_TASKS`` default 4; <=0 traces the whole run).
+    Returns None — creating nothing — when telemetry is off or another
+    profiler session is active."""
+    global _WINDOW
+    if not telemetry.enabled():
+        return None
+    if tasks is None:
+        tasks = _env_int("CHUNKFLOW_PROFILE_TASKS", 4)
+    window = _TaskWindow(trace_dir, tasks)
+    if not window._start():
+        return None
+    _WINDOW = window
+    return window
+
+
+def note_task_done() -> None:
+    """One pipeline task finished (flow/runtime.process_stream). Cheap
+    flag check when no window is open."""
+    window = _WINDOW
+    if window is not None:
+        window.note_task()
+
+
+# ---------------------------------------------------------------------------
+# per-run lifecycle: ride telemetry's flush/reset
+# ---------------------------------------------------------------------------
+def _on_reset() -> None:
+    global _LAST_CAPTURE_T, _STALL_PHASE, _STALL_TICKS, _WINDOW
+    window = _WINDOW
+    if window is not None:
+        window.close()
+    _WINDOW = None
+    with _LEDGER_LOCK:
+        _LEDGER.clear()
+    with _STATE_LOCK:
+        _LAST_CAPTURE_T = None
+        _STALL_PHASE, _STALL_TICKS = None, 0
+
+
+telemetry.add_flush_hook(write_catalog)
+telemetry.add_reset_hook(_on_reset)

@@ -75,7 +75,7 @@ __all__ = [
     "enabled", "configure", "configured_path", "inc", "gauge", "observe",
     "span", "event", "snapshot", "flush", "reset", "summary_table",
     "hist_totals", "worker_id", "task_context", "current_trace_id",
-    "snapshot_interval",
+    "snapshot_interval", "add_flush_hook", "add_reset_hook",
 ]
 
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -430,12 +430,44 @@ def snapshot() -> dict:
         }
 
 
+# Layer hooks: other observability planes (core/profiling.py's program
+# cost ledger) ride the same flush/reset lifecycle without telemetry
+# importing them (this module stays zero-dependency). Flush hooks get
+# the metrics dir in effect (None when no sink); both hook kinds are
+# best-effort — a failing hook must never take the pipeline down.
+_FLUSH_HOOKS: list = []
+_RESET_HOOKS: list = []
+
+
+def add_flush_hook(fn) -> None:
+    """Register ``fn(metrics_dir_or_None)`` to run at every
+    :func:`flush` (idempotent by identity). Skipped entirely when
+    telemetry is disabled — the kill switch silences hooked planes too."""
+    if fn not in _FLUSH_HOOKS:
+        _FLUSH_HOOKS.append(fn)
+
+
+def add_reset_hook(fn) -> None:
+    """Register ``fn()`` to run at every :func:`reset` (idempotent by
+    identity) so hooked planes drop their per-run state with ours."""
+    if fn not in _RESET_HOOKS:
+        _RESET_HOOKS.append(fn)
+
+
 def flush() -> None:
     """Write the aggregate snapshot as a final event and flush the sink.
     Counters (builds/hits, task counts) reach the JSONL stream here —
     they are aggregate-only during the run."""
     if not enabled():
         return
+    metrics_dir = (
+        os.path.dirname(_REG.sink_path) if _REG.sink_path else None
+    )
+    for hook in list(_FLUSH_HOOKS):
+        try:
+            hook(metrics_dir)
+        except Exception:
+            pass
     snap = snapshot()
     if _REG.sink is not None:
         _REG.emit(_stamp({"kind": "snapshot", "t": time.time(),
@@ -465,6 +497,11 @@ def reset() -> None:
         _REG.sink, _REG.sink_path = None, None
         _REG.sink_bytes = 0
     _WORKER_ID = None
+    for hook in list(_RESET_HOOKS):
+        try:
+            hook()
+        except Exception:
+            pass
 
 
 # -- end-of-run reporting ----------------------------------------------

@@ -73,8 +73,15 @@ def name_option(default):
 @click.option("--dry-run/--real-run", default=False)
 @click.option("--verbose", "-v", count=True)
 @click.option("--profile-dir", type=str, default=None,
-              help="write a jax profiler trace of the whole pipeline here "
-                   "(view with tensorboard or xprof)")
+              help="capture a jax profiler trace of the run's first "
+                   "--profile-tasks tasks here (bounded, not the whole "
+                   "run; summarize with tools/analyze_trace.py or view "
+                   "with tensorboard/xprof). CHUNKFLOW_TELEMETRY=0 "
+                   "disables all profiling")
+@click.option("--profile-tasks", type=int, default=None,
+              help="tasks covered by the --profile-dir window "
+                   "(CHUNKFLOW_PROFILE_TASKS, default 4; <=0 traces "
+                   "the whole run — the pre-PR 8 behavior)")
 @click.option("--metrics-dir", type=str, default=None,
               help="append structured telemetry JSONL (spans, stall "
                    "attribution, cache counters) here; aggregate with "
@@ -86,7 +93,8 @@ def name_option(default):
                    "ephemeral port; CHUNKFLOW_METRICS_PORT is the env "
                    "equivalent). CHUNKFLOW_TELEMETRY=0 creates no "
                    "listener (docs/observability.md \"Fleet view\")")
-def main(mip, dry_run, verbose, profile_dir, metrics_dir, metrics_port):
+def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
+         metrics_port):
     """chunkflow-tpu: compose chunk operators into a pipeline.
 
     \b
@@ -111,6 +119,17 @@ def main(mip, dry_run, verbose, profile_dir, metrics_dir, metrics_port):
       fleet-run spawns/monitors/scales/evicts worker processes from
       live telemetry; CHUNKFLOW_FLEET=0 pins a static fleet size and
       bypasses the scaling controller (liveness replacement stays).
+
+    \b
+    Device performance plane (docs/observability.md "Device program
+    view"): every compiled program's compile time + XLA cost analysis
+    lands in program/* counters and --metrics-dir/programs.json;
+    --profile-dir captures the first --profile-tasks tasks; anomaly
+    captures (retrace watchdog, sustained dominant stall) write
+    bounded profile-* trace dirs under --metrics-dir, summarized by
+    log-summary / tools/analyze_trace.py; POST /profile?seconds=N on
+    the metrics port profiles a live worker on demand.
+    CHUNKFLOW_TELEMETRY=0 disables the entire plane.
     """
     from chunkflow_tpu.core import telemetry
 
@@ -177,19 +196,27 @@ def _print_run_telemetry(verbose: int) -> None:
 
 
 @main.result_callback()
-def run_pipeline(stages, mip, dry_run, verbose, profile_dir, metrics_dir,
-                 metrics_port):
+def run_pipeline(stages, mip, dry_run, verbose, profile_dir, profile_tasks,
+                 metrics_dir, metrics_port):
+    window = None
     if profile_dir:
-        import jax
+        # windowed capture (core/profiling.py): the trace covers the
+        # first --profile-tasks tasks, not the whole run — a petabyte
+        # job's profile should not be a petabyte of trace
+        from chunkflow_tpu.core import profiling
 
-        jax.profiler.start_trace(profile_dir)
+        window = profiling.start_task_window(profile_dir,
+                                             tasks=profile_tasks)
+        if window is None:
+            print(
+                "profiler window not started (telemetry disabled or "
+                "another profiler session active)", file=sys.stderr,
+            )
     try:
         count = process_stream(stages, verbose=verbose)
     finally:
-        if profile_dir:
-            import jax
-
-            jax.profiler.stop_trace()
+        if window is not None:
+            window.close()
         _print_run_telemetry(verbose)
         # the exporter's lifetime is the run's: a supervisor scraping a
         # finished worker should see connection-refused, not stale data
@@ -763,7 +790,10 @@ def fleet_status_cmd(queue_name, workers, timeout, fleet_state):
 
         from chunkflow_tpu.core import telemetry
         from chunkflow_tpu.parallel.queues import open_queue
-        from chunkflow_tpu.parallel.restapi import scrape_worker
+        from chunkflow_tpu.parallel.restapi import (
+            achieved_mvox_s,
+            scrape_worker,
+        )
 
         queue = open_queue(queue_name)
         stats = queue.stats()
@@ -851,6 +881,9 @@ def fleet_status_cmd(queue_name, workers, timeout, fleet_state):
             )
             if dominant is not None:
                 line += f" dominant-stall-share={dominant:.0%}"
+            mvox = achieved_mvox_s(metrics)
+            if mvox is not None:
+                line += f" achieved={mvox:.2f} Mvox/s"
             print(line)
         return
         yield  # pragma: no cover

@@ -270,6 +270,7 @@ def summarize_telemetry(events: List[dict]) -> dict:
         for name, g in gauge_stats.items()
     }
 
+    programs = summarize_programs(events)
     stall_total = sum(
         spans[p]["total_s"] for p in STALL_PHASES if p in spans
     )
@@ -282,7 +283,118 @@ def summarize_telemetry(events: List[dict]) -> dict:
         for p in STALL_PHASES if p in spans
     }
     return {"spans": spans, "counters": counters, "gauges": gauges,
-            "stall": stall, "depth_changes": depth_changes}
+            "stall": stall, "depth_changes": depth_changes,
+            "programs": programs}
+
+
+# ---------------------------------------------------------------------------
+# device program view (core/profiling.py cost ledger)
+# ---------------------------------------------------------------------------
+def summarize_programs(events: List[dict]) -> List[dict]:
+    """Per-program cost entries from the telemetry stream: the LAST
+    ``programs``-kind catalog event per worker wins (it carries the
+    roofline derivations); workers that died before a catalog flush
+    fall back to their raw per-build ``compile`` events. Entries are
+    stamped with their worker and sorted by compile seconds."""
+    catalogs: dict = {}
+    compiles: dict = {}
+    for record in events:
+        kind = record.get("kind")
+        worker = _event_worker(record)
+        if kind == "programs":
+            catalogs[worker] = record.get("programs") or []
+        elif kind == "compile":
+            compiles.setdefault(worker, []).append({
+                "family": record.get("family", ""),
+                "key": record.get("key", ""),
+                "build_s": record.get("build_s"),
+                "compile_s": record.get("compile_s"),
+                "flops": record.get("flops"),
+                "bytes_accessed": record.get("bytes_accessed"),
+                "device_kind": record.get("device", ""),
+            })
+    entries: List[dict] = []
+    for worker in sorted(set(catalogs) | set(compiles)):
+        source = catalogs.get(worker) or compiles.get(worker) or []
+        for entry in source:
+            row = dict(entry)
+            row["worker"] = worker
+            entries.append(row)
+    entries.sort(key=lambda e: -(e.get("compile_s") or 0.0))
+    return entries
+
+
+def _fmt_quantity(value, scale: float, suffix: str) -> str:
+    if value is None:
+        return "-"
+    return f"{value / scale:.2f}{suffix}"
+
+
+def print_program_summary(programs: List[dict], top: int = 10) -> None:
+    """The DEVICE PROGRAMS table: top program families by compile time,
+    with XLA cost analysis and the achieved-vs-roofline figure when the
+    catalog carried one (docs/observability.md "Device program view")."""
+    if not programs:
+        return
+    print("device programs (top by compile time; util is an upper "
+          "bound under async dispatch):")
+    print(
+        f"  {'family':<10} {'key':<14} {'compile_s':>9} {'flops':>9} "
+        f"{'bytes':>9} {'exec_ms':>8} {'roofline':>8}"
+    )
+    for entry in programs[:top]:
+        exec_s = entry.get("exec_mean_s")
+        util = entry.get("roofline_util")
+        print(
+            f"  {str(entry.get('family', ''))[:10]:<10} "
+            f"{str(entry.get('key', ''))[:14]:<14} "
+            f"{entry.get('compile_s') or 0.0:>9.3f} "
+            f"{_fmt_quantity(entry.get('flops'), 1e9, 'G'):>9} "
+            f"{_fmt_quantity(entry.get('bytes_accessed'), 2**20, 'M'):>9} "
+            f"{exec_s * 1e3 if exec_s else 0.0:>8.2f} "
+            f"{(f'{util:.1%}' if util is not None else '-'):>8}"
+        )
+
+
+def print_profile_summaries(metrics_dir: str, top: int = 3) -> None:
+    """Summarize every bounded profiler capture under ``metrics_dir``
+    (``profile-*`` dirs from anomaly captures / the ``/profile`` route
+    / windowed ``--profile-dir`` runs pointed here) through
+    ``tools/analyze_trace.py`` op-category attribution. Quiet when the
+    analyzer is not importable (installed package without the repo's
+    tools/) or there are no captures."""
+    import glob as _glob
+
+    capture_dirs = sorted(
+        d for d in _glob.glob(os.path.join(metrics_dir, "profile-*"))
+        if os.path.isdir(d)
+    )
+    if not capture_dirs:
+        return
+    try:
+        from tools.analyze_trace import summarize_trace_dir
+    except ImportError:
+        print(
+            f"{len(capture_dirs)} profiler capture(s) under "
+            f"{metrics_dir} (tools/analyze_trace.py not importable "
+            f"here; run it directly for op attribution)"
+        )
+        return
+    for capture_dir in capture_dirs:
+        summary = summarize_trace_dir(capture_dir, top=top)
+        name = os.path.basename(capture_dir)
+        if summary["files"] == 0:
+            print(f"profiler capture {name}: no trace files")
+            continue
+        cats = ", ".join(
+            f"{row['category']} {row['share']:.0%}"
+            for row in summary["categories"][:top]
+        )
+        print(
+            f"profiler capture {name}: {summary['files']} file(s), "
+            f"{summary['total_device_us'] / 1e3:.2f} ms device time"
+            + (f" [{cats}]" if cats else "")
+        )
 
 
 def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
@@ -364,6 +476,7 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
             f"program cache: {builds or 0:g} build(s), {hits or 0:g} "
             f"hit(s)"
         )
+    print_program_summary(agg.get("programs") or [])
     if agg["counters"].get("compile_cache/retrace_warnings"):
         print(
             f"RETRACE WARNINGS: "
@@ -385,6 +498,7 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 f"  {name:<28} {s['count']:>7} {s['total_s']:>9.3f} "
                 f"{s['mean_s']:>9.4f}"
             )
+    print_profile_summaries(metrics_dir)
     return agg
 
 

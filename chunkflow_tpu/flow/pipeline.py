@@ -65,7 +65,16 @@ def _drain_host(out):
         with telemetry.span("pipeline/compute"):
             arr.block_until_ready()
     with telemetry.span("pipeline/drain"):
-        return out.host()
+        host = out.host()
+    # inference/voxels + the span totals give achieved Mvox/s per worker
+    # (fleet-status, docs/observability.md "Device program view")
+    shape = getattr(getattr(host, "array", None), "shape", None)
+    if shape:
+        voxels = 1
+        for length in shape[-3:]:
+            voxels *= int(length)
+        telemetry.inc("inference/voxels", float(voxels))
+    return host
 
 
 def _device_pipeline(inferencer, chunks: Iterable, ring: int, crop=None):

@@ -15,7 +15,7 @@ import functools
 import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
-from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core import profiling, telemetry
 from chunkflow_tpu.testing import chaos
 
 DEFAULT_CHUNK_NAME = "chunk"
@@ -110,6 +110,10 @@ def process_stream(stages: Iterable[Callable], verbose: int = 0) -> int:
                         telemetry.span("pipeline/ack_writes"):
                     drain_pending_writes(task)
                 telemetry.inc("pipeline/tasks")
+                # windowed --profile-dir capture: the profiler window
+                # closes itself after its first-N-tasks budget
+                # (core/profiling.py; cheap flag check when no window)
+                profiling.note_task_done()
                 if task is None:
                     telemetry.inc("pipeline/tasks_skipped")
                 if verbose and task is not None and task.get("log"):

@@ -58,7 +58,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Iterator, Optional
 
-from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core import profiling, telemetry
 from chunkflow_tpu.flow.pipeline import _drain_host
 from chunkflow_tpu.parallel.lifecycle import tag_culprit as _tag_culprit
 from chunkflow_tpu.testing import chaos
@@ -204,7 +204,13 @@ class DepthController:
         if window <= 0.0:
             return []
         dominant = max(deltas, key=deltas.get)
-        if deltas[dominant] / window < self.min_share:
+        share = deltas[dominant] / window
+        # anomaly feed (core/profiling.py): a dominant share that holds
+        # above the capture threshold for K consecutive ticks triggers
+        # one bounded profiler window — the bottleneck this controller
+        # could not widen away is exactly what a trace should explain
+        profiling.note_stall(dominant, share)
+        if share < self.min_share:
             return []  # no clear bottleneck: depths are matched, stand pat
         applied = []
         for knob in PHASE_KNOBS[dominant]:

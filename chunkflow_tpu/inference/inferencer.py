@@ -579,7 +579,16 @@ class Inferencer:
         # the compiled program, GL007); blend mode labels the event so
         # fold-vs-scatter time is separable offline
         with telemetry.span("inference/infer", blend=self.blend_mode):
-            return self._infer(chunk, block=True)
+            result = self._infer(chunk, block=True)
+        # achieved-Mvox/s numerator (host-side, GL007): the pipelined
+        # paths count in flow/pipeline._drain_host instead
+        shape = getattr(getattr(result, "array", None), "shape", None)
+        if shape:
+            voxels = 1
+            for length in shape[-3:]:
+                voxels *= int(length)
+            telemetry.inc("inference/voxels", float(voxels))
+        return result
 
     def stream(self, chunks, postprocess=None, post_depth: int = 2,
                ring: int = 2, prefetch_depth: int = 2, adaptive=None):

@@ -185,6 +185,8 @@ class CoordinationService:
             return 200, render_prometheus()
         if method == "GET" and path == "/healthz":
             return 200, worker_health()
+        if method == "POST" and path.split("?", 1)[0] == "/profile":
+            return self._handle_profile(path)
         m = re.fullmatch(r"/objids/(\d+)", path)
         if method == "GET" and m:
             return 200, {"base_id": self.ids.allocate(int(m.group(1)))}
@@ -208,6 +210,38 @@ class CoordinationService:
                 return 404, {"error": "no task tree configured"}
             return 200, self.tree.to_dict()
         return 404, {"error": f"unknown endpoint {method} {path}"}
+
+    @staticmethod
+    def _handle_profile(path: str):
+        """``POST /profile?seconds=N``: capture one bounded jax.profiler
+        window on this live worker (docs/observability.md "Device
+        program view"). Blocks the request for the window's duration
+        (each request has its own server thread) and returns the trace
+        dir, ready for ``tools/analyze_trace.py``. Operator-requested,
+        so the automatic-capture cooldown does not apply; the
+        one-session-at-a-time exclusion does (409). Under
+        ``CHUNKFLOW_TELEMETRY=0`` the route does not exist (404) — and
+        the exporter never even opened a socket."""
+        if not telemetry.enabled():
+            return 404, {"error": "telemetry disabled "
+                                  "(CHUNKFLOW_TELEMETRY=0)"}
+        from urllib.parse import parse_qs, urlsplit
+
+        from chunkflow_tpu.core import profiling
+
+        query = parse_qs(urlsplit(path).query)
+        try:
+            seconds = float(query.get("seconds", ["2.0"])[0])
+        except ValueError:
+            return 400, {"error": "seconds must be a number"}
+        trace_dir, err = profiling.capture(
+            seconds, reason="operator", force=True, background=False,
+        )
+        if trace_dir is None:
+            status = 409 if "already active" in (err or "") else 503
+            return status, {"error": err}
+        return 200, {"trace_dir": trace_dir, "seconds": seconds,
+                     "worker": telemetry.worker_id()}
 
 
 def serve(
@@ -301,6 +335,29 @@ def dominant_stall(text: str) -> Optional[dict]:
     if m is None:
         return None
     return {"phase": m.group(1), "share": float(m.group(2))}
+
+
+#: the span summaries whose ``_sum`` samples cover device inference
+#: time: ``inference/infer`` on the serial path, dispatch/compute/drain
+#: on the pipelined paths — disjoint by construction, so the sum is the
+#: denominator of the achieved-throughput figure either way
+_INFER_TIME_SUMS = (
+    "chunkflow_inference_infer_sum", "chunkflow_pipeline_dispatch_sum",
+    "chunkflow_pipeline_compute_sum", "chunkflow_pipeline_drain_sum",
+)
+
+
+def achieved_mvox_s(metrics: Dict[str, float]) -> Optional[float]:
+    """Achieved inference throughput in Mvox/s from one worker's parsed
+    ``/metrics`` sample: output voxels counted at the host sink
+    (``inference/voxels``) over the inference-side span seconds. None
+    when the worker has no voxel count yet (non-inference pipeline, or
+    just started) — fleet-status then simply omits the figure."""
+    voxels = metrics.get("chunkflow_inference_voxels_total", 0.0)
+    seconds = sum(metrics.get(name, 0.0) for name in _INFER_TIME_SUMS)
+    if voxels <= 0 or seconds <= 0:
+        return None
+    return voxels / seconds / 1e6
 
 
 def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:

@@ -68,7 +68,10 @@ def snapshot_metric_data(snap: Optional[dict] = None,
         })
 
     for name, value in sorted((snap.get("counters") or {}).items()):
-        add(name, value, "Count")
+        # time-valued counters (program/compile_seconds, PR 8's device
+        # program plane) carry a real unit; everything else is a Count
+        unit = "Seconds" if name.endswith("_seconds") else "Count"
+        add(name, value, unit)
     for name, value in sorted((snap.get("gauges") or {}).items()):
         if name in _BYTE_GAUGES:
             unit = "Bytes"

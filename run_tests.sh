@@ -56,7 +56,7 @@ stage_time "pytest"
 # on the hot path, per-event fsync), so shared-box noise cannot redden CI.
 echo "== telemetry overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py telemetry_overhead || rc=$((rc == 0 ? 1 : rc))
+    python bench.py telemetry_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "telemetry overhead gate"
 
 # --- pipeline overlap gate --------------------------------------------------
@@ -67,7 +67,7 @@ stage_time "telemetry overhead gate"
 # of record. The run itself raises on bit-divergence.
 echo "== pipeline overlap gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py pipeline_overlap || rc=$((rc == 0 ? 1 : rc))
+    python bench.py pipeline_overlap --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "pipeline overlap gate"
 
 # --- e2e overlap gate ------------------------------------------------------
@@ -77,7 +77,7 @@ stage_time "pipeline overlap gate"
 # best-of-3 in tests/test_bench.py); the process only fails below 1.1x.
 echo "== e2e overlap gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py e2e_overlap || rc=$((rc == 0 ? 1 : rc))
+    python bench.py e2e_overlap --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "e2e overlap gate"
 
 # --- resilience overhead gate ----------------------------------------------
@@ -88,7 +88,7 @@ stage_time "e2e overlap gate"
 # hot path), so shared-box noise cannot redden CI.
 echo "== resilience overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py resilience_overhead || rc=$((rc == 0 ? 1 : rc))
+    python bench.py resilience_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "resilience overhead gate"
 
 # --- export overhead gate ---------------------------------------------------
@@ -99,7 +99,7 @@ stage_time "resilience overhead gate"
 # per-task hot path), so shared-box noise cannot redden CI.
 echo "== export overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py export_overhead || rc=$((rc == 0 ? 1 : rc))
+    python bench.py export_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "export overhead gate"
 
 # --- fleet chaos smoke ------------------------------------------------------
@@ -110,6 +110,19 @@ stage_time "export overhead gate"
 # clean — or the process exits nonzero.
 echo "== fleet chaos smoke =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python bench.py fleet_smoke || rc=$((rc == 0 ? 1 : rc))
+    python bench.py fleet_smoke --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "fleet chaos smoke"
+
+# --- bench regression ledger ------------------------------------------------
+# Every gate above appended its measurement (commit-stamped) to
+# telemetry/bench_ledger.jsonl; compare diffs this run against the
+# rolling median of prior FRESH rows (cached: rows loudly refused as
+# baselines). Soft gate on this load-sensitive 1-core box: compare
+# itself exits nonzero only on a >25% fresh-vs-fresh regression of a
+# throughput/speedup metric (docs/observability.md "Device program
+# view" — bench-ledger cookbook).
+echo "== bench regression ledger compare =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py compare || rc=$((rc == 0 ? 1 : rc))
+stage_time "bench ledger compare"
 exit $rc

@@ -1,0 +1,354 @@
+"""Device-performance plane (core/profiling.py, ISSUE 8): program cost
+ledger + roofline accounting, anomaly-triggered bounded profiler
+capture, kill-switch compliance, and the GL007 lint gate over the new
+module."""
+import glob
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.core import profiling, telemetry
+from chunkflow_tpu.core.compile_cache import ProgramCache, RetraceWarning
+
+
+@pytest.fixture
+def clean_plane(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()  # reset hook clears the ledger + capture state
+    yield monkeypatch
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# cost ledger
+# ---------------------------------------------------------------------------
+def test_program_cache_build_records_cost_ledger_entry(clean_plane,
+                                                       tmp_path):
+    """Acceptance: every ProgramCache build records compile seconds
+    (always) and FLOPs/bytes (cost_analysis available on CPU), visible
+    in the catalog, programs.json, the JSONL stream, and /metrics."""
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    telemetry.configure(str(tmp_path))
+    inferencer = Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(2):
+        np.asarray(inferencer(Chunk(
+            rng.random((8, 32, 32), dtype=np.float32))).array)
+
+    entries = profiling.catalog()
+    assert len(entries) == 1
+    entry = entries[0]
+    assert entry["family"] == "scatter"
+    assert entry["compile_s"] > 0  # first call paid trace + XLA compile
+    assert entry["flops"] > 0  # CPU backend exposes cost_analysis
+    assert entry["bytes_accessed"] > 0
+    assert entry["calls"] == 2
+    # roofline derivation against the peak table (CPU fallback row)
+    assert entry["roofline_s"] > 0
+    assert entry["roofline_util"] is not None
+    assert entry["peak_source"].startswith(("table:", "env"))
+
+    counters = telemetry.snapshot()["counters"]
+    assert counters["program/builds"] == 1
+    assert counters["program/compile_seconds"] > 0
+    assert counters["program/flops_total"] == entry["flops"]
+
+    # flush writes programs.json (flush hook) + emits the catalog event
+    telemetry.flush()
+    catalog_path = tmp_path / "programs.json"
+    assert catalog_path.exists()
+    payload = json.loads(catalog_path.read_text())
+    assert payload["programs"][0]["family"] == "scatter"
+
+    kinds = {}
+    with open(telemetry.configured_path()) as f:
+        for line in f:
+            record = json.loads(line)
+            kinds.setdefault(record["kind"], []).append(record)
+    assert len(kinds["compile"]) == 1
+    compile_ev = kinds["compile"][0]
+    assert compile_ev["name"] == "program/scatter"
+    assert compile_ev["compile_s"] > 0
+    assert kinds["programs"][0]["programs"]
+
+    # the program/* counters ride /metrics with zero new mapping code
+    from chunkflow_tpu.parallel.restapi import (
+        parse_prometheus,
+        render_prometheus,
+    )
+
+    metrics = parse_prometheus(render_prometheus())
+    assert metrics["chunkflow_program_builds_total"] == 1
+    assert metrics["chunkflow_program_compile_seconds_total"] > 0
+    assert metrics["chunkflow_program_flops_total_total"] == entry["flops"]
+
+
+def test_instrument_program_passthrough_for_non_programs(clean_plane):
+    """Cache entries that are not lowerable jit programs (tests cache
+    plain sentinels) pass through untouched."""
+    assert profiling.instrument_program("tag", ("k",)) == "tag"
+    fn = lambda: 1  # noqa: E731 — callable but no .lower
+    assert profiling.instrument_program(fn, ("k",)) is fn
+    assert profiling.catalog() == []
+
+
+def test_instrumented_program_forwards_attributes(clean_plane):
+    import jax
+    import jax.numpy as jnp
+
+    program = profiling.instrument_program(
+        jax.jit(lambda x: x * 2), ("fold", (8, 16, 16)), label="t")
+    out = program(jnp.ones((4, 4)))
+    assert float(out[0, 0]) == 2.0
+    assert program._cache_size() == 1  # PjitFunction API forwards
+    entry = profiling.catalog()[0]
+    assert entry["family"] == "fold"
+    assert entry["key"] == "(8, 16, 16)"
+
+
+def test_device_peaks_env_override_and_table(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("CHUNKFLOW_PEAK_BW", raising=False)
+    v5e = profiling.device_peaks("TPU v5 lite")
+    assert v5e["flops_per_s"] == 197e12 and v5e["bytes_per_s"] == 819e9
+    assert v5e["source"] == "table:tpu v5 lite"
+    assert profiling.device_peaks("weird accelerator")["source"] \
+        == "fallback"
+    monkeypatch.setenv("CHUNKFLOW_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("CHUNKFLOW_PEAK_BW", "2e11")
+    got = profiling.device_peaks("TPU v5 lite")
+    assert got == {"flops_per_s": 1e12, "bytes_per_s": 2e11,
+                   "source": "env"}
+
+
+# ---------------------------------------------------------------------------
+# anomaly-triggered bounded capture
+# ---------------------------------------------------------------------------
+def test_retrace_fire_captures_exactly_once(clean_plane, tmp_path):
+    """Acceptance: an induced retrace-watchdog fire produces exactly ONE
+    bounded capture that tools/analyze_trace.py can summarise; a second
+    anomaly within the cooldown does not capture again."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch = clean_plane
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_ON_ANOMALY", "1")
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_SECONDS", "0.3")
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_COOLDOWN", "300")
+    telemetry.configure(str(tmp_path))
+
+    cache = ProgramCache(expected_builds=1, label="anomaly")
+    cache.get(("a",), lambda: jax.jit(lambda x: x + 1))(jnp.ones((8, 8)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RetraceWarning)
+        program = cache.get(("b",), lambda: jax.jit(lambda x: x * 2))
+    # run device work while the window is open so the trace has events
+    for _ in range(5):
+        program(jnp.ones((16, 16))).block_until_ready()
+    profiling.wait_for_captures(30)
+
+    capture_dirs = sorted(glob.glob(str(tmp_path / "profile-*")))
+    assert len(capture_dirs) == 1
+    assert "retrace-anomaly" in os.path.basename(capture_dirs[0])
+
+    from tools.analyze_trace import summarize_trace_dir
+
+    summary = summarize_trace_dir(capture_dirs[0])
+    assert summary["files"] >= 1
+
+    # second anomaly inside the cooldown: no new capture
+    profiling.note_retrace("again")
+    profiling.wait_for_captures(10)
+    assert len(glob.glob(str(tmp_path / "profile-*"))) == 1
+    assert telemetry.snapshot()["counters"]["profile/captures"] == 1
+
+
+def test_stall_streak_triggers_capture(clean_plane, monkeypatch):
+    """K consecutive controller ticks with the SAME dominant phase at or
+    above the share threshold trigger one capture; dipping below or
+    switching phase resets the streak."""
+    captured = []
+    monkeypatch.setattr(profiling, "maybe_capture",
+                        lambda reason: captured.append(reason) or True)
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_STALL_SHARE", "0.8")
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_STALL_TICKS", "3")
+
+    profiling.note_stall("scheduler/load", 0.9)
+    profiling.note_stall("scheduler/load", 0.5)  # dip resets
+    profiling.note_stall("scheduler/load", 0.9)
+    profiling.note_stall("pipeline/drain", 0.9)  # phase switch resets
+    profiling.note_stall("pipeline/drain", 0.9)
+    assert captured == []
+    profiling.note_stall("pipeline/drain", 0.9)  # third consecutive
+    assert captured == ["stall-pipeline-drain"]
+    # streak reset after firing: the cooldown owns repeat suppression
+    profiling.note_stall("pipeline/drain", 0.9)
+    profiling.note_stall("pipeline/drain", 0.9)
+    assert len(captured) == 1
+
+
+def test_scheduler_tick_feeds_stall_anomaly(clean_plane, monkeypatch):
+    """The depth controller reports every tick's dominant share to the
+    profiling plane (flow/scheduler.py wiring)."""
+    from chunkflow_tpu.flow.scheduler import DepthController
+
+    seen = []
+    monkeypatch.setattr(profiling, "note_stall",
+                        lambda phase, share: seen.append((phase, share)))
+    ctl = DepthController(interval=1, watermark_bytes=1 << 40)
+    ctl.tick({"scheduler/load": 10.0})
+    assert seen == [("scheduler/load", 1.0)]
+
+
+# ---------------------------------------------------------------------------
+# kill switch: CHUNKFLOW_TELEMETRY=0 means the plane does not exist
+# ---------------------------------------------------------------------------
+def test_kill_switch_creates_nothing(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    telemetry.reset()
+    # no instrumentation wrapper...
+    program = jax.jit(lambda x: x + 1)
+    assert profiling.instrument_program(program, ("a",)) is program
+    cached = ProgramCache().get(("a",), lambda: program)
+    assert cached is program
+    cached(jnp.ones((4, 4)))
+    assert profiling.catalog() == []
+    # ...no catalog file...
+    assert profiling.write_catalog(str(tmp_path)) is None
+    # ...no capture threads or files...
+    assert profiling.maybe_capture("retrace-x") is False
+    target, err = profiling.capture(0.1, "operator", force=True)
+    assert target is None and "disabled" in err
+    # ...no task window...
+    assert profiling.start_task_window(str(tmp_path / "w")) is None
+    # ...and no /profile route
+    from chunkflow_tpu.parallel.restapi import CoordinationService
+
+    status, payload = CoordinationService().handle(
+        "POST", "/profile?seconds=0.1")
+    assert status == 404
+    assert list(tmp_path.iterdir()) == []
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY")
+    telemetry.reset()
+
+
+def test_capture_requires_a_destination(clean_plane, monkeypatch):
+    """No metrics sink and no CHUNKFLOW_PROFILE_DIR: captures refuse
+    rather than writing somewhere surprising."""
+    monkeypatch.delenv("CHUNKFLOW_PROFILE_DIR", raising=False)
+    target, err = profiling.capture(0.1, "operator", force=True)
+    assert target is None and "no capture dir" in err
+
+
+# ---------------------------------------------------------------------------
+# lint compliance: no instrumentation inside traced functions (GL007)
+# ---------------------------------------------------------------------------
+def test_profiling_module_is_gl007_clean():
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        ["chunkflow_tpu/core/profiling.py"], config, repo_root=repo_root)
+    gl007 = [f for f in findings if f.code == "GL007"]
+    assert not gl007, [f"{f.path}:{f.line}: {f.message}" for f in gl007]
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]
+
+
+# ---------------------------------------------------------------------------
+# log-summary DEVICE PROGRAMS table + cloud watch pickup
+# ---------------------------------------------------------------------------
+def test_log_summary_renders_device_programs_table(clean_plane, tmp_path,
+                                                   capsys):
+    from chunkflow_tpu.flow.log_summary import (
+        print_telemetry_summary,
+        summarize_programs,
+    )
+
+    events = [
+        {"kind": "compile", "name": "program/fold", "family": "fold",
+         "key": "(8, 32, 32)", "compile_s": 0.5, "flops": 2e9,
+         "bytes_accessed": 3e8, "device": "cpu", "worker": "w1",
+         "t": 1.0},
+        {"kind": "programs", "name": "program/catalog", "worker": "w1",
+         "t": 2.0, "programs": [
+             {"family": "fold", "key": "(8, 32, 32)", "compile_s": 0.5,
+              "flops": 2e9, "bytes_accessed": 3e8, "exec_mean_s": 0.01,
+              "roofline_util": 0.42, "device_kind": "cpu"},
+             {"family": "scatter", "key": "", "compile_s": 0.2,
+              "flops": 1e9, "bytes_accessed": 1e8, "exec_mean_s": 0.02,
+              "roofline_util": 0.04, "device_kind": "cpu"},
+         ]},
+    ]
+    programs = summarize_programs(events)
+    # the catalog event wins over raw compile events for the same worker
+    assert len(programs) == 2
+    assert programs[0]["family"] == "fold"  # sorted by compile_s
+    assert programs[0]["roofline_util"] == 0.42
+
+    path = tmp_path / "telemetry-w1.jsonl"
+    with open(path, "w") as f:
+        for record in events:
+            f.write(json.dumps(record) + "\n")
+    print_telemetry_summary(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "device programs" in out
+    assert "fold" in out and "scatter" in out
+    assert "42.0%" in out
+
+
+def test_program_counters_reach_cloud_watch(clean_plane):
+    """Satellite: program_* counters flow through the CloudWatch
+    publisher with no new mapping code (and the seconds counter gets a
+    real unit)."""
+    from chunkflow_tpu.plugins.aws.cloud_watch import snapshot_metric_data
+
+    telemetry.inc("program/builds", 2)
+    telemetry.inc("program/compile_seconds", 1.5)
+    data = {d["MetricName"]: d for d in snapshot_metric_data()}
+    assert data["program/builds"]["Value"] == 2
+    assert data["program/builds"]["Unit"] == "Count"
+    assert data["program/compile_seconds"]["Unit"] == "Seconds"
+
+
+def test_task_window_stops_after_n_tasks(clean_plane, tmp_path):
+    """--profile-dir windowed capture: the trace closes itself once its
+    task budget is spent and releases the profiler session."""
+    import jax
+    import jax.numpy as jnp
+
+    telemetry.configure(str(tmp_path))
+    trace_dir = tmp_path / "win"
+    window = profiling.start_task_window(str(trace_dir), tasks=2)
+    assert window is not None and window.active
+    jax.jit(lambda x: x + 1)(jnp.ones((8, 8))).block_until_ready()
+    profiling.note_task_done()
+    assert window.active  # 1 of 2
+    profiling.note_task_done()
+    assert not window.active  # budget spent: trace stopped
+    assert glob.glob(str(trace_dir / "**" / "*.trace.json.gz"),
+                     recursive=True)
+    # the session flag is released: a capture can start again
+    assert profiling._TRACE_ACTIVE is False
+    profiling.note_task_done()  # past-budget tasks are a no-op
+    window.close()  # idempotent

@@ -152,3 +152,27 @@ def test_print_telemetry_summary(tmp_path, capsys):
     assert "dominant phase: pipeline/drain" in out
     assert "ring occupancy" in out
     assert "1 build(s), 5 hit(s)" in out
+
+
+def test_log_summary_sweeps_profile_captures(tmp_path, capsys):
+    """ISSUE 8: log-summary summarizes every profile-* capture dir under
+    the metrics dir through tools/analyze_trace.py."""
+    import gzip
+
+    from chunkflow_tpu.flow.log_summary import print_profile_summaries
+
+    capture = tmp_path / "profile-retrace-x-1" / "plugins" / "run"
+    capture.mkdir(parents=True)
+    with gzip.open(capture / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 7,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 7, "name": "fusion.1", "dur": 800},
+            {"ph": "X", "pid": 7, "name": "convolution.2", "dur": 200},
+        ]}, f)
+    (tmp_path / "profile-empty-2").mkdir()
+    print_profile_summaries(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "profile-retrace-x-1" in out
+    assert "fusion 80%" in out
+    assert "profile-empty-2: no trace files" in out

@@ -221,3 +221,84 @@ def test_http_server_roundtrip():
             assert resp.status == 200
     finally:
         server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# POST /profile (ISSUE 8: operator-requested bounded capture)
+# ---------------------------------------------------------------------------
+def test_profile_route_captures_bounded_window(clean_telemetry, tmp_path):
+    import glob
+
+    telemetry.configure(str(tmp_path))
+    svc = CoordinationService()
+    status, payload = svc.handle("POST", "/profile?seconds=0.1")
+    assert status == 200, payload
+    assert payload["trace_dir"].startswith(str(tmp_path))
+    assert payload["seconds"] == 0.1
+    assert glob.glob(payload["trace_dir"] + "/**/*.trace.json.gz",
+                     recursive=True)
+
+
+def test_profile_route_rejects_malformed_seconds(clean_telemetry,
+                                                 tmp_path):
+    telemetry.configure(str(tmp_path))
+    status, payload = CoordinationService().handle(
+        "POST", "/profile?seconds=soon")
+    assert status == 400
+
+
+def test_profile_route_refuses_concurrent_session(clean_telemetry,
+                                                  tmp_path, monkeypatch):
+    from chunkflow_tpu.core import profiling
+
+    telemetry.configure(str(tmp_path))
+    monkeypatch.setattr(profiling, "_TRACE_ACTIVE", True)
+    status, payload = CoordinationService().handle(
+        "POST", "/profile?seconds=0.1")
+    assert status == 409
+    assert "already active" in payload["error"]
+
+
+def test_profile_route_gone_under_kill_switch(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    status, payload = CoordinationService().handle(
+        "POST", "/profile?seconds=1")
+    assert status == 404
+
+
+def test_profile_route_over_http(clean_telemetry, tmp_path):
+    telemetry.configure(str(tmp_path))
+    server, _thread = serve(CoordinationService(), host="127.0.0.1",
+                            port=0, background=True)
+    try:
+        port = server.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/profile?seconds=0.1", method="POST")
+        with urllib.request.urlopen(req) as resp:
+            payload = json.loads(resp.read())
+        assert payload["trace_dir"].startswith(str(tmp_path))
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# achieved Mvox/s derivation (fleet-status satellite)
+# ---------------------------------------------------------------------------
+def test_achieved_mvox_s_derivation():
+    from chunkflow_tpu.parallel.restapi import achieved_mvox_s
+
+    # serial path: inference/infer carries the seconds
+    assert achieved_mvox_s({
+        "chunkflow_inference_voxels_total": 4e6,
+        "chunkflow_inference_infer_sum": 2.0,
+    }) == pytest.approx(2.0)
+    # pipelined path: dispatch + compute + drain carry them
+    assert achieved_mvox_s({
+        "chunkflow_inference_voxels_total": 3e6,
+        "chunkflow_pipeline_dispatch_sum": 0.5,
+        "chunkflow_pipeline_compute_sum": 0.25,
+        "chunkflow_pipeline_drain_sum": 0.25,
+    }) == pytest.approx(3.0)
+    # no voxel count yet: the figure is simply absent
+    assert achieved_mvox_s({"chunkflow_pipeline_compute_sum": 1.0}) is None
+    assert achieved_mvox_s({}) is None

@@ -396,3 +396,146 @@ def test_cached_result_prefers_per_row_commit(tmp_path, monkeypatch):
     assert cached["measured_at_commit"] == "rowlevel1"
     assert cached["measured_config"] == "x"
     assert cached["value"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# bench regression ledger (ISSUE 8): --ledger append + compare semantics
+# ---------------------------------------------------------------------------
+def _ledger_row(metric, value, cached=False, unit="x_serial",
+                commit="abc1234", config=None):
+    return {"t": 0.0, "commit": commit, "metric": metric, "value": value,
+            "unit": unit, "config": config, "cached": cached}
+
+
+def test_ledger_append_stamps_commit_config_cached(tmp_path, monkeypatch):
+    path = tmp_path / "ledger.jsonl"
+    monkeypatch.setattr(bench, "_LEDGER_FILE", str(path))
+    bench._append_ledger({"metric": "e2e_overlap_speedup", "value": 2.5,
+                          "unit": "x_serial", "gate_pass": True})
+    bench._append_ledger({
+        "metric": "affinity_inference_throughput", "value": 1.79,
+        "unit": "Mvoxel/s/chip", "config": "cached:bench_tpu",
+        "cached": True, "measured_at_commit": "deadbee",
+    })
+    rows = bench.load_ledger(str(path))
+    assert len(rows) == 2
+    fresh, cached = rows
+    assert fresh["metric"] == "e2e_overlap_speedup"
+    assert fresh["cached"] is False
+    assert fresh["commit"]  # stamped with the measured tree's commit
+    assert fresh["gate_pass"] is True
+    assert cached["cached"] is True
+    # a cached row keeps the commit the chip actually measured
+    assert cached["commit"] == "deadbee"
+
+
+def test_ledger_flag_consumed_by_main(tmp_path, monkeypatch, capsys):
+    """`bench.py compare --ledger=PATH` parses and reads that path."""
+    path = tmp_path / "ledger.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps(_ledger_row("m", 2.0)) + "\n")
+    monkeypatch.setattr(bench.sys, "argv",
+                        ["bench.py", "compare", f"--ledger={path}"])
+    assert bench.main() == 0
+    assert "1 row(s)" in capsys.readouterr().out
+
+
+def test_compare_flags_fresh_regression(tmp_path):
+    """Acceptance: a ledger seeded with two fresh entries flags an
+    injected 30% regression (hard, exit 4 through compare_main)."""
+    rows = [
+        _ledger_row("e2e_overlap_speedup", 2.0),
+        _ledger_row("e2e_overlap_speedup", 2.1),
+        _ledger_row("e2e_overlap_speedup", 1.4),  # ~32% below median 2.05
+    ]
+    report = bench.compare_ledger(rows, threshold_pct=25.0)
+    info = report["metrics"]["e2e_overlap_speedup"]
+    assert info["status"] == "regression"
+    assert info["baseline"] == pytest.approx(2.05)
+    assert info["delta_pct"] > 25
+    assert report["regressions"]
+
+    path = tmp_path / "ledger.jsonl"
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    assert bench.compare_main([f"--ledger={path}"]) == 4
+
+
+def test_compare_within_threshold_passes(tmp_path):
+    rows = [
+        _ledger_row("e2e_overlap_speedup", 2.0),
+        _ledger_row("e2e_overlap_speedup", 2.1),
+        _ledger_row("e2e_overlap_speedup", 1.9),  # ~7%: noise
+    ]
+    report = bench.compare_ledger(rows)
+    assert report["metrics"]["e2e_overlap_speedup"]["status"] == "ok"
+    assert not report["regressions"]
+
+
+def test_compare_refuses_cached_rows_as_baseline():
+    """Acceptance: cached: rows (the stale 1.79 headline shape) never
+    enter a baseline, loudly."""
+    rows = [
+        _ledger_row("affinity_inference_throughput", 1.79, cached=True,
+                    unit="Mvoxel/s/chip", commit="deadbee"),
+        _ledger_row("affinity_inference_throughput", 1.81, cached=True,
+                    unit="Mvoxel/s/chip", commit="deadbee"),
+        _ledger_row("affinity_inference_throughput", 1.20,
+                    unit="Mvoxel/s/chip"),
+    ]
+    report = bench.compare_ledger(rows)
+    info = report["metrics"]["affinity_inference_throughput"]
+    # 1.20 fresh vs 1.79/1.81 cached would read as a 33% regression —
+    # but cached rows measured OLD code, so there is NO baseline
+    assert info["status"] == "no-baseline"
+    assert info["refused_cached"] == 2
+    assert not report["regressions"]
+    assert any("REFUSING 2 cached row(s)" in w for w in report["warnings"])
+
+
+def test_compare_refuses_cached_current_row():
+    rows = [
+        _ledger_row("affinity_inference_throughput", 2.0,
+                    unit="Mvoxel/s/chip"),
+        _ledger_row("affinity_inference_throughput", 2.0,
+                    unit="Mvoxel/s/chip"),
+        _ledger_row("affinity_inference_throughput", 1.79, cached=True,
+                    unit="Mvoxel/s/chip", commit="deadbee"),
+    ]
+    report = bench.compare_ledger(rows)
+    info = report["metrics"]["affinity_inference_throughput"]
+    assert info["status"] == "cached-current"
+    assert not report["regressions"]
+    assert any("current row is cached" in w for w in report["warnings"])
+
+
+def test_compare_single_fresh_baseline_warns_only():
+    rows = [
+        _ledger_row("e2e_overlap_speedup", 2.0),
+        _ledger_row("e2e_overlap_speedup", 1.0),  # 50% down, 1 baseline
+    ]
+    report = bench.compare_ledger(rows)
+    assert report["metrics"]["e2e_overlap_speedup"]["status"] == "warn"
+    assert not report["regressions"]
+
+
+def test_compare_percentage_metrics_warn_only():
+    """Overhead gates (pct units) are noise-dominated on a loaded box:
+    even a big relative jump warns instead of hard-failing."""
+    rows = [
+        _ledger_row("telemetry_overhead", 1.0,
+                    unit="pct_of_untelemetered_wall"),
+        _ledger_row("telemetry_overhead", 1.2,
+                    unit="pct_of_untelemetered_wall"),
+        _ledger_row("telemetry_overhead", 5.0,
+                    unit="pct_of_untelemetered_wall"),
+    ]
+    report = bench.compare_ledger(rows)
+    assert report["metrics"]["telemetry_overhead"]["status"] == "warn"
+    assert not report["regressions"]
+
+
+def test_compare_empty_ledger_is_ok(tmp_path):
+    assert bench.compare_main(
+        [f"--ledger={tmp_path / 'missing.jsonl'}"]) == 0

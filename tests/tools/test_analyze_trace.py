@@ -1,0 +1,103 @@
+"""tools/analyze_trace.py (ISSUE 8 satellite): importable summarizer,
+robust on empty/missing dirs, --json output mode — over a tiny
+synthetic *.trace.json.gz fixture."""
+import gzip
+import json
+import os
+
+import pytest
+
+from tools.analyze_trace import (
+    categorize,
+    find_trace_files,
+    main,
+    summarize_trace_dir,
+)
+
+
+def write_trace(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+@pytest.fixture
+def trace_dir(tmp_path):
+    """Two trace files in nested dirs, with device-pid metadata: pid 7
+    is the TPU lane, pid 1 is host python frames that must be dropped
+    only when no device metadata exists (here it IS present, so the
+    filter is pid-based)."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 7,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "python host"}},
+        {"ph": "X", "pid": 7, "name": "fusion.42", "dur": 600},
+        {"ph": "X", "pid": 7, "name": "convolution.3", "dur": 300},
+        {"ph": "X", "pid": 7, "name": "convolution.3", "dur": 100},
+        {"ph": "X", "pid": 1, "name": "runner.py:12", "dur": 9999},
+    ]
+    write_trace(str(tmp_path / "a" / "host.trace.json.gz"), events)
+    write_trace(
+        str(tmp_path / "b" / "host.trace.json.gz"),
+        [{"ph": "M", "name": "process_name", "pid": 7,
+          "args": {"name": "/device:TPU:0"}},
+         {"ph": "X", "pid": 7, "name": "dynamic-update-slice.1",
+          "dur": 500}],
+    )
+    return tmp_path
+
+
+def test_find_and_summarize(trace_dir):
+    assert len(find_trace_files(str(trace_dir))) == 2
+    summary = summarize_trace_dir(str(trace_dir), top=10)
+    assert summary["files"] == 2
+    # host pid 9999us excluded: 600 + 300 + 100 + 500
+    assert summary["total_device_us"] == 1500
+    cats = {row["category"]: row for row in summary["categories"]}
+    assert cats["fusion"]["us"] == 600
+    assert cats["convolution"]["us"] == 400
+    assert cats["gather/slice"]["us"] == 500
+    ops = {row["name"]: row for row in summary["top_ops"]}
+    assert ops["convolution.3"]["count"] == 2
+    assert abs(sum(r["share"] for r in summary["categories"]) - 1.0) < 1e-9
+
+
+def test_categorize_rules():
+    assert categorize("fusion.12") == "fusion"
+    assert categorize("loop_convolution_fusion") == "convolution"
+    assert categorize("all-reduce.1") == "reduce"
+    assert categorize("some-op") == "other"
+
+
+def test_empty_dir_warns_instead_of_crashing(tmp_path, capsys):
+    rc = main([str(tmp_path / "nowhere")])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "warning: no *.trace.json.gz" in captured.err
+
+
+def test_json_output_mode(trace_dir, capsys):
+    rc = main([str(trace_dir), "--json", "--top", "2"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    summary = json.loads(captured.out)
+    assert summary["files"] == 2
+    assert len(summary["top_ops"]) == 2
+    assert summary["total_device_us"] == 1500
+
+
+def test_json_output_empty_dir(tmp_path, capsys):
+    rc = main([str(tmp_path), "--json"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert json.loads(captured.out)["files"] == 0
+
+
+def test_corrupt_trace_file_is_skipped(trace_dir):
+    bad = trace_dir / "c" / "bad.trace.json.gz"
+    os.makedirs(bad.parent)
+    bad.write_bytes(b"not gzip at all")
+    summary = summarize_trace_dir(str(trace_dir))
+    assert summary["files"] == 3  # counted as present...
+    assert summary["total_device_us"] == 1500  # ...but contributes nothing

@@ -8,7 +8,15 @@ device time, grouped by XLA op category (convolution / fusion / copy /
 all-reduce / ...), with per-category totals. That attribution is what
 decides the next forward-pass lever (VERDICT r2 item 3).
 
-Usage: python tools/analyze_trace.py [trace_dir] [--top N]
+Since PR 8 this is also the summarizer for the device-performance
+plane's bounded captures (core/profiling.py: windowed ``--profile-dir``
+runs, anomaly captures, the ``POST /profile`` route): importable
+(:func:`summarize_trace_dir`), machine-readable (``--json``), and an
+empty or missing trace dir is a warning, not a crash — ``log-summary``
+calls through here for every ``profile-*`` dir it finds under a
+metrics dir.
+
+Usage: python tools/analyze_trace.py [trace_dir] [--top N] [--json]
 """
 from __future__ import annotations
 
@@ -19,6 +27,7 @@ import gzip
 import json
 import os
 import re
+import sys
 
 
 def find_trace_files(trace_dir: str):
@@ -81,43 +90,89 @@ def device_op_durations(events):
     return durations, counts
 
 
-def main():
+def summarize_trace_dir(trace_dir: str, top: int = 25) -> dict:
+    """Aggregate every ``*.trace.json.gz`` under ``trace_dir`` (an
+    empty or missing dir yields ``files == 0``, never raises)::
+
+        {"trace_dir": ..., "files": n, "total_device_us": x,
+         "categories": [{"category", "us", "share"}, ...],   # sorted
+         "top_ops": [{"name", "us", "share", "count"}, ...]}
+    """
+    files = find_trace_files(trace_dir)
+    durations = collections.Counter()
+    counts = collections.Counter()
+    for path in files:
+        try:
+            d, c = device_op_durations(load_events(path))
+        except (OSError, ValueError):
+            continue  # a torn/corrupt trace file is skippable evidence
+        durations.update(d)
+        counts.update(c)
+    total_us = sum(durations.values())
+    by_cat = collections.Counter()
+    for name, dur in durations.items():
+        by_cat[categorize(name)] += dur
+    return {
+        "trace_dir": trace_dir,
+        "files": len(files),
+        "total_device_us": total_us,
+        "categories": [
+            {"category": cat, "us": dur,
+             "share": dur / total_us if total_us else 0.0}
+            for cat, dur in by_cat.most_common()
+        ],
+        "top_ops": [
+            {"name": name, "us": dur,
+             "share": dur / total_us if total_us else 0.0,
+             "count": counts[name]}
+            for name, dur in durations.most_common(top)
+        ],
+    }
+
+
+def print_summary(summary: dict) -> None:
+    """Human rendering of a :func:`summarize_trace_dir` result."""
+    print(f"{summary['files']} trace file(s); total device-op time "
+          f"{summary['total_device_us'] / 1e3:.2f} ms\n")
+    print("== by category ==")
+    for row in summary["categories"]:
+        print(f"{row['us'] / 1e3:10.2f} ms  {100 * row['share']:5.1f}%"
+              f"  {row['category']}")
+    print(f"\n== top {len(summary['top_ops'])} ops ==")
+    for row in summary["top_ops"]:
+        print(f"{row['us'] / 1e3:10.2f} ms  {100 * row['share']:5.1f}%"
+              f"  x{row['count']:<5d} {row['name'][:90]}")
+
+
+def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "trace_dir", nargs="?",
         default=os.path.join(os.path.dirname(__file__), "profile_r03"),
     )
     parser.add_argument("--top", type=int, default=25)
-    args = parser.parse_args()
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the summary as one JSON object (log-summary "
+             "consumption) instead of the human tables",
+    )
+    args = parser.parse_args(argv)
 
-    files = find_trace_files(args.trace_dir)
-    if not files:
-        raise SystemExit(f"no *.trace.json.gz under {args.trace_dir}")
-
-    durations = collections.Counter()
-    counts = collections.Counter()
-    for path in files:
-        d, c = device_op_durations(load_events(path))
-        durations.update(d)
-        counts.update(c)
-
-    total_us = sum(durations.values())
-    print(f"{len(files)} trace file(s); total device-op time "
-          f"{total_us / 1e3:.2f} ms\n")
-
-    by_cat = collections.Counter()
-    for name, dur in durations.items():
-        by_cat[categorize(name)] += dur
-    print("== by category ==")
-    for cat, dur in by_cat.most_common():
-        print(f"{dur / 1e3:10.2f} ms  {100 * dur / max(total_us, 1):5.1f}%"
-              f"  {cat}")
-
-    print(f"\n== top {args.top} ops ==")
-    for name, dur in durations.most_common(args.top):
-        print(f"{dur / 1e3:10.2f} ms  {100 * dur / max(total_us, 1):5.1f}%"
-              f"  x{counts[name]:<5d} {name[:90]}")
+    summary = summarize_trace_dir(args.trace_dir, top=args.top)
+    if summary["files"] == 0:
+        # a missing/empty dir is an answer (nothing captured here), not
+        # a crash: log-summary sweeps every profile-* candidate dir
+        print(f"warning: no *.trace.json.gz under {args.trace_dir}",
+              file=sys.stderr)
+        if args.json:
+            print(json.dumps(summary))
+        return 0
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print_summary(summary)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())
