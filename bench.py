@@ -1163,6 +1163,112 @@ def run_resilience_overhead(
     }
 
 
+def run_serving_throughput(
+    n_requests: int = 16,
+    rounds: int = 3,
+) -> dict:
+    """Packed cross-request batching vs sequential per-chunk execution
+    on many small concurrent requests (ISSUE 9, CI gate): each request
+    carries 3 patches against a device batch of 8, so the per-chunk
+    fused program runs every forward batch at 37.5% occupancy while the
+    packer fills batches across requests. Gate: >= 1.3x wall-clock
+    speedup (reported as ``gate_pass``); the process only fails below
+    1.1x — the packer lost its occupancy win outright.
+
+    The engine is a calibrated matmul tower (same compiled work per
+    batch on either path), so the speedup measured is occupancy, not
+    engine luck; correctness is asserted bitwise against the per-chunk
+    reference on every round."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.inference import Inferencer, engines
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    pin = (4, 16, 16)
+    features = int(np.prod(pin))
+    rng = np.random.default_rng(0)
+    weights = jnp.asarray(
+        rng.standard_normal((features, features)).astype(np.float32)
+        / np.sqrt(features)
+    )
+
+    def apply(params, batch):
+        x = batch.reshape(batch.shape[0], -1)
+        # enough compiled work per batch (~ms) that the measured ratio
+        # is forward-batch count — i.e. occupancy — not dispatch noise
+        for _ in range(8):
+            x = jnp.tanh(x @ params)
+        return x.reshape((batch.shape[0], 1) + pin)
+
+    inferencer = Inferencer(
+        input_patch_size=pin,
+        num_output_channels=1,
+        framework="prebuilt",
+        engine=engines.Engine(
+            params=weights, apply=apply,
+            num_input_channels=1, num_output_channels=1,
+        ),
+        batch_size=8,
+        crop_output_margin=False,
+    )
+    # (4, 16, 48) with zero overlap -> exactly 3 patches per request:
+    # the per-chunk path pads every forward batch 3/8 full
+    chunks = [
+        Chunk(rng.random((4, 16, 48), dtype=np.float32),
+              voxel_offset=(i * 8, 0, 0))
+        for i in range(n_requests)
+    ]
+    refs = [np.asarray(inferencer(c).array) for c in chunks]  # + warmup
+    packer = PatchPacker(inferencer, max_wait_ms=4.0)
+    np.asarray(packer.infer(chunks[0]).array)  # warm the serve programs
+
+    telemetry.reset()
+    seq_s = packed_s = None
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        outs = [np.asarray(inferencer(c).array) for c in chunks]
+        dt = time.perf_counter() - t0
+        seq_s = dt if seq_s is None else min(seq_s, dt)
+        for ref, out in zip(refs, outs):
+            if not np.array_equal(ref, out):
+                raise RuntimeError("serving bench: per-chunk round "
+                                   "diverged from reference")
+        t0 = time.perf_counter()
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=120).array) for h in handles]
+        dt = time.perf_counter() - t0
+        packed_s = dt if packed_s is None else min(packed_s, dt)
+        for ref, out in zip(refs, outs):
+            if not np.array_equal(ref, out):
+                raise RuntimeError(
+                    "serving bench: packed output NOT bit-identical to "
+                    "the per-chunk path")
+    packer.close()
+    snap = telemetry.snapshot()
+    batches = snap["counters"].get("serving/batches", 0)
+    packed_patches = snap["counters"].get("serving/packed_patches", 0)
+    occupancy = (packed_patches / (batches * inferencer.batch_size)
+                 if batches else 0.0)
+    telemetry.reset()
+    speedup = seq_s / packed_s if packed_s else 0.0
+    return {
+        "metric": "serving_throughput",
+        "value": round(speedup, 3),
+        "unit": "x_packed_vs_per_chunk",
+        "seq_s": round(seq_s, 3),
+        "packed_s": round(packed_s, 3),
+        "requests": n_requests * rounds,
+        "patches_per_request": 3,
+        "batch_size": inferencer.batch_size,
+        "packed_occupancy": round(occupancy, 3),
+        "gate_x": 1.3,
+        "gate_pass": speedup >= 1.3,
+        "bit_identical": True,
+    }
+
+
 def run_fleet_smoke(n_tasks: int = 6) -> dict:
     """Chaos smoke of the fleet supervisor (ISSUE 7, CI gate): a REAL
     multi-process fleet drains a small volume while one worker is
@@ -1648,6 +1754,7 @@ def main() -> int:
     if len(sys.argv) > 1 and sys.argv[1] in (
         "pipeline_overlap", "telemetry_overhead", "e2e_overlap",
         "resilience_overhead", "export_overhead", "fleet_smoke",
+        "serving_throughput",
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -1678,6 +1785,14 @@ def main() -> int:
             # (every task exactly once despite a SIGKILL and a drill)
             # or run_fleet_smoke raises and the process exits nonzero
             return _emit(run_fleet_smoke())
+        if sys.argv[1] == "serving_throughput":
+            result = run_serving_throughput()
+            _emit(result)
+            # soft gate at the 1.3x target (reported as gate_pass,
+            # asserted in tests/test_bench.py); hard floor at 1.1x —
+            # below that the packer lost its occupancy win outright
+            # (bit-identity is asserted inside, raising on divergence)
+            return 0 if result["value"] >= 1.1 else 4
         if sys.argv[1] == "export_overhead":
             result = run_export_overhead()
             _emit(result)

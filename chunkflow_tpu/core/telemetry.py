@@ -76,6 +76,8 @@ __all__ = [
     "span", "event", "snapshot", "flush", "reset", "summary_table",
     "hist_totals", "worker_id", "task_context", "current_trace_id",
     "snapshot_interval", "add_flush_hook", "add_reset_hook",
+    "observe_quantile", "quantile", "quantile_from_buckets",
+    "QUANTILE_BOUNDS",
 ]
 
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -186,6 +188,47 @@ def _max_sink_bytes() -> int:
     return int(mb * (1 << 20))
 
 
+#: Upper bucket bounds (seconds) of the quantile histograms — log-spaced
+#: from 1 ms to 2 min, with an implicit +inf overflow bucket. Chosen for
+#: request-latency distributions (docs/serving.md): a serving p50 of a
+#: few ms and a p99 of seconds both land mid-range. Fixed bounds (not
+#: per-process sketches) are what make bucket counts summable across
+#: workers in ``log-summary --fleet`` and renderable as a Prometheus
+#: ``histogram`` (parallel/restapi.py).
+QUANTILE_BOUNDS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+
+def quantile_from_buckets(qhist: dict, q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile (0..1) from a snapshot-form quantile
+    histogram ``{"count": n, "buckets": [..per-bound.., overflow]}`` by
+    linear interpolation inside the covering bucket. Returns None for an
+    empty histogram; the overflow bucket reports its lower bound (the
+    estimate saturates at the largest tracked bound). Shared by
+    ``log-summary`` (merged multi-worker buckets) and live reporting so
+    every p50/p99 figure is computed one way."""
+    count = qhist.get("count", 0)
+    buckets = qhist.get("buckets") or []
+    if not count or not buckets:
+        return None
+    rank = q * count
+    seen = 0.0
+    lower = 0.0
+    for i, n in enumerate(buckets):
+        upper = (QUANTILE_BOUNDS[i] if i < len(QUANTILE_BOUNDS)
+                 else QUANTILE_BOUNDS[-1])
+        if n and seen + n >= rank:
+            if i >= len(QUANTILE_BOUNDS):
+                return QUANTILE_BOUNDS[-1]  # overflow: saturate
+            frac = (rank - seen) / n
+            return lower + frac * (upper - lower)
+        seen += n
+        lower = upper
+    return QUANTILE_BOUNDS[-1]
+
+
 class _Registry:
     """Process-global metric state + optional JSONL sink. All mutation is
     behind one lock; the disabled path never takes it."""
@@ -196,6 +239,8 @@ class _Registry:
         self.gauges: Dict[str, float] = {}
         # name -> [count, total, min, max]
         self.hists: Dict[str, list] = {}
+        # name -> [count, total, min, max, [bucket counts + overflow]]
+        self.qhists: Dict[str, list] = {}
         self.sink = None
         self.sink_path: Optional[str] = None
         self.sink_bytes = 0
@@ -220,6 +265,25 @@ class _Registry:
                 h[1] += value
                 h[2] = min(h[2], value)
                 h[3] = max(h[3], value)
+
+    def add_qhist(self, name: str, value: float) -> None:
+        with self.lock:
+            h = self.qhists.get(name)
+            if h is None:
+                h = self.qhists[name] = [
+                    0, 0.0, value, value,
+                    [0] * (len(QUANTILE_BOUNDS) + 1),
+                ]
+            h[0] += 1
+            h[1] += value
+            h[2] = min(h[2], value)
+            h[3] = max(h[3], value)
+            for i, bound in enumerate(QUANTILE_BOUNDS):
+                if value <= bound:
+                    h[4][i] += 1
+                    break
+            else:
+                h[4][-1] += 1  # overflow
 
     # -- sink ----------------------------------------------------------
     def emit(self, payload: dict) -> None:
@@ -331,6 +395,31 @@ def observe(name: str, value: float) -> None:
     _REG.add_hist(name, value)
 
 
+def observe_quantile(name: str, value: float) -> None:
+    """Fold a sample (seconds) into a fixed-bound quantile histogram —
+    the p50/p99 substrate for request latencies (docs/serving.md).
+    Bucket counts ride the snapshot event (summable across workers) and
+    render as a Prometheus ``histogram`` on ``/metrics``; no per-sample
+    event is emitted."""
+    if not enabled():
+        return
+    _REG.add_qhist(name, value)
+
+
+def quantile(name: str, q: float) -> Optional[float]:
+    """Live ``q``-quantile estimate (seconds) of a quantile histogram in
+    this process's registry; None when the histogram has no samples (or
+    telemetry is off)."""
+    if not enabled():
+        return None
+    with _REG.lock:
+        h = _REG.qhists.get(name)
+        if h is None:
+            return None
+        snap = {"count": h[0], "buckets": list(h[4])}
+    return quantile_from_buckets(snap, q)
+
+
 def event(kind: str, name: str, **attrs) -> None:
     """Emit a free-form event line (sink configured and telemetry on)."""
     if not enabled() or _REG.sink is None:
@@ -411,7 +500,10 @@ def hist_totals(names) -> Dict[str, float]:
 def snapshot() -> dict:
     """Copy of all aggregated metrics:
     ``{"counters": {...}, "gauges": {...}, "hists": {name:
-    {"count", "total", "min", "max", "mean"}}}``."""
+    {"count", "total", "min", "max", "mean"}}, "qhists": {name:
+    {"count", "total", "min", "max", "buckets"}}}`` (``qhists`` only
+    when quantile histograms were recorded — older streams stay
+    schema-stable)."""
     with _REG.lock:
         hists = {
             name: {
@@ -423,11 +515,23 @@ def snapshot() -> dict:
             }
             for name, h in _REG.hists.items()
         }
-        return {
+        snap = {
             "counters": dict(_REG.counters),
             "gauges": dict(_REG.gauges),
             "hists": hists,
         }
+        if _REG.qhists:
+            snap["qhists"] = {
+                name: {
+                    "count": h[0],
+                    "total": h[1],
+                    "min": h[2],
+                    "max": h[3],
+                    "buckets": list(h[4]),
+                }
+                for name, h in _REG.qhists.items()
+            }
+        return snap
 
 
 # Layer hooks: other observability planes (core/profiling.py's program
@@ -489,6 +593,7 @@ def reset() -> None:
         _REG.counters.clear()
         _REG.gauges.clear()
         _REG.hists.clear()
+        _REG.qhists.clear()
         if _REG.sink is not None:
             try:
                 _REG.sink.close()
@@ -521,6 +626,19 @@ def summary_table() -> str:
             lines.append(
                 f"  {name:<28} {h['count']:>7} {h['total']:>9.3f} "
                 f"{h['mean']:>9.4f} {h['max']:>9.4f}"
+            )
+    if snap.get("qhists"):
+        lines.append(
+            f"  {'latency hist':<28} {'count':>7} {'p50_s':>9} {'p99_s':>9}"
+        )
+        for name in sorted(snap["qhists"]):
+            h = snap["qhists"][name]
+            p50 = quantile_from_buckets(h, 0.5)
+            p99 = quantile_from_buckets(h, 0.99)
+            lines.append(
+                f"  {name:<28} {h['count']:>7} "
+                f"{p50 if p50 is not None else 0.0:>9.4f} "
+                f"{p99 if p99 is not None else 0.0:>9.4f}"
             )
     if snap["counters"]:
         lines.append(f"  {'counter':<28} {'value':>7}")

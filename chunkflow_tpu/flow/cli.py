@@ -154,9 +154,23 @@ def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
     state.metrics_server = (
         start_metrics_exporter(port) if port is not None else None
     )
-    if state.metrics_server is not None and verbose:
-        host, bound = state.metrics_server.server_address[:2]
-        print(f"metrics exporter: http://{host}:{bound}/metrics")
+    if state.metrics_server is not None:
+        from chunkflow_tpu.parallel.restapi import (
+            bound_port,
+            write_endpoint_file,
+        )
+
+        bound = bound_port(state.metrics_server)
+        if metrics_dir:
+            # publish the actually-bound port so a supervisor that
+            # spawned us with --metrics-port 0 (ephemeral; no port
+            # collisions between workers on one host) can find us
+            write_endpoint_file(metrics_dir, metrics_port=bound)
+        if verbose or port == 0:
+            # a requested port 0 MUST be reported — nothing else tells
+            # the operator where the listener landed
+            host = state.metrics_server.server_address[0]
+            print(f"metrics exporter: http://{host}:{bound}/metrics")
 
 
 def _print_run_telemetry(verbose: int) -> None:
@@ -885,6 +899,23 @@ def fleet_status_cmd(queue_name, workers, timeout, fleet_state):
             if mvox is not None:
                 line += f" achieved={mvox:.2f} Mvox/s"
             print(line)
+            serving = sample.get("serving")
+            if serving:
+                # the SERVING block: request-path health next to the
+                # batch-path stats (docs/serving.md)
+                def ms(value):
+                    return ("?" if value is None
+                            else f"{value * 1e3:.1f}ms")
+
+                print(
+                    f"  serving: in-flight={serving['inflight']:g} "
+                    f"requests={serving['requests']:g} "
+                    f"completed={serving['completed']:g} "
+                    f"p50={ms(serving['p50_s'])} "
+                    f"p99={ms(serving['p99_s'])} "
+                    f"rejects={serving['rejects']:g} "
+                    f"deadline-misses={serving['deadline_missed']:g}"
+                )
         return
         yield  # pragma: no cover
 
@@ -1014,6 +1045,180 @@ def fleet_run_cmd(queue_name, worker_args_str, min_workers, max_workers,
         )
         if supervisor.state_path:
             print(f"fleet state: {supervisor.state_path}")
+        return
+        yield  # pragma: no cover
+
+    return stage()
+
+
+@main.command("serve")
+@click.option("--port", type=int, default=0,
+              help="HTTP listener port; 0 (default) binds an ephemeral "
+                   "port and prints it — multiple servers on one host "
+                   "never collide")
+@click.option("--host", type=str, default="0.0.0.0")
+@cartesian_option("--input-patch-size", "-p", "-s", default=None,
+                  help="required unless --spool (external workers own "
+                       "the model there)")
+@cartesian_option("--output-patch-size", "-z", default=None)
+@cartesian_option("--output-patch-overlap", default=(0, 0, 0))
+@click.option("--num-output-channels", "-c", type=int, default=3)
+@click.option("--num-input-channels", type=int, default=1)
+@click.option(
+    "--framework", "-f",
+    type=click.Choice(["identity", "flax", "jax", "pytorch", "universal"]),
+    default="flax",
+)
+@click.option("--model-path", "-m", type=str, default="")
+@click.option("--weight-path", "-w", type=str, default=None)
+@click.option("--batch-size", "-b", type=int, default=4)
+@click.option("--output-dtype",
+              type=click.Choice(["float32", "bfloat16", "uint8"]),
+              default="float32")
+@click.option("--crop-output-margin/--no-crop-output-margin", default=True)
+@cartesian_option("--shape-bucket", default=None,
+                  help="bucket request shapes so ragged traffic shares "
+                       "compiled programs (strongly recommended for "
+                       "mixed-size serving)")
+@click.option("--serve-workers", type=int, default=2,
+              help="in-process lifecycle worker threads claiming "
+                   "requests (local mode)")
+@click.option("--max-inflight", type=int, default=8,
+              help="admission control: concurrent requests past this "
+                   "are rejected 429, not queued to death")
+@click.option("--default-deadline-s", type=float, default=30.0,
+              help="per-request deadline when the request does not "
+                   "carry one; a missed deadline is a clean 504 + "
+                   "serving/deadline_missed, never worker death")
+@click.option("--max-retries", type=int, default=2,
+              help="lifecycle retry budget per request (transient "
+                   "compute failures retry with backoff; past the "
+                   "budget the request dead-letters and fails cleanly)")
+@click.option("--max-wait-ms", type=float, default=2.0,
+              help="how long a partial device batch waits for more "
+                   "cross-request patches before dispatching underfull "
+                   "(the latency/occupancy knob, docs/serving.md)")
+@click.option("--spool", type=str, default=None,
+              help="spool-mode serving: requests land in <dir>/in + a "
+                   "file queue and EXTERNAL supervised workers complete "
+                   "them (preemptible, fleet-scalable); this process "
+                   "serves HTTP only")
+@click.option("--visibility-timeout", "-v", type=int, default=30,
+              help="request lease timeout: a worker (thread or "
+                   "process) that dies mid-request loses the lease and "
+                   "the request is redelivered")
+@click.option("--max-runtime", type=float, default=None,
+              help="exit after this many seconds (tests/drills); "
+                   "default: run until SIGTERM/SIGINT")
+def serve_cmd(port, host, input_patch_size, output_patch_size,
+              output_patch_overlap, num_output_channels,
+              num_input_channels, framework, model_path, weight_path,
+              batch_size, output_dtype, crop_output_margin, shape_bucket,
+              serve_workers, max_inflight, default_deadline_s,
+              max_retries, max_wait_ms, spool, visibility_timeout,
+              max_runtime):
+    """Serve ``POST /infer`` requests with continuous cross-request
+    patch batching (docs/serving.md).
+
+    Each request is a TASK: leased, retried on transient failures,
+    committed exactly once through a completion ledger
+    (docs/fault_tolerance.md), and its patches share fixed device
+    batches with every other in-flight request's
+    (chunkflow_tpu/serve/packer.py). Admission control and per-request
+    deadlines shed overload as clean 429/504 responses; backpressure is
+    the adaptive scheduler's host-memory watermark
+    (CHUNKFLOW_SCHED_MEM_GB). ``/metrics``, ``/healthz`` and
+    ``/profile`` ride the same listener. CHUNKFLOW_SERVE=0 disables the
+    packer (requests run the per-chunk path, bit-identically)."""
+
+    @generator
+    def stage(task):
+        import os
+        import time as _time
+
+        from chunkflow_tpu.core import telemetry
+        from chunkflow_tpu.parallel.restapi import (
+            bound_port,
+            write_endpoint_file,
+        )
+        from chunkflow_tpu.serve.frontend import (
+            AdmissionController,
+            LocalBackend,
+            ServingService,
+            SpoolBackend,
+            start_serving,
+        )
+
+        if spool is None:
+            if input_patch_size is None or not any(input_patch_size):
+                raise click.UsageError(
+                    "serve needs --input-patch-size (or --spool for "
+                    "external-worker mode)")
+            from chunkflow_tpu.inference import Inferencer
+
+            inferencer = Inferencer(
+                input_patch_size=input_patch_size,
+                output_patch_size=(
+                    output_patch_size
+                    if output_patch_size and any(output_patch_size)
+                    else None),
+                output_patch_overlap=output_patch_overlap,
+                num_output_channels=num_output_channels,
+                num_input_channels=num_input_channels,
+                framework=framework,
+                model_path=model_path,
+                weight_path=weight_path,
+                batch_size=batch_size,
+                output_dtype=output_dtype,
+                crop_output_margin=crop_output_margin,
+                shape_bucket=shape_bucket,
+                dry_run=state.dry_run,
+            )
+            backend = LocalBackend(
+                inferencer, workers=serve_workers, max_retries=max_retries,
+                max_wait_ms=max_wait_ms,
+                visibility_timeout=visibility_timeout,
+            )
+        else:
+            backend = SpoolBackend(
+                spool, visibility_timeout=visibility_timeout)
+        admission = AdmissionController(max_inflight=max_inflight)
+        service = ServingService(
+            backend, admission=admission,
+            default_deadline_s=default_deadline_s,
+        )
+        server = start_serving(service, host=host, port=port)
+        actual = bound_port(server)
+        # port 0 is the default: ALWAYS report where we landed, and
+        # publish it next to the telemetry stream for supervisors
+        print(f"serving: http://{host}:{actual}/infer "
+              f"(mode={'spool' if spool else 'local'})", flush=True)
+        if telemetry.configured_path():
+            write_endpoint_file(
+                os.path.dirname(telemetry.configured_path()),
+                serving_port=actual)
+        deadline = (
+            _time.time() + max_runtime if max_runtime is not None
+            else None)
+        try:
+            while deadline is None or _time.time() < deadline:
+                _time.sleep(0.2)
+        except (KeyboardInterrupt, SystemExit):
+            print("serve: draining on preemption signal", flush=True)
+        finally:
+            # graceful drain: stop admitting, finish in-flight, then
+            # close the listener — rejected requests saw clean 429s
+            admission.drain()
+            server.shutdown()
+            server.server_close()
+            backend.close()
+            stats = service.serving_stats()
+            print(
+                f"serve drained: {stats['requests']:g} request(s), "
+                f"{stats['completed']:g} completed, "
+                f"{stats['rejected_admission'] + stats['rejected_memory']:g}"
+                f" rejected, {stats['deadline_missed']:g} deadline "
+                f"miss(es), {stats['errors']:g} error(s)")
         return
         yield  # pragma: no cover
 

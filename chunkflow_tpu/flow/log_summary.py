@@ -55,6 +55,18 @@ FLEET_COUNTERS = (
     "fleet/crash_backoffs",
 )
 
+#: serving-plane counters (chunkflow_tpu/serve/, docs/serving.md),
+#: reported as their own block: under request traffic, "how many
+#: requests were admitted / shed / late and how full the device batches
+#: ran" is the serving story
+SERVING_COUNTERS = (
+    "serving/requests", "serving/admitted", "serving/completed",
+    "serving/rejected_admission", "serving/rejected_memory",
+    "serving/rejected_duplicate", "serving/deadline_missed",
+    "serving/errors", "serving/packer_errors", "serving/fallbacks",
+    "serving/batches", "serving/packed_patches", "serving/filler_slots",
+)
+
 
 def load_log_dir(log_dir: str) -> List[dict]:
     records = []
@@ -240,9 +252,23 @@ def summarize_telemetry(events: List[dict]) -> dict:
             snapshots_by_pid[_event_worker(record)] = record
 
     counters: dict = {}
+    qhists: dict = {}
     for snap in snapshots_by_pid.values():
         for name, value in (snap.get("counters") or {}).items():
             counters[name] = counters.get(name, 0) + value
+        for name, h in (snap.get("qhists") or {}).items():
+            # fixed-bound bucket counts sum exactly across workers —
+            # the property that makes fleet-wide p50/p99 well-defined
+            agg_h = qhists.setdefault(
+                name, {"count": 0, "total": 0.0,
+                       "buckets": [0] * len(h.get("buckets") or [])})
+            agg_h["count"] += h.get("count", 0)
+            agg_h["total"] += h.get("total", 0.0)
+            for i, n in enumerate(h.get("buckets") or []):
+                if i < len(agg_h["buckets"]):
+                    agg_h["buckets"][i] += n
+                else:
+                    agg_h["buckets"].append(n)
         for name, value in (snap.get("gauges") or {}).items():
             # snapshot gauges fill holes for streams with no gauge-level
             # events (a worker killed before any sink was configured, or
@@ -284,7 +310,7 @@ def summarize_telemetry(events: List[dict]) -> dict:
     }
     return {"spans": spans, "counters": counters, "gauges": gauges,
             "stall": stall, "depth_changes": depth_changes,
-            "programs": programs}
+            "programs": programs, "qhists": qhists}
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +423,48 @@ def print_profile_summaries(metrics_dir: str, top: int = 3) -> None:
         )
 
 
+def print_serving_block(agg: dict, indent: str = "") -> bool:
+    """The SERVING block (docs/serving.md): request counters, in-flight
+    level, mean device-batch occupancy and the p50/p99 request latency
+    from the fleet-summed quantile-histogram buckets. Fed purely from
+    the existing JSONL/registry plumbing; quiet (returns False) for
+    runs that served no requests."""
+    from chunkflow_tpu.core import telemetry as _telemetry
+
+    serving = {
+        name: agg["counters"][name]
+        for name in SERVING_COUNTERS if agg["counters"].get(name)
+    }
+    if not serving:
+        return False
+    print(f"{indent}serving (docs/serving.md):")
+    for name in SERVING_COUNTERS:
+        if name in serving:
+            print(f"{indent}  {name:<28} {serving[name]:>7g}")
+    inflight = agg["gauges"].get("serving/inflight")
+    occupancy = agg["gauges"].get("serving/occupancy")
+    parts = []
+    if inflight is not None:
+        parts.append(f"in-flight last {inflight['last']:g}")
+    if occupancy is not None:
+        parts.append(f"batch occupancy mean {occupancy['mean']:.0%}")
+    latency = (agg.get("qhists") or {}).get("serving/latency")
+    if latency:
+        p50 = _telemetry.quantile_from_buckets(latency, 0.5)
+        p99 = _telemetry.quantile_from_buckets(latency, 0.99)
+        if p50 is not None:
+            parts.append(f"latency p50 {p50 * 1e3:.1f}ms "
+                         f"p99 {p99 * 1e3:.1f}ms")
+    if parts:
+        print(f"{indent}  -> " + ", ".join(parts))
+    if serving.get("serving/deadline_missed") or (
+            serving.get("serving/rejected_admission")
+            or serving.get("serving/rejected_memory")):
+        print(f"{indent}  -> shedding load: raise --max-inflight / the "
+              f"memory watermark, or add serving workers")
+    return True
+
+
 def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
     """Human report over a metrics dir; returns the aggregate (None when
     the dir holds no events — e.g. the run had CHUNKFLOW_TELEMETRY=0)."""
@@ -431,6 +499,7 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 "  -> dead-lettered tasks pending triage: inspect with "
                 "`chunkflow dead-letter -q <queue>`"
             )
+    print_serving_block(agg)
     fleet = {
         name: agg["counters"][name]
         for name in FLEET_COUNTERS if agg["counters"].get(name)
@@ -531,6 +600,7 @@ def summarize_fleet(events: List[dict]) -> dict:
             if agg["stall"] else None
         )
         device_mem = agg["gauges"].get("device/bytes_in_use")
+        latency = (agg.get("qhists") or {}).get("serving/latency")
         fleet[worker] = {
             "spans": agg["spans"],
             "counters": counters,
@@ -546,6 +616,11 @@ def summarize_fleet(events: List[dict]) -> dict:
             "device_bytes_in_use": (
                 device_mem["last"] if device_mem else None
             ),
+            "serving_requests": counters.get("serving/requests", 0),
+            "serving_completed": counters.get("serving/completed", 0),
+            "serving_deadline_missed": counters.get(
+                "serving/deadline_missed", 0),
+            "serving_latency": latency,
         }
     return fleet
 
@@ -594,6 +669,20 @@ def print_fleet_summary(metrics_dir: str,
             print(f"    -> dominant phase: {info['dominant']}")
         if info["cache_hit_rate"] is not None:
             print(f"  cache hit rate: {100 * info['cache_hit_rate']:.1f}%")
+        if info.get("serving_requests"):
+            from chunkflow_tpu.core import telemetry as _telemetry
+
+            line = (f"  serving: requests={info['serving_requests']:g} "
+                    f"completed={info['serving_completed']:g} "
+                    f"deadline-misses={info['serving_deadline_missed']:g}")
+            latency = info.get("serving_latency")
+            if latency:
+                p50 = _telemetry.quantile_from_buckets(latency, 0.5)
+                p99 = _telemetry.quantile_from_buckets(latency, 0.99)
+                if p50 is not None:
+                    line += (f" p50={p50 * 1e3:.1f}ms "
+                             f"p99={p99 * 1e3:.1f}ms")
+            print(line)
         if info["device_bytes_in_use"] is not None:
             print(
                 f"  device memory in use: "

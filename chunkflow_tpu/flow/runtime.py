@@ -280,7 +280,17 @@ def prefetch_stage(depth: int = 2, to_device: bool = False) -> Callable:
                     if to_device and task is not None:
                         task = _stage_chunks(task)
                     if not put(task):
-                        return  # consumer gone: stop pulling upstream
+                        # consumer gone mid-pull: a supervised task
+                        # claimed after the failure handler's in-flight
+                        # snapshot must be handed back, not dropped —
+                        # a silently leaked lease loses the task until
+                        # the visibility timeout
+                        from chunkflow_tpu.parallel.lifecycle import (
+                            surrender_task,
+                        )
+
+                        surrender_task(task)
+                        return
             except BaseException as exc:  # propagate to consumer
                 put((_END, exc))
                 return
@@ -302,8 +312,20 @@ def prefetch_stage(depth: int = 2, to_device: bool = False) -> Callable:
                 yield item
         finally:
             # early exit (downstream error / generator close): unblock and
-            # retire the worker so it stops consuming upstream tasks
+            # retire the worker so it stops consuming upstream tasks,
+            # then surrender anything still buffered (same lease-leak
+            # guard as the pump drop above)
             stop.set()
             thread.join(timeout=5.0)
+            from chunkflow_tpu.parallel.lifecycle import surrender_task
+
+            while True:
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    break
+                if not (isinstance(item, tuple) and len(item) == 2
+                        and item[0] is _END):
+                    surrender_task(item)
 
     return stage

@@ -60,13 +60,17 @@ from typing import Callable, Iterable, Iterator, Optional
 
 from chunkflow_tpu.core import profiling, telemetry
 from chunkflow_tpu.flow.pipeline import _drain_host
-from chunkflow_tpu.parallel.lifecycle import tag_culprit as _tag_culprit
+from chunkflow_tpu.parallel.lifecycle import (
+    surrender_task as _surrender_task,
+    tag_culprit as _tag_culprit,
+)
 from chunkflow_tpu.testing import chaos
 
 __all__ = [
     "scheduler_mode", "mem_watermark_bytes", "DepthController",
     "schedule_chunks", "scheduled_inference_stage", "write_behind_stage",
-    "sample_device_memory",
+    "sample_device_memory", "reserve_host_bytes", "release_host_bytes",
+    "external_resident_bytes",
 ]
 
 _OFF_VALUES = ("static", "0", "off", "false", "no")
@@ -91,6 +95,50 @@ def mem_watermark_bytes() -> int:
     except ValueError:
         gb = 4.0
     return int(gb * (1 << 30))
+
+
+# ---------------------------------------------------------------------------
+# shared host-memory reservations (scheduler depths + serving admission)
+# ---------------------------------------------------------------------------
+_EXT_LOCK = threading.Lock()
+_EXT_BYTES = 0
+
+
+def reserve_host_bytes(nbytes: int) -> bool:
+    """Reserve host-resident bytes against the scheduler's memory
+    watermark on behalf of a plane *outside* the pipeline executor — the
+    serving front-end reserves each admitted request's working set here
+    (docs/serving.md "Backpressure"). Returns False (nothing reserved)
+    when the reservation would cross ``CHUNKFLOW_SCHED_MEM_GB``; the
+    caller should reject/shed rather than admit. The depth controller
+    sees these reservations too (:meth:`DepthController._would_fit`), so
+    a busy serving plane also holds pipeline depth growth — one
+    watermark, every consumer."""
+    global _EXT_BYTES
+    nbytes = max(0, int(nbytes))
+    with _EXT_LOCK:
+        if _EXT_BYTES + nbytes > mem_watermark_bytes():
+            return False
+        _EXT_BYTES += nbytes
+        total = _EXT_BYTES
+    telemetry.gauge("scheduler/external_bytes", total)
+    return True
+
+
+def release_host_bytes(nbytes: int) -> None:
+    """Return a :func:`reserve_host_bytes` reservation."""
+    global _EXT_BYTES
+    nbytes = max(0, int(nbytes))
+    with _EXT_LOCK:
+        _EXT_BYTES = max(0, _EXT_BYTES - nbytes)
+        total = _EXT_BYTES
+    telemetry.gauge("scheduler/external_bytes", total)
+
+
+def external_resident_bytes() -> int:
+    """Bytes currently reserved by non-pipeline planes (serving)."""
+    with _EXT_LOCK:
+        return _EXT_BYTES
 
 
 def _controller_interval() -> int:
@@ -181,9 +229,12 @@ class DepthController:
         return sum(self.depths.values())
 
     def _would_fit(self) -> bool:
-        # 2x: each slot can pin an input and an output chunk at once
+        # 2x: each slot can pin an input and an output chunk at once;
+        # serving-plane reservations (reserve_host_bytes) count against
+        # the same watermark, so depth growth yields to live traffic
         per_slot = 2 * max(self._slot_bytes, 1)
-        return (self.resident_slots() + 1) * per_slot <= self.watermark_bytes
+        return ((self.resident_slots() + 1) * per_slot
+                + external_resident_bytes() <= self.watermark_bytes)
 
     # -- decision -------------------------------------------------------
     def tick(self, totals: dict) -> list:
@@ -287,20 +338,33 @@ class _AdaptiveQueue:
             return item
 
     def close(self) -> None:
-        """Consumer-side: unblock and retire the producer for good."""
+        """Consumer-side: unblock and retire the producer for good.
+        Items still buffered are SURRENDERED, not dropped: a supervised
+        task claimed after the failure handler's in-flight snapshot
+        would otherwise leak its queue lease until the visibility
+        timeout (lifecycle.surrender_task)."""
         with self._lock:
             self._closed = True
+            leftovers = list(self._items)
+            self._items.clear()
             self._not_full.notify_all()
             self._not_empty.notify_all()
+        for item in leftovers:
+            if not _is_end(item):
+                _surrender_task(item)
 
 
 def _pump(source: Iterator, q: _AdaptiveQueue) -> None:
     """Producer body: pull upstream (this is where load-operator IO
     actually runs) into the bounded queue; terminate with an (_END, exc)
-    sentinel on every path so the consumer never blocks forever."""
+    sentinel on every path so the consumer never blocks forever. An item
+    refused because the consumer closed mid-pull is surrendered — it may
+    be a queue task this thread claimed a breath after the chain-failure
+    handler resolved the in-flight set (lifecycle.surrender_task)."""
     try:
         for item in source:
             if not q.put(item):
+                _surrender_task(item)
                 return  # consumer gone: stop pulling upstream
     except BaseException as exc:  # propagate to the consumer thread
         q.put((_END, exc))

@@ -669,42 +669,75 @@ class Inferencer:
             arr.copy_to_host_async()
         return out
 
-    @contract(chunk=Spec(ndim=(3, 4)))
-    def _infer(self, chunk: Chunk, block: bool, consume: bool = False) -> Chunk:
-        import jax
-        import jax.numpy as jnp
-
-        out_layer = (
+    @property
+    def _out_layer(self):
+        return (
             LayerType.AFFINITY_MAP
             if self.num_output_channels == 3
             else LayerType.PROBABILITY_MAP
         )
 
-        if self.dry_run or chunk.all_zero():
-            # channel count must match the real path, which drops the myelin
-            # channel when mask_myelin_threshold is set
-            nchan = self.num_output_channels
-            if self.mask_myelin_threshold is not None:
-                nchan -= 1
-            import ml_dtypes
+    def _blank_output(self, chunk: Chunk) -> Chunk:
+        """The dry-run / all-zero-input result: a zero chunk with the
+        real path's channel count and dtype. Shared with the serving
+        packer (chunkflow_tpu/serve/packer.py) so packed and per-chunk
+        execution agree on the blank fast path too."""
+        # channel count must match the real path, which drops the myelin
+        # channel when mask_myelin_threshold is set
+        nchan = self.num_output_channels
+        if self.mask_myelin_threshold is not None:
+            nchan -= 1
+        import ml_dtypes
 
-            blank_dtype = {
-                "float32": np.float32,
-                "bfloat16": ml_dtypes.bfloat16,
-                "uint8": np.uint8,
-            }[self.output_dtype]
-            out = Chunk.from_bbox(
-                chunk.bbox,
-                # match the real path's result dtype so a volume mixing
-                # blank and real chunks stays dtype-consistent
-                dtype=blank_dtype,
-                nchannels=nchan,
-                voxel_size=chunk.voxel_size,
+        blank_dtype = {
+            "float32": np.float32,
+            "bfloat16": ml_dtypes.bfloat16,
+            "uint8": np.uint8,
+        }[self.output_dtype]
+        out = Chunk.from_bbox(
+            chunk.bbox,
+            # match the real path's result dtype so a volume mixing
+            # blank and real chunks stays dtype-consistent
+            dtype=blank_dtype,
+            nchannels=nchan,
+            voxel_size=chunk.voxel_size,
+        )
+        out.layer_type = self._out_layer
+        if self.crop_output_margin:
+            out = out.crop_margin(self.crop_margin)
+        return out
+
+    def _postprocess_result(self, result, chunk: Chunk,
+                            orig_zyx, run_zyx) -> Chunk:
+        """Crop bucket padding, wrap, myelin-mask and margin-crop a raw
+        program result — the single definition of "what happens after
+        the blend", shared by :meth:`_infer` and the serving packer so
+        the two paths cannot drift."""
+        if run_zyx != orig_zyx:
+            result = result[
+                :, : orig_zyx[0], : orig_zyx[1], : orig_zyx[2]
+            ]
+        out = Chunk(
+            result,
+            voxel_offset=chunk.voxel_offset,
+            voxel_size=chunk.voxel_size,
+            layer_type=self._out_layer,
+        )
+        if self.mask_myelin_threshold is not None:
+            out = out.mask_using_last_channel(
+                threshold=self.mask_myelin_threshold
             )
-            out.layer_type = out_layer
-            if self.crop_output_margin:
-                out = out.crop_margin(self.crop_margin)
-            return out
+        if self.crop_output_margin:
+            out = out.crop_margin(self.crop_margin)
+        return out
+
+    @contract(chunk=Spec(ndim=(3, 4)))
+    def _infer(self, chunk: Chunk, block: bool, consume: bool = False) -> Chunk:
+        import jax
+        import jax.numpy as jnp
+
+        if self.dry_run or chunk.all_zero():
+            return self._blank_output(chunk)
 
         orig_zyx = tuple(chunk.shape[-3:])
         run_zyx = self._run_shape(orig_zyx)
@@ -787,21 +820,4 @@ class Inferencer:
             result = self._run_sharded(arr, grid)
         if block:
             result.block_until_ready()
-        if run_zyx != orig_zyx:
-            result = result[
-                :, : orig_zyx[0], : orig_zyx[1], : orig_zyx[2]
-            ]
-
-        out = Chunk(
-            result,
-            voxel_offset=chunk.voxel_offset,
-            voxel_size=chunk.voxel_size,
-            layer_type=out_layer,
-        )
-        if self.mask_myelin_threshold is not None:
-            out = out.mask_using_last_channel(
-                threshold=self.mask_myelin_threshold
-            )
-        if self.crop_output_margin:
-            out = out.crop_margin(self.crop_margin)
-        return out
+        return self._postprocess_result(result, chunk, orig_zyx, run_zyx)

@@ -44,6 +44,51 @@ def stacked_scatter_enabled() -> bool:
     )
 
 
+def make_accumulate(output_patch_size: Tuple[int, int, int]):
+    """The ONE per-batch accumulation step: ``accumulate(out, weight,
+    weighted, wpatch, starts) -> (out, weight)`` via runtime-coordinate
+    ``lax.scatter_add`` (or the pallas DMA kernel when selected), plus
+    the ``(pad_y, pad_x)`` buffer padding the pallas path needs.
+
+    Factored out of :func:`build_local_blend` so the serving packer's
+    scatter program (chunkflow_tpu/serve/packer.py) replays *exactly*
+    the accumulation the fused per-chunk program runs — same kernel
+    selection, same dimension numbers, same per-batch grouping — which
+    is what makes packed-vs-per-chunk outputs bit-identical."""
+    from jax import lax
+
+    from chunkflow_tpu.ops import pallas_blend
+
+    pout = tuple(output_patch_size)
+    mode = pallas_blend.pallas_mode()
+    pad_y, pad_x = (
+        pallas_blend.buffer_padding(pout) if mode != "off" else (0, 0)
+    )
+
+    dnums4 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3, 4),
+        inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(1, 2, 3),
+    )
+    dnums3 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3),
+        inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0, 1, 2),
+    )
+
+    def accumulate(out, weight, weighted, wpatch, starts):
+        if mode != "off":
+            return pallas_blend.accumulate_patches(
+                out, weight, weighted, wpatch, starts,
+                interpret=(mode == "interpret"),
+            )
+        out = lax.scatter_add(out, starts, weighted, dnums4)
+        weight = lax.scatter_add(weight, starts, wpatch, dnums3)
+        return out, weight
+
+    return accumulate, pad_y, pad_x
+
+
 def build_local_blend(
     forward: Callable,
     num_input_channels: int,
@@ -70,11 +115,9 @@ def build_local_blend(
 
     mode = pallas_blend.pallas_mode()
 
-    # The pallas kernel only DMAs (8,128)-aligned windows, so its buffers
-    # carry high-side padding that is cropped off after the scan.
-    pad_y, pad_x = (
-        pallas_blend.buffer_padding(pout) if mode != "off" else (0, 0)
-    )
+    # the shared per-batch accumulation step (and the (8,128)-aligned
+    # buffer padding the pallas kernel needs, cropped after the scan)
+    accumulate, pad_y, pad_x = make_accumulate(pout)
 
     # Stacking every weighted prediction and accumulating ONCE (vs once per
     # scan batch) removes the per-batch full-buffer traffic on paper — but
@@ -85,27 +128,6 @@ def build_local_blend(
     # tasks) cannot OOM HBM even when opted in.
     stack_max_bytes = stack_budget_bytes()
     use_stacked = stacked_scatter_enabled()
-
-    _DNUMS4 = lax.ScatterDimensionNumbers(
-        update_window_dims=(1, 2, 3, 4),
-        inserted_window_dims=(),
-        scatter_dims_to_operand_dims=(1, 2, 3),
-    )
-    _DNUMS3 = lax.ScatterDimensionNumbers(
-        update_window_dims=(1, 2, 3),
-        inserted_window_dims=(),
-        scatter_dims_to_operand_dims=(0, 1, 2),
-    )
-
-    def accumulate(out, weight, weighted, wpatch, starts):
-        if mode != "off":
-            return pallas_blend.accumulate_patches(
-                out, weight, weighted, wpatch, starts,
-                interpret=(mode == "interpret"),
-            )
-        out = lax.scatter_add(out, starts, weighted, _DNUMS4)
-        weight = lax.scatter_add(weight, starts, wpatch, _DNUMS3)
-        return out, weight
 
     # Per-patch f32 bytes the stacked path keeps alive: the prediction
     # stack plus the equal-footprint weight-patch stack, and on the pallas

@@ -128,10 +128,14 @@ def _proc_rss_gb(pid: int) -> Optional[float]:
 
 
 def _free_port(host: str) -> int:
-    """An ephemeral port for a worker's metrics exporter. Bind-and-
-    release is racy in principle; a worker that loses the race fails to
-    bind, dies, and is replaced on a fresh port — the same recovery
-    path as any other worker death."""
+    """An ephemeral port for a worker's metrics exporter — the FALLBACK
+    for fleets running without a --metrics-dir. Bind-and-release is racy
+    in principle; real spawns with a metrics dir instead pass
+    ``--metrics-port 0`` and discover the actually-bound port from the
+    worker's endpoint file (``restapi.write_endpoint_file``), which
+    cannot race. A worker that loses the fallback race fails to bind,
+    dies, and is replaced — the same recovery path as any other worker
+    death."""
     with socket.socket() as s:
         s.bind((host if host != "0.0.0.0" else "", 0))
         return s.getsockname()[1]
@@ -147,8 +151,11 @@ class WorkerHandle:
         any --process died--> exited
     """
 
-    def __init__(self, ident: str, port: int, proc, cmd: List[str]):
+    def __init__(self, ident: str, port: Optional[int], proc,
+                 cmd: List[str]):
         self.ident = ident
+        # None until discovered from the worker's endpoint file (the
+        # --metrics-port 0 spawn path); probing waits for it
         self.port = port
         self.proc = proc
         self.cmd = cmd
@@ -184,7 +191,8 @@ class WorkerHandle:
             "worker": self.ident,
             "pid": getattr(self.proc, "pid", None),
             "port": self.port,
-            "endpoint": f"127.0.0.1:{self.port}",
+            "endpoint": (f"127.0.0.1:{self.port}"
+                         if self.port is not None else None),
             "state": self.state,
             "started": self.started,
             "last_seen": self.last_seen,
@@ -326,11 +334,19 @@ class FleetSupervisor:
     def spawn_worker(self) -> WorkerHandle:
         self._seq += 1
         ident = f"fleet-w{self._seq:03d}"
-        port = _free_port(self.host)
+        # real spawns with a metrics dir bind ephemeral (--metrics-port
+        # 0) and publish the bound port in their endpoint file — no
+        # pre-pick race, no collisions between workers on one host.
+        # Injected launchers (tests) and dir-less fleets keep the
+        # legacy pre-picked port, which is the only address the
+        # supervisor could know for them.
+        discover = (self.metrics_dir is not None
+                    and self.launcher == self._spawn_process)
+        port = None if discover else _free_port(self.host)
         cmd = [self.python, "-m", "chunkflow_tpu.flow.cli"]
         if self.metrics_dir:
             cmd += ["--metrics-dir", self.metrics_dir]
-        cmd += ["--metrics-port", str(port)]
+        cmd += ["--metrics-port", "0" if discover else str(port)]
         cmd += self.worker_args
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -352,12 +368,39 @@ class FleetSupervisor:
         return worker
 
     # -- probing + eviction ---------------------------------------------
+    def _discover_port(self, worker: WorkerHandle) -> Optional[int]:
+        """Resolve an ephemeral-spawned worker's bound metrics port from
+        the endpoint file it publishes once its exporter is up."""
+        if worker.port is not None:
+            return worker.port
+        if not self.metrics_dir:
+            return None
+        from chunkflow_tpu.parallel.restapi import read_endpoint_file
+
+        record = read_endpoint_file(self.metrics_dir, worker.ident)
+        if record and record.get("metrics_port"):
+            worker.port = int(record["metrics_port"])
+        return worker.port
+
     def _probe(self, worker: WorkerHandle, now: float) -> None:
         if not worker.running or worker.state not in ("starting", "live"):
             return
         if not self.probing:
             worker.state = "live"  # liveness only: running == healthy
             worker.last_seen = now
+            return
+        if self._discover_port(worker) is None:
+            # no bound port published yet: indistinguishable from "the
+            # exporter is not up yet" — same startup grace, then the
+            # same probation as a worker that never answers
+            if now - worker.started < self.startup_grace:
+                return
+            worker.misses += 1
+            telemetry.inc("fleet/probe_failures")
+            if worker.misses >= self.probe_misses:
+                self._evict(
+                    worker, f"no endpoint published after "
+                            f"{now - worker.started:.0f}s")
             return
         sample = self.scraper(
             f"{self.host}:{worker.port}", timeout=self.probe_timeout)

@@ -64,7 +64,7 @@ __all__ = [
     "backoff_delay", "LedgerBase", "MemoryLedger", "FileLedger",
     "open_ledger", "LeaseRenewer", "TaskLifecycle",
     "LifecycleSupervisor", "inflight", "handle_failure", "tag_culprit",
-    "install_preemption_handler",
+    "surrender_task", "install_preemption_handler",
 ]
 
 
@@ -647,6 +647,28 @@ def handle_failure(exc: BaseException) -> bool:
                 f"{release_exc!r}", file=sys.stderr,
             )
     return not preempt
+
+
+def surrender_task(item) -> None:
+    """Hand back the queue claim of a task DROPPED between pipeline
+    stages during teardown. The prefetch pump threads (flow/scheduler.py
+    ``_pump``, flow/runtime.py ``prefetch_stage``) race chain rebuild:
+    after a contained failure resolves the in-flight set, the pump can
+    pull — and claim — one more task before it notices the consumer is
+    gone, and tasks already buffered in the handoff queue may likewise
+    have been claimed after the failure snapshot. Dropping such an item
+    on the floor leaks its lease until the visibility timeout (observed:
+    a 1800 s claim outliving a cleanly-exited worker, losing the task
+    for the run). Surrender is the correct resolution — nack with no
+    failure recorded, idempotent for already-resolved lifecycles — and a
+    no-op for non-task items (chunks, sentinels, unsupervised tasks).
+    Best-effort: teardown must not die on a broken queue."""
+    lc = item.get("lifecycle") if isinstance(item, dict) else None
+    if lc is not None:
+        try:
+            lc.surrender()
+        except Exception:
+            pass
 
 
 def install_preemption_handler():

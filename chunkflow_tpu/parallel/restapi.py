@@ -92,6 +92,30 @@ def render_prometheus(snap: Optional[dict] = None,
         lines.append(f"# TYPE {metric} summary")
         lines.append(f"{metric}_count{label} {h['count']:g}")
         lines.append(f"{metric}_sum{label} {h['total']:g}")
+    # quantile histograms (serving latency etc.) render as real
+    # Prometheus histograms: cumulative le-labeled buckets, so any
+    # scraper (or fleet-status via serving_stats) can compute p50/p99
+    for name in sorted(snap.get("qhists", {})):
+        h = snap["qhists"][name]
+        metric = prometheus_name(name)
+        lines.append(f"# TYPE {metric} histogram")
+        cumulative = 0
+        worker_esc = _escape_label(worker)
+        for bound, count in zip(telemetry.QUANTILE_BOUNDS, h["buckets"]):
+            cumulative += count
+            lines.append(
+                f'{metric}_bucket{{worker="{worker_esc}",le="{bound:g}"}} '
+                f"{cumulative:g}"
+            )
+        overflow = (h["buckets"][len(telemetry.QUANTILE_BOUNDS)]
+                    if len(h["buckets"]) > len(telemetry.QUANTILE_BOUNDS)
+                    else 0)
+        lines.append(
+            f'{metric}_bucket{{worker="{worker_esc}",le="+Inf"}} '
+            f"{cumulative + overflow:g}"
+        )
+        lines.append(f"{metric}_count{label} {h['count']:g}")
+        lines.append(f"{metric}_sum{label} {h['total']:g}")
     # derived: per-phase stall shares + the dominant share, so the
     # scraper reads "what is this worker waiting on" without re-deriving
     hists = snap.get("hists", {})
@@ -178,9 +202,12 @@ class CoordinationService:
         self._claimed: dict = {}
 
     # ---- request handling (transport-independent) ----------------------
-    def handle(self, method: str, path: str):
+    def handle(self, method: str, path: str, body: Optional[bytes] = None):
         """Returns (status, payload): a dict serves as JSON, a str as
-        ``text/plain`` (the Prometheus exposition), None as empty."""
+        ``text/plain`` (the Prometheus exposition), None as empty.
+        ``body`` carries the raw POST payload (None for GET); the
+        serving front-end's ``POST /infer`` route consumes it
+        (chunkflow_tpu/serve/frontend.py)."""
         if method == "GET" and path == "/metrics":
             return 200, render_prometheus()
         if method == "GET" and path == "/healthz":
@@ -254,8 +281,9 @@ def serve(
     thread) for tests."""
 
     class Handler(BaseHTTPRequestHandler):
-        def _respond(self):
-            status, payload = service.handle(self.command, self.path)
+        def _respond(self, body: Optional[bytes] = None):
+            status, payload = service.handle(self.command, self.path,
+                                             body)
             self.send_response(status)
             if isinstance(payload, str):
                 # raw text route (/metrics: Prometheus exposition 0.0.4)
@@ -274,7 +302,11 @@ def serve(
             self._respond()
 
         def do_POST(self):
-            self._respond()
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            self._respond(self.rfile.read(length) if length else None)
 
         def log_message(self, *args):  # quiet
             pass
@@ -304,6 +336,68 @@ def start_metrics_exporter(port: int, host: str = "0.0.0.0"):
     server, _thread = serve(service, host=host, port=int(port),
                             background=True)
     return server
+
+
+def bound_port(server) -> Optional[int]:
+    """The port a listener actually bound (differs from the requested
+    one when it was 0 — the ephemeral-port path that lets many workers
+    share one host without colliding on a fixed ``--metrics-port``)."""
+    if server is None:
+        return None
+    return int(server.server_address[1])
+
+
+def write_endpoint_file(metrics_dir: str, **ports) -> Optional[str]:
+    """Publish this worker's actually-bound listener port(s) as
+    ``<metrics_dir>/endpoint-<worker>.json`` (atomic replace; repeated
+    calls merge, so the metrics exporter and the serving listener each
+    add their port). This is how a supervisor that spawned a worker
+    with ``--metrics-port 0`` learns where to probe it
+    (parallel/fleet.py) — the bind-and-release port pre-pick it
+    replaces was racy by construction. No-op (None) when telemetry is
+    off or the dir is unwritable; ports passed as None are skipped."""
+    if not telemetry.enabled() or not metrics_dir:
+        return None
+    worker = telemetry.worker_id()
+    safe = "".join(
+        ch if ch.isalnum() or ch in "._-" else "_" for ch in worker
+    )
+    path = os.path.join(metrics_dir, f"endpoint-{safe}.json")
+    payload = {"worker": worker, "pid": os.getpid(), "t": time.time()}
+    try:
+        with open(path) as f:
+            previous = json.load(f)
+        if isinstance(previous, dict) and previous.get("pid") == os.getpid():
+            payload = {**previous, **payload}
+    except (OSError, ValueError):
+        pass
+    for name, port in ports.items():
+        if port is not None:
+            payload[name] = int(port)
+    try:
+        os.makedirs(metrics_dir, exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def read_endpoint_file(metrics_dir: str, worker: str) -> Optional[dict]:
+    """The endpoint record a worker published (None when absent or
+    torn) — keyed by the ``CHUNKFLOW_WORKER_ID`` the spawner assigned."""
+    safe = "".join(
+        ch if ch.isalnum() or ch in "._-" else "_" for ch in worker
+    )
+    path = os.path.join(metrics_dir, f"endpoint-{safe}.json")
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
 
 
 def exporter_port_from_env() -> Optional[int]:
@@ -360,6 +454,56 @@ def achieved_mvox_s(metrics: Dict[str, float]) -> Optional[float]:
     return voxels / seconds / 1e6
 
 
+_LATENCY_BUCKET_RE = re.compile(
+    r'^chunkflow_serving_latency_bucket\{[^}]*le="([^"]*)"[^}]*\}\s+'
+    r"(-?[0-9.eE+-]+)$", re.MULTILINE,
+)
+
+
+def serving_stats(text: str) -> Optional[dict]:
+    """The SERVING view of one worker's exposition: ``{"inflight",
+    "requests", "completed", "rejects", "deadline_missed", "p50_s",
+    "p99_s"}`` — None when the worker serves no requests (no serving
+    samples at all). The latency quantiles come from the le-labeled
+    ``chunkflow_serving_latency`` histogram buckets; the generic
+    :func:`parse_prometheus` drops labels, so the buckets are re-parsed
+    here and fed through the one shared quantile estimator
+    (``telemetry.quantile_from_buckets``)."""
+    flat = parse_prometheus(text)
+    requests = flat.get("chunkflow_serving_requests_total")
+    if requests is None:
+        return None
+    out = {
+        "requests": requests,
+        "inflight": flat.get("chunkflow_serving_inflight", 0.0),
+        "completed": flat.get("chunkflow_serving_completed_total", 0.0),
+        "rejects": (flat.get("chunkflow_serving_rejected_admission_total",
+                             0.0)
+                    + flat.get("chunkflow_serving_rejected_memory_total",
+                               0.0)),
+        "deadline_missed": flat.get(
+            "chunkflow_serving_deadline_missed_total", 0.0),
+        "p50_s": None, "p99_s": None,
+    }
+    cumulative = {}
+    for match in _LATENCY_BUCKET_RE.finditer(text):
+        le, value = match.group(1), float(match.group(2))
+        cumulative[le] = value
+    if cumulative:
+        # cumulative le counts -> per-bucket counts in bound order
+        buckets, prev = [], 0.0
+        for bound in telemetry.QUANTILE_BOUNDS:
+            cum = cumulative.get(f"{bound:g}", prev)
+            buckets.append(max(0.0, cum - prev))
+            prev = cum
+        inf_cum = cumulative.get("+Inf", prev)
+        buckets.append(max(0.0, inf_cum - prev))
+        qhist = {"count": inf_cum, "buckets": buckets}
+        out["p50_s"] = telemetry.quantile_from_buckets(qhist, 0.5)
+        out["p99_s"] = telemetry.quantile_from_buckets(qhist, 0.99)
+    return out
+
+
 def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
     """Sample one worker's observability endpoints for ``fleet-status``
     and the fleet supervisor: ``{"endpoint", "healthz": dict|None,
@@ -370,7 +514,7 @@ def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
     base = endpoint if "://" in endpoint else f"http://{endpoint}"
     base = base.rstrip("/")
     out = {"endpoint": base, "healthz": None, "metrics": None,
-           "dominant_stall": None, "error": None}
+           "dominant_stall": None, "serving": None, "error": None}
     try:
         with urllib.request.urlopen(f"{base}/healthz",
                                     timeout=timeout) as resp:
@@ -380,6 +524,7 @@ def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
             text = resp.read().decode()
         out["metrics"] = parse_prometheus(text)
         out["dominant_stall"] = dominant_stall(text)
+        out["serving"] = serving_stats(text)
     except Exception as exc:  # noqa: BLE001 — any failure = unreachable
         out["error"] = f"{type(exc).__name__}: {exc}"
     return out

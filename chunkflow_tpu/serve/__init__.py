@@ -1,0 +1,11 @@
+"""Serving subsystem: cross-task patch batching + the request front-end.
+
+``packer.py`` keeps fixed-shape device batches full from ragged
+many-request traffic (the Ragged Paged Attention idiom applied to our
+patch grids); ``frontend.py`` turns ``parallel/restapi.py``'s HTTP
+server into a real ``POST /infer`` path with admission control,
+deadlines and lifecycle-supervised execution. See docs/serving.md.
+"""
+from chunkflow_tpu.serve.packer import PatchPacker, serve_enabled
+
+__all__ = ["PatchPacker", "serve_enabled"]

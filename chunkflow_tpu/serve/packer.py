@@ -1,0 +1,530 @@
+"""Cross-task patch packer: fill fixed device batches from ragged traffic.
+
+The per-chunk fused program (inference/inferencer.py) pads every task's
+patch list to a multiple of ``batch_size`` with validity-0 entries, so a
+task with 3 patches and batch 8 runs the forward pass at 37% occupancy —
+and under many small concurrent requests (the ROADMAP's "millions of
+users" scenario) the device spends most of its cycles on padding. This
+module drains patches from *all* in-flight tasks into one shared queue
+and dispatches fixed ``[B, ci, *pin]`` batches that mix patches across
+tasks, keeping occupancy near 1 regardless of request shapes — the
+Ragged Paged Attention idiom (PAPERS.md) applied to patch grids, with
+PipeFusion's observation that the patch, not the chunk, is the natural
+scheduling unit.
+
+Bit-identity contract (tested in tests/serve/test_packer.py): packed
+outputs equal the per-chunk fused path's outputs **bitwise**. The fused
+program is ``gather -> forward*bump*valid -> per-batch scatter-add ->
+normalize``; the packer replays the same math as three steps with the
+same grouping:
+
+1. *host prep* — the chunk's int->float32 normalization and edge padding
+   are IEEE-exact operations, mirrored on the host (conversion and
+   padding are value-copies/roundings with identical results on host
+   and device); patches are gathered by host slicing (exact);
+2. *shared forward program* (``("serve_forward",)`` in the inferencer's
+   ProgramCache — ONE trace for all traffic): computes
+   ``forward(params, patches) * bump * valid`` for a mixed batch. A real
+   patch's row multiplies by valid=1.0 exactly as in the fused program;
+   filler rows are discarded;
+3. *per-task scatter program* (``("serve_scatter", run_shape)`` — keyed
+   by the PR 2 compile-cache shape bucket, so ragged chunks that bucket
+   together share one trace): rebuilds the task's ``[n_pad, ...]``
+   weighted stack (missing = padding rows are exact zeros, which is
+   bitwise what the fused program scatter-adds for validity-0 entries),
+   then replays the *same* scan-over-batches accumulation — same
+   ``ops.blend.make_accumulate`` step, same batch grouping, same order —
+   and the same ``normalize_blend``.
+
+Provenance: every queued patch carries its request and patch index; the
+dispatcher writes each forward row back into its request's stack, so a
+mixed batch scatters back to the right task's accumulation buffers.
+
+Kill switch: ``CHUNKFLOW_SERVE=0`` — :meth:`PatchPacker.submit` routes
+every request through the untouched per-chunk path (``inferencer(...)``),
+bit-identically and without building any serve program. Requests that
+the packed path does not cover (sharded inferencers, fold blend,
+dry-run) take the same fallback automatically, loudly counted as
+``serving/fallbacks``.
+
+Telemetry (docs/observability.md "Serving"): ``serving/occupancy`` gauge
++ histogram (real patches per dispatched batch slot), ``serving/
+queue_age`` histogram, ``serving/patch_queue`` gauge, ``serving/batches``
+/ ``serving/packed_patches`` / ``serving/filler_slots`` /
+``serving/fallbacks`` counters, ``serving/forward`` / ``serving/scatter``
+spans (host-side only, GL007).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.inference.patching import enumerate_patches, pad_to_batch
+
+__all__ = [
+    "serve_enabled", "RequestExpired", "PackerClosed", "PendingResult",
+    "PatchPacker",
+]
+
+_OFF_VALUES = ("0", "off", "false", "no")
+
+
+def serve_enabled() -> bool:
+    """The serving kill switch (``CHUNKFLOW_SERVE``, default on).
+    Re-read per call so tests and long-lived workers can flip it; off
+    means every request takes the per-chunk batching path bit-identically
+    and no serve program is ever built."""
+    return os.environ.get("CHUNKFLOW_SERVE", "1").lower() not in _OFF_VALUES
+
+
+class RequestExpired(RuntimeError):
+    """The request's deadline passed before its patches completed; its
+    remaining queued patches are dropped (``serving/deadline_missed``)."""
+
+
+class PackerClosed(RuntimeError):
+    """The packer was shut down while the request was still queued."""
+
+
+class PendingResult:
+    """One submitted request's completion handle: ``result(timeout)``
+    blocks until the output chunk (or the failure) is ready."""
+
+    __slots__ = ("_event", "_result", "_error", "trace_id")
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self._event = threading.Event()
+        self._result: Optional[Chunk] = None
+        self._error: Optional[BaseException] = None
+        self.trace_id = trace_id
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _complete(self, chunk: Chunk) -> None:
+        self._result = chunk
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self._event.is_set():
+            self._error = exc
+            self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Chunk:
+        if not self._event.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Request:
+    """Per-request provenance + accumulation state (host side)."""
+
+    __slots__ = (
+        "chunk", "handle", "deadline", "trace_id", "orig_zyx", "run_zyx",
+        "n", "n_pad", "out_starts", "valid", "patches", "weighted",
+        "remaining", "lock", "enqueued_t",
+    )
+
+    def __init__(self, chunk, handle, deadline, trace_id):
+        self.chunk = chunk
+        self.handle = handle
+        self.deadline = deadline
+        self.trace_id = trace_id
+        self.lock = threading.Lock()
+        self.enqueued_t = time.time()
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.time() > self.deadline
+
+
+def _host_float32(chunk: Chunk) -> np.ndarray:
+    """The chunk payload as ``[ci, z, y, x]`` float32 on the host,
+    mirroring ``Inferencer._infer``'s on-device normalization bitwise:
+    int images scale to [0, 1] by ``1/iinfo.max`` (int->f32 conversion
+    is exact, the f32 multiply is the same IEEE operation on host and
+    device); float inputs round to f32 with the same IEEE
+    round-to-nearest the device conversion applies."""
+    arr = np.asarray(chunk.array)
+    dt = np.dtype(chunk.dtype)
+    if dt.kind in "iu":
+        scale = np.float32(1.0 / np.iinfo(dt).max)
+        arr = arr.astype(np.float32) * scale
+    else:
+        arr = np.asarray(arr, dtype=np.float32)
+    if arr.ndim == 3:
+        arr = arr[None]
+    return arr
+
+
+class PatchPacker:
+    """Continuous cross-task patch batching around one
+    :class:`~chunkflow_tpu.inference.inferencer.Inferencer`.
+
+    ``submit`` is thread-safe (the serving front-end calls it from HTTP
+    handler threads and lifecycle worker threads alike); all device work
+    runs on one dispatcher thread, so program build and dispatch never
+    race. ``max_wait_ms`` bounds how long a partial batch waits for more
+    traffic before dispatching underfull — the latency/occupancy knob.
+    """
+
+    def __init__(self, inferencer, max_wait_ms: float = 2.0,
+                 max_queue_patches: int = 4096):
+        self.inferencer = inferencer
+        self.batch_size = int(inferencer.batch_size)
+        self.max_wait_s = max(0.0, float(max_wait_ms) / 1e3)
+        self.max_queue_patches = int(max_queue_patches)
+        self._cv = threading.Condition()
+        self._items: deque = deque()  # (request, patch_index, enqueue_t)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- eligibility ----------------------------------------------------
+    def _eligible(self) -> bool:
+        """Packed execution covers the single-device scatter path — the
+        serving shape. Everything else (sharded meshes, fold blend, the
+        kill switch) falls back to the per-chunk program."""
+        inf = self.inferencer
+        return (
+            serve_enabled()
+            and inf.sharding == "none"
+            and inf.blend_mode == "scatter"
+            and not inf.dry_run
+        )
+
+    # -- submission -----------------------------------------------------
+    def submit(self, chunk: Chunk, deadline: Optional[float] = None,
+               trace_id: Optional[str] = None) -> PendingResult:
+        """Queue one request's patches for packed execution; returns a
+        :class:`PendingResult`. ``deadline`` is an absolute ``time.time``
+        deadline: patches still queued past it are dropped and the
+        request fails with :class:`RequestExpired`. Ineligible requests
+        (kill switch, sharded, fold, dry-run) complete synchronously
+        through the per-chunk path, bit-identically."""
+        handle = PendingResult(trace_id)
+        if not self._eligible():
+            telemetry.inc("serving/fallbacks")
+            try:
+                handle._complete(self.inferencer(chunk))
+            except BaseException as exc:
+                handle._fail(exc)
+            return handle
+        if chunk.all_zero():
+            # same blank fast path the per-chunk program takes
+            try:
+                handle._complete(self.inferencer._blank_output(chunk))
+            except BaseException as exc:
+                handle._fail(exc)
+            return handle
+
+        req = _Request(chunk, handle, deadline, trace_id)
+        try:
+            self._prepare(req)
+        except BaseException as exc:
+            handle._fail(exc)
+            return handle
+        with self._cv:
+            if self._stop:
+                handle._fail(PackerClosed("packer is shut down"))
+                return handle
+            while (len(self._items) + req.n > self.max_queue_patches
+                   and not self._stop):
+                # bounded queue: submission backpressure rather than
+                # unbounded host memory under a traffic spike
+                self._cv.wait(0.05)
+            if self._stop:
+                handle._fail(PackerClosed("packer is shut down"))
+                return handle
+            now = time.time()
+            for i in range(req.n):
+                self._items.append((req, i, now))
+            telemetry.gauge("serving/patch_queue", len(self._items))
+            self._ensure_thread()
+            self._cv.notify_all()
+        return handle
+
+    def infer(self, chunk: Chunk, deadline: Optional[float] = None,
+              timeout: Optional[float] = None) -> Chunk:
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(chunk, deadline=deadline).result(timeout)
+
+    def _prepare(self, req: _Request) -> None:
+        """Host-side request prep: f32 normalization, bucket padding,
+        patch gather, provenance bookkeeping. Pure numpy — exactness
+        notes in the module docstring."""
+        inf = self.inferencer
+        chunk = req.chunk
+        req.orig_zyx = tuple(chunk.shape[-3:])
+        req.run_zyx = inf._run_shape(req.orig_zyx)
+        arr = _host_float32(chunk)
+        if req.run_zyx != req.orig_zyx:
+            pad = [(0, 0)] + [
+                (0, r - s) for r, s in zip(req.run_zyx, req.orig_zyx)
+            ]
+            # same edge-replicate the device path applies for bucketing
+            arr = np.pad(arr, pad, mode="edge")
+        grid = enumerate_patches(
+            req.run_zyx,
+            inf.input_patch_size,
+            inf.output_patch_size,
+            inf.output_patch_overlap,
+        )
+        in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
+        req.n = grid.num_patches
+        req.n_pad = len(valid)
+        req.out_starts = out_starts
+        req.valid = valid
+        pin = tuple(inf.input_patch_size)
+        pout = tuple(inf.output_patch_size)
+        co = inf.num_output_channels
+        req.patches = [
+            arr[:, s[0]:s[0] + pin[0], s[1]:s[1] + pin[1],
+                s[2]:s[2] + pin[2]]
+            for s in in_starts[:req.n]
+        ]
+        # padding rows stay exact zeros: bitwise what the fused program's
+        # validity-0 entries contribute to the scatter-add
+        req.weighted = np.zeros((req.n_pad, co) + pout, dtype=np.float32)
+        req.remaining = req.n
+
+    # -- dispatcher -----------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="patch-packer",
+            )
+            self._thread.start()
+
+    def _next_batch(self):
+        """Collect up to ``batch_size`` queued patches; a partial batch
+        waits ``max_wait_s`` (from its oldest item) for more traffic
+        before dispatching underfull."""
+        with self._cv:
+            while True:
+                if self._items:
+                    oldest_t = self._items[0][2]
+                    if (len(self._items) >= self.batch_size or self._stop
+                            or time.time() - oldest_t >= self.max_wait_s):
+                        batch = [
+                            self._items.popleft()
+                            for _ in range(min(self.batch_size,
+                                               len(self._items)))
+                        ]
+                        telemetry.gauge("serving/patch_queue",
+                                        len(self._items))
+                        self._cv.notify_all()
+                        return batch
+                    self._cv.wait(
+                        max(0.0005,
+                            self.max_wait_s - (time.time() - oldest_t)))
+                    continue
+                if self._stop:
+                    return None
+                self._cv.wait(0.1)
+
+    def _forward_program(self):
+        inf = self.inferencer
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            from chunkflow_tpu.inference.bump import bump_map
+
+            bump = jnp.asarray(bump_map(tuple(inf.output_patch_size)))
+
+            def program(patches, valid, params):
+                preds = inf._forward(params, patches)
+                # the same weighting expression, in the same order, as
+                # the fused program's forward_batch (ops/blend.py)
+                return preds * bump[None, None] * \
+                    valid[:, None, None, None, None]
+
+            # the packed batch buffer is packer-owned and dead after the
+            # call (GL005): donate it into the program
+            return jax.jit(program, donate_argnums=(0,))
+
+        return inf._programs.get(("serve_forward",), build)
+
+    def _scatter_program(self, run_zyx, n_pad):
+        inf = self.inferencer
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+            from jax import lax
+
+            from chunkflow_tpu.inference.bump import bump_map
+            from chunkflow_tpu.ops.blend import (
+                make_accumulate,
+                normalize_blend,
+            )
+
+            pout = tuple(inf.output_patch_size)
+            co = inf.num_output_channels
+            B = self.batch_size
+            bump = jnp.asarray(bump_map(pout))
+            accumulate, pad_y, pad_x = make_accumulate(pout)
+            out_dtype = inf.output_dtype
+            zyx_buf = (run_zyx[0], run_zyx[1] + pad_y, run_zyx[2] + pad_x)
+            num_batches = n_pad // B
+
+            def program(weighted, valid, out_starts):
+                # wpatch computed on device exactly as the fused
+                # program's step does (bump * validity, f32)
+                wpatch_all = bump[None] * valid[:, None, None, None]
+                out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
+                w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
+
+                def step(carry, b):
+                    out, weight = carry
+                    i0 = b * B
+                    w = lax.dynamic_slice(
+                        weighted, (i0, 0, 0, 0, 0), (B, co) + pout)
+                    wp = lax.dynamic_slice(
+                        wpatch_all, (i0, 0, 0, 0), (B,) + pout)
+                    s_out = lax.dynamic_slice(out_starts, (i0, 0), (B, 3))
+                    out, weight = accumulate(out, weight, w, wp, s_out)
+                    return (out, weight), None
+
+                (out, weight), _ = lax.scan(
+                    step, (out0, w0), jnp.arange(num_batches)
+                )
+                if pad_y or pad_x:
+                    out = out[:, :, : run_zyx[1], : run_zyx[2]]
+                    weight = weight[:, : run_zyx[1], : run_zyx[2]]
+                return normalize_blend(out, weight, out_dtype)
+
+            # the assembled weighted stack is packer-owned and dead
+            # after the call (GL005): donate it
+            return jax.jit(program, donate_argnums=(0,))
+
+        return inf._programs.get(("serve_scatter", tuple(run_zyx)), build)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch)
+            except BaseException as exc:  # noqa: BLE001 — fail, don't die
+                for req, _, _ in batch:
+                    req.handle._fail(exc)
+                # dispatcher-plane failures get their own counter; the
+                # front-end owns the per-request outcome counters
+                # (serving/errors, serving/deadline_missed) — one count
+                # per request no matter who detected the failure first
+                telemetry.inc("serving/packer_errors")
+
+    def _run_batch(self, batch) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        inf = self.inferencer
+        now = time.time()
+        live = []
+        for item in batch:
+            req, _, enq_t = item
+            if req.handle.done:
+                continue  # already failed/expired: drop its patches
+            if req.expired:
+                req.handle._fail(RequestExpired(
+                    f"deadline passed {now - req.deadline:.3f}s ago with "
+                    f"patches still queued"))
+                continue
+            telemetry.observe("serving/queue_age", now - enq_t)
+            live.append(item)
+        if not live:
+            return
+        B = self.batch_size
+        pin = tuple(inf.input_patch_size)
+        ci = inf.num_input_channels
+        batch_np = np.zeros((B, ci) + pin, dtype=np.float32)
+        valid_np = np.zeros((B,), dtype=np.float32)
+        for row, (req, idx, _) in enumerate(live):
+            batch_np[row] = req.patches[idx]
+            valid_np[row] = 1.0
+        occupancy = len(live) / B
+        telemetry.gauge("serving/occupancy", occupancy)
+        telemetry.inc("serving/batches")
+        telemetry.inc("serving/packed_patches", len(live))
+        telemetry.inc("serving/filler_slots", B - len(live))
+
+        if inf._device_params is None:
+            inf._device_params = jax.device_put(inf.engine.params)
+        program = self._forward_program()
+        with telemetry.span("serving/forward", occupancy=round(occupancy, 3)):
+            out = program(
+                jnp.asarray(batch_np), jnp.asarray(valid_np),
+                inf._device_params,
+            )
+            out_np = np.asarray(out)
+
+        done = []
+        for row, (req, idx, _) in enumerate(live):
+            with req.lock:
+                req.weighted[idx] = out_np[row]
+                req.patches[idx] = None  # free the gathered input early
+                req.remaining -= 1
+                if req.remaining == 0:
+                    done.append(req)
+        for req in done:
+            try:
+                self._finalize(req)
+            except BaseException as exc:  # noqa: BLE001
+                req.handle._fail(exc)
+                telemetry.inc("serving/packer_errors")
+
+    def _finalize(self, req: _Request) -> None:
+        """All of the request's patches are forwarded: replay the fused
+        program's scan-over-batches accumulation and hand the result
+        through the inferencer's shared post-processing."""
+        import jax.numpy as jnp
+
+        if req.expired:
+            req.handle._fail(RequestExpired("deadline passed at finalize"))
+            return
+        program = self._scatter_program(req.run_zyx, req.n_pad)
+        with telemetry.span("serving/scatter"):
+            result = program(
+                jnp.asarray(req.weighted), jnp.asarray(req.valid),
+                jnp.asarray(req.out_starts),
+            )
+            result.block_until_ready()
+        req.weighted = None
+        out = self.inferencer._postprocess_result(
+            result, req.chunk, req.orig_zyx, req.run_zyx)
+        shape = getattr(getattr(out, "array", None), "shape", None)
+        if shape:
+            voxels = 1
+            for length in shape[-3:]:
+                voxels *= int(length)
+            telemetry.inc("inference/voxels", float(voxels))
+        req.handle._complete(out)
+
+    # -- teardown -------------------------------------------------------
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop the dispatcher. ``drain=True`` (default) lets queued
+        patches finish first; ``drain=False`` fails still-queued
+        requests with :class:`PackerClosed`."""
+        with self._cv:
+            if not drain:
+                while self._items:
+                    req, _, _ = self._items.popleft()
+                    req.handle._fail(PackerClosed("packer closed"))
+            self._stop = True
+            self._cv.notify_all()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)

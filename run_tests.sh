@@ -113,6 +113,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py fleet_smoke --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "fleet chaos smoke"
 
+# --- serving throughput gate -------------------------------------------------
+# Packed cross-request batching vs sequential per-chunk execution on many
+# small concurrent requests (docs/serving.md). Reports the >=1.3x target
+# as gate_pass (asserted slow-marked in tests/test_bench.py); the process
+# only fails below 1.1x. The run itself raises on any bit-divergence
+# between the packed and per-chunk paths.
+echo "== serving throughput gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py serving_throughput --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "serving throughput gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

@@ -266,3 +266,55 @@ def test_rotation_off_without_sink_and_when_disabled(
     telemetry.flush()
     # kill switch: no files at all, rotated or otherwise
     assert not (tmp_path / "off").exists()
+
+
+# ---------------------------------------------------------------------------
+# quantile histograms (the serving p50/p99 substrate, ISSUE 9)
+# ---------------------------------------------------------------------------
+def test_quantile_histogram_estimates_and_snapshot_schema():
+    telemetry.reset()
+    try:
+        for v in [0.004] * 50 + [0.02] * 40 + [0.8] * 10:
+            telemetry.observe_quantile("serving/latency", v)
+        p50 = telemetry.quantile("serving/latency", 0.5)
+        p99 = telemetry.quantile("serving/latency", 0.99)
+        # 50th sample sits in the (0.0025, 0.005] bucket, 99th in
+        # (0.5, 1.0] — the log-bucket estimate must land inside them
+        assert 0.0025 <= p50 <= 0.005, p50
+        assert 0.5 <= p99 <= 1.0, p99
+        snap = telemetry.snapshot()
+        h = snap["qhists"]["serving/latency"]
+        assert h["count"] == 100
+        assert len(h["buckets"]) == len(telemetry.QUANTILE_BOUNDS) + 1
+        assert sum(h["buckets"]) == 100
+        # fixed bounds mean per-worker buckets sum exactly: merging two
+        # copies doubles every estimate's weight but moves no quantile
+        merged = {"count": 2 * h["count"],
+                  "buckets": [2 * n for n in h["buckets"]]}
+        assert telemetry.quantile_from_buckets(merged, 0.5) == \
+            pytest.approx(p50)
+    finally:
+        telemetry.reset()
+
+
+def test_quantile_histogram_edge_cases():
+    telemetry.reset()
+    try:
+        assert telemetry.quantile("missing", 0.5) is None
+        assert telemetry.quantile_from_buckets(
+            {"count": 0, "buckets": []}, 0.5) is None
+        # an overflow-only histogram saturates at the top bound
+        telemetry.observe_quantile("serving/huge", 9999.0)
+        assert telemetry.quantile("serving/huge", 0.5) == \
+            telemetry.QUANTILE_BOUNDS[-1]
+    finally:
+        telemetry.reset()
+
+
+def test_quantile_histogram_respects_kill_switch(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    telemetry.observe_quantile("serving/latency", 0.1)
+    assert telemetry.quantile("serving/latency", 0.5) is None
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY")
+    telemetry.reset()
+    assert "qhists" not in telemetry.snapshot()

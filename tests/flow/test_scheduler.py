@@ -549,3 +549,64 @@ def test_scheduler_mode_env_values(monkeypatch):
 def test_mem_watermark_malformed_falls_back(monkeypatch):
     monkeypatch.setenv("CHUNKFLOW_SCHED_MEM_GB", "not-a-number")
     assert scheduler.mem_watermark_bytes() == 4 << 30
+
+
+# ---------------------------------------------------------------------------
+# lease-leak guard: tasks dropped during chain teardown are surrendered
+# ---------------------------------------------------------------------------
+class _FakeLifecycle:
+    def __init__(self):
+        self.surrenders = 0
+
+    def surrender(self):
+        self.surrenders += 1
+        return "surrendered"
+
+
+def test_pump_drop_and_close_surrender_claimed_tasks():
+    """The chain-rebuild race (observed in the lifecycle chaos
+    acceptance run): after a contained failure resolves the in-flight
+    set, the prefetch pump can claim ONE more task before noticing the
+    consumer closed, and tasks buffered in the handoff queue may have
+    been claimed after the snapshot too. Both must be surrendered —
+    dropped-on-the-floor claims leak their lease until the visibility
+    timeout and lose the task for the run."""
+    from chunkflow_tpu.flow.scheduler import _AdaptiveQueue, _pump
+
+    buffered, in_hand, never_pulled = (
+        _FakeLifecycle(), _FakeLifecycle(), _FakeLifecycle(),
+    )
+    q = _AdaptiveQueue(1)
+
+    def source():
+        yield {"lifecycle": buffered}    # fills the queue
+        q.close()                        # consumer dies between pulls
+        yield {"lifecycle": in_hand}     # put() refused -> surrender
+        yield {"lifecycle": never_pulled}  # pump must have stopped
+
+    _pump(iter(source()), q)
+    assert buffered.surrenders == 1     # drained + surrendered at close
+    assert in_hand.surrenders == 1      # refused put -> surrendered
+    assert never_pulled.surrenders == 0  # never claimed, never touched
+
+
+def test_prefetch_stage_surrenders_buffered_tasks_on_early_close():
+    """Same guard for the static-path prefetch stage (runtime.py)."""
+    from chunkflow_tpu.flow.runtime import prefetch_stage
+
+    lcs = [_FakeLifecycle() for _ in range(4)]
+
+    def source():
+        for lc in lcs:
+            yield {"lifecycle": lc, "log": {"timer": {}}}
+
+    stage = prefetch_stage(depth=2)
+    stream = stage(source())
+    first = next(stream)        # one task delivered downstream
+    stream.close()              # downstream dies; buffered tasks remain
+    delivered = first["lifecycle"]
+    assert delivered.surrenders == 0  # delivered tasks are NOT touched
+    surrendered = sum(lc.surrenders for lc in lcs if lc is not delivered)
+    # whatever the worker managed to buffer before close was handed back
+    assert surrendered >= 1
+    assert all(lc.surrenders <= 1 for lc in lcs)

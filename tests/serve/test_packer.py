@@ -1,0 +1,307 @@
+"""Cross-task patch packer: bit-identical parity with the per-chunk
+fused path on ragged mixed-size traffic, kill-switch equivalence,
+occupancy telemetry, deadline drops, and the graftlint gate over the
+serve modules (ISSUE 9)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.inference import Inferencer
+from chunkflow_tpu.serve.packer import (
+    PackerClosed,
+    PatchPacker,
+    RequestExpired,
+    serve_enabled,
+)
+
+
+@pytest.fixture
+def clean(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    monkeypatch.delenv("CHUNKFLOW_SERVE", raising=False)
+    telemetry.reset()
+    yield monkeypatch
+    telemetry.reset()
+
+
+def make_inferencer(**kwargs):
+    defaults = dict(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=4,
+        crop_output_margin=False,
+    )
+    defaults.update(kwargs)
+    return Inferencer(**defaults)
+
+
+#: deliberately ragged: exact patch size, mid-size, and shapes that snap
+#: their last patch flush against the boundary
+RAGGED_SHAPES = [(8, 32, 32), (6, 20, 28), (4, 16, 16), (9, 33, 35),
+                 (5, 17, 30)]
+
+
+def _parity_check(inferencer, chunks, max_wait_ms=1.0):
+    """refs through the fused per-chunk program, then the same chunks
+    through the packer concurrently; assert bitwise equality."""
+    refs = [np.asarray(inferencer(c).array) for c in chunks]
+    packer = PatchPacker(inferencer, max_wait_ms=max_wait_ms)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [h.result(timeout=60) for h in handles]
+    finally:
+        packer.close()
+    for i, (ref, out) in enumerate(zip(refs, outs)):
+        got = np.asarray(out.array)
+        assert got.dtype == ref.dtype, chunks[i].shape
+        assert np.array_equal(got, ref), (
+            f"chunk {tuple(chunks[i].shape)}: packed output diverged "
+            f"(max |d| = {np.abs(got.astype(np.float64) - ref.astype(np.float64)).max()})"
+        )
+    return refs, outs
+
+
+def test_packed_bit_identical_ragged_float32(clean):
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(7)
+    chunks = [
+        Chunk(rng.random(s).astype(np.float32), voxel_offset=(64 * i, 0, 0))
+        for i, s in enumerate(RAGGED_SHAPES)
+    ]
+    _parity_check(inferencer, chunks)
+
+
+def test_packed_bit_identical_uint8_and_bucket_boundaries(clean):
+    """uint8 input (the narrow EM-image wire path) + uint8 on-device
+    quantization + shape bucketing, including shapes exactly ON the
+    bucket boundary and one voxel past it."""
+    inferencer = make_inferencer(
+        output_patch_size=(2, 8, 8),
+        output_patch_overlap=(1, 2, 2),
+        num_output_channels=2,
+        output_dtype="uint8",
+        shape_bucket=(4, 16, 16),
+    )
+    rng = np.random.default_rng(3)
+    shapes = [
+        (8, 32, 32),   # exact multiple of the bucket
+        (7, 31, 30),   # ragged: pads into the SAME (8,32,32) bucket
+        (4, 16, 16),   # exactly one bucket
+        (5, 17, 16),   # one voxel past a boundary on two axes
+    ]
+    chunks = [
+        Chunk((rng.random(s) * 255).astype(np.uint8),
+              voxel_offset=(64 * i, 0, 0))
+        for i, s in enumerate(shapes)
+    ]
+    refs, _ = _parity_check(inferencer, chunks)
+    assert refs[0].dtype == np.uint8
+    # bucketing must have collapsed the serve scatter programs: at most
+    # one per distinct bucketed run shape, not one per raw shape
+    scatter_keys = {
+        key for key, _ in inferencer._programs.items()
+        if key[0] == "serve_scatter"
+    }
+    assert len(scatter_keys) < len(shapes)
+
+
+def test_packed_bit_identical_with_crop_margin(clean):
+    inferencer = make_inferencer(
+        output_patch_size=(2, 8, 8),
+        output_patch_overlap=(1, 4, 4),
+        crop_output_margin=True,
+    )
+    rng = np.random.default_rng(11)
+    chunks = [
+        Chunk(rng.random(s).astype(np.float32), voxel_offset=(64 * i, 0, 0))
+        for i, s in enumerate([(8, 32, 32), (6, 24, 28)])
+    ]
+    _parity_check(inferencer, chunks)
+
+
+def test_packed_bit_identical_under_concurrent_submitters(clean):
+    """Mixed-size requests racing in from many threads — the serving
+    shape — still scatter back to the right task, bitwise."""
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(5)
+    chunks = [
+        Chunk(rng.random(RAGGED_SHAPES[i % len(RAGGED_SHAPES)])
+              .astype(np.float32), voxel_offset=(64 * i, 0, 0))
+        for i in range(12)
+    ]
+    refs = [np.asarray(inferencer(c).array) for c in chunks]
+    packer = PatchPacker(inferencer, max_wait_ms=1.0)
+    results = [None] * len(chunks)
+
+    def submit(i):
+        results[i] = packer.submit(chunks[i]).result(timeout=60)
+
+    threads = [threading.Thread(target=submit, args=(i,))
+               for i in range(len(chunks))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    packer.close()
+    for ref, out in zip(refs, results):
+        assert out is not None
+        assert np.array_equal(np.asarray(out.array), ref)
+
+
+def test_all_zero_chunk_takes_blank_path(clean):
+    inferencer = make_inferencer()
+    chunk = Chunk(np.zeros((8, 32, 32), dtype=np.float32))
+    ref = inferencer(chunk)
+    packer = PatchPacker(inferencer)
+    out = packer.infer(chunk, timeout=30)
+    packer.close()
+    assert np.array_equal(np.asarray(out.array), np.asarray(ref.array))
+    assert out.array.dtype == ref.array.dtype
+
+
+# ---------------------------------------------------------------------------
+# kill switch
+# ---------------------------------------------------------------------------
+def test_kill_switch_restores_per_chunk_path(clean):
+    """CHUNKFLOW_SERVE=0: submit() routes through the untouched
+    per-chunk program — bit-identical by construction — and builds NO
+    serve program at all (the repo's kill-switch convention)."""
+    clean.setenv("CHUNKFLOW_SERVE", "0")
+    assert not serve_enabled()
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(9)
+    chunks = [
+        Chunk(rng.random(s).astype(np.float32), voxel_offset=(64 * i, 0, 0))
+        for i, s in enumerate(RAGGED_SHAPES[:3])
+    ]
+    refs = [np.asarray(inferencer(c).array) for c in chunks]
+    packer = PatchPacker(inferencer, max_wait_ms=1.0)
+    outs = [packer.submit(c).result(timeout=30) for c in chunks]
+    packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(np.asarray(out.array), ref)
+    keys = {key[0] for key, _ in inferencer._programs.items()}
+    assert "serve_forward" not in keys
+    assert "serve_scatter" not in keys
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("serving/fallbacks", 0) == len(chunks)
+    assert "serving/batches" not in snap["counters"]
+
+
+def test_sharded_and_fold_inferencers_fall_back(clean):
+    inferencer = make_inferencer(blend="fold")
+    rng = np.random.default_rng(2)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    ref = np.asarray(inferencer(chunk).array)
+    packer = PatchPacker(inferencer)
+    out = packer.infer(chunk, timeout=60)
+    packer.close()
+    assert np.array_equal(np.asarray(out.array), ref)
+    assert telemetry.snapshot()["counters"].get("serving/fallbacks") == 1
+
+
+# ---------------------------------------------------------------------------
+# occupancy telemetry + compile-cache reuse
+# ---------------------------------------------------------------------------
+def test_occupancy_telemetry_and_single_forward_trace(clean):
+    """Same-size 3-patch requests against batch 8: the packed plane
+    must account every batch slot (real + filler = batches * B), fill
+    past per-chunk occupancy on concurrent traffic, and trace the
+    forward program exactly once."""
+    inferencer = make_inferencer(
+        input_patch_size=(4, 16, 16), output_patch_overlap=(0, 0, 0),
+        batch_size=8,
+    )
+    rng = np.random.default_rng(1)
+    chunks = [
+        Chunk(rng.random((4, 16, 48)).astype(np.float32),
+              voxel_offset=(8 * i, 0, 0))
+        for i in range(8)  # 8 requests x 3 patches = 24 = 3 full batches
+    ]
+    packer = PatchPacker(inferencer, max_wait_ms=20.0)
+    handles = [packer.submit(c) for c in chunks]
+    for h in handles:
+        h.result(timeout=60)
+    packer.close()
+    snap = telemetry.snapshot()
+    counters = snap["counters"]
+    batches = counters["serving/batches"]
+    assert counters["serving/packed_patches"] == 24
+    assert (counters["serving/packed_patches"]
+            + counters.get("serving/filler_slots", 0)) == batches * 8
+    # the per-chunk path would have dispatched 8 one-per-request
+    # batches; packing crosses requests, so strictly fewer
+    assert batches < len(chunks)
+    occupancy = counters["serving/packed_patches"] / (batches * 8)
+    assert occupancy > 0.5
+    # ONE forward trace serves all traffic (compile-cache reuse)
+    forward_keys = [key for key, _ in inferencer._programs.items()
+                    if key[0] == "serve_forward"]
+    assert len(forward_keys) == 1
+    assert "serving/queue_age" in snap["hists"]
+    assert "serving/occupancy" in snap["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# deadlines + teardown
+# ---------------------------------------------------------------------------
+def test_expired_request_fails_with_request_expired(clean):
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    packer = PatchPacker(inferencer, max_wait_ms=1.0)
+    handle = packer.submit(chunk, deadline=time.time() - 1.0)
+    with pytest.raises(RequestExpired):
+        handle.result(timeout=30)
+    # the packer stays healthy for later traffic
+    ref = np.asarray(inferencer(chunk).array)
+    out = packer.infer(chunk, timeout=30)
+    packer.close()
+    assert np.array_equal(np.asarray(out.array), ref)
+
+
+def test_close_without_drain_fails_queued_requests(clean):
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(0)
+    # a huge wait window so the queued request is still pending at close
+    packer = PatchPacker(inferencer, max_wait_ms=60_000.0)
+    handle = packer.submit(Chunk(rng.random((4, 16, 16))
+                                 .astype(np.float32)))
+    packer.close(drain=False)
+    with pytest.raises((PackerClosed, RequestExpired)):
+        handle.result(timeout=10)
+    # a submit after close fails cleanly too
+    late = packer.submit(Chunk(rng.random((4, 16, 16))
+                               .astype(np.float32)))
+    with pytest.raises(PackerClosed):
+        late.result(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# lint gate: the serve modules are GL001-GL007 clean
+# ---------------------------------------------------------------------------
+def test_serve_modules_are_graftlint_clean():
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        [
+            "chunkflow_tpu/serve/__init__.py",
+            "chunkflow_tpu/serve/packer.py",
+            "chunkflow_tpu/serve/frontend.py",
+        ],
+        config, repo_root=repo_root,
+    )
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]

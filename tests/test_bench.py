@@ -539,3 +539,42 @@ def test_compare_percentage_metrics_warn_only():
 def test_compare_empty_ledger_is_ok(tmp_path):
     assert bench.compare_main(
         [f"--ledger={tmp_path / 'missing.jsonl'}"]) == 0
+
+
+@pytest.mark.bench
+@pytest.mark.slow
+def test_serving_throughput_microbench(tmp_path):
+    """Packed cross-request batching must beat sequential per-chunk
+    execution on many small concurrent requests (ISSUE 9 acceptance:
+    >= 1.3x packed-occupancy speedup) and stay bit-identical —
+    run_serving_throughput itself raises on any divergence.
+
+    Marked slow/bench like the other load-sensitive ratio gates;
+    run_tests.sh runs the same workload as a standalone gate after
+    fleet_smoke. Fresh-subprocess + best-of-3 pattern shared with them."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # the 8-device virtual mesh (conftest.py)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "serving_throughput"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] > best["value"]:
+            best = stats
+        if best["value"] >= 1.3:
+            break
+    assert best["metric"] == "serving_throughput"
+    assert best["value"] >= 1.3, best
+    assert best["gate_pass"] is True, best
+    assert best["bit_identical"] is True, best
+    # the win is occupancy by construction: the packer must actually
+    # have filled its batches from cross-request traffic
+    assert best["packed_occupancy"] >= 0.9, best
