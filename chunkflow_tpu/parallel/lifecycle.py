@@ -252,6 +252,9 @@ class LeaseRenewer:
         self.interval = max(0.05, float(interval))
         self.timeout = timeout
         self.renewals = 0
+        # guards the renewals counter: the renewer thread increments it
+        # while supervisors/tests read it live (GL010)
+        self._count_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
@@ -265,7 +268,8 @@ class LeaseRenewer:
     def _run(self) -> None:
         while not self._stop.wait(self.interval):
             if _renew_with_retry(self.queue, self.handle, self.timeout):
-                self.renewals += 1
+                with self._count_lock:
+                    self.renewals += 1
 
     def stop(self) -> None:
         self._stop.set()

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -257,9 +258,19 @@ class QueueBase:
 
 
 class MemoryQueue(QueueBase):
-    """In-process queue with visibility timeout semantics."""
+    """In-process queue with visibility timeout semantics.
+
+    Thread-safe: one MemoryQueue is drained by several worker THREADS at
+    once (the serving front-end's LocalBackend runs a claim loop per
+    worker thread, the lifecycle heartbeat renews leases from its own
+    thread). ``receive`` in particular is a compound
+    claim-and-make-invisible — unlocked, two threads could claim the
+    same handle (double execution) or crash on the second ``del``, so
+    every compound state transition holds ``_lock``.
+    """
 
     _registry: Dict[str, "MemoryQueue"] = {}
+    _registry_lock = threading.Lock()
 
     def __init__(self, name: str, visibility_timeout: float = 1800.0):
         self.name = name
@@ -270,24 +281,29 @@ class MemoryQueue(QueueBase):
         self.receives: Dict[str, int] = {}
         self.dead: Dict[str, dict] = {}
         self.retry_sleep = 0.01
+        self._lock = threading.Lock()
 
     @classmethod
     def open(cls, name: str, visibility_timeout: float = 1800.0) -> "MemoryQueue":
-        if name not in cls._registry:
-            cls._registry[name] = cls(name, visibility_timeout)
-        else:
-            # a reopen with a different timeout is a reconfiguration,
-            # not a no-op: silently keeping the first value would give
-            # lease renewal / requeue tests (and real workers) a
-            # different timeout than they asked for
-            cls._registry[name].visibility_timeout = visibility_timeout
-        return cls._registry[name]
+        with cls._registry_lock:
+            if name not in cls._registry:
+                cls._registry[name] = cls(name, visibility_timeout)
+            else:
+                # a reopen with a different timeout is a reconfiguration,
+                # not a no-op: silently keeping the first value would give
+                # lease renewal / requeue tests (and real workers) a
+                # different timeout than they asked for
+                cls._registry[name].visibility_timeout = visibility_timeout
+            return cls._registry[name]
 
     def send_messages(self, bodies: List[str]) -> None:
-        for body in self._pack_bodies(bodies):
-            self.pending[uuid.uuid4().hex] = body
+        packed = self._pack_bodies(bodies)  # telemetry outside the lock
+        with self._lock:
+            for body in packed:
+                self.pending[uuid.uuid4().hex] = body
 
     def _requeue_expired(self) -> None:
+        """Caller holds ``_lock``."""
         now = time.time()
         expired = [h for h, (_, deadline) in self.invisible.items()
                    if now > deadline]
@@ -296,82 +312,94 @@ class MemoryQueue(QueueBase):
             self.pending[h] = body
 
     def receive(self) -> Optional[Tuple[str, str]]:
-        self._requeue_expired()
-        if not self.pending:
-            return None
-        handle, wire = next(iter(self.pending.items()))
-        del self.pending[handle]
-        self.invisible[handle] = (wire, time.time() + self.visibility_timeout)
-        self.receives[handle] = self.receives.get(handle, 0) + 1
+        with self._lock:
+            self._requeue_expired()
+            if not self.pending:
+                return None
+            handle, wire = next(iter(self.pending.items()))
+            del self.pending[handle]
+            self.invisible[handle] = (
+                wire, time.time() + self.visibility_timeout
+            )
+            self.receives[handle] = self.receives.get(handle, 0) + 1
         body, trace_id = unpack_task(wire)
         self._note_receive(handle, trace_id)
         return handle, body
 
     def delete(self, handle: str) -> None:
-        self.invisible.pop(handle, None)
-        self.pending.pop(handle, None)
-        self.receives.pop(handle, None)
-        getattr(self, "_traces", {}).pop(handle, None)
+        with self._lock:
+            self.invisible.pop(handle, None)
+            self.pending.pop(handle, None)
+            self.receives.pop(handle, None)
+            getattr(self, "_traces", {}).pop(handle, None)
 
     def renew(self, handle: str, timeout: Optional[float] = None) -> None:
-        entry = self.invisible.get(handle)
-        if entry is None:
-            return  # already expired/acked: nothing to extend
-        timeout = self.visibility_timeout if timeout is None else timeout
-        self.invisible[handle] = (entry[0], time.time() + timeout)
+        with self._lock:
+            entry = self.invisible.get(handle)
+            if entry is None:
+                return  # already expired/acked: nothing to extend
+            timeout = self.visibility_timeout if timeout is None else timeout
+            self.invisible[handle] = (entry[0], time.time() + timeout)
 
     def nack(self, handle: str, refund: bool = True) -> bool:
-        entry = self.invisible.pop(handle, None)
-        if entry is None:
-            return False  # already acked or expired: nothing to release
-        self.pending[handle] = entry[0]
-        if refund:
-            # a first-party handback is not a failed attempt (see
-            # QueueBase.nack); third-party force_release preserves the
-            # count so crash deliveries accrue
-            count = self.receives.get(handle, 0)
-            if count > 0:
-                self.receives[handle] = count - 1
-        return True
+        with self._lock:
+            entry = self.invisible.pop(handle, None)
+            if entry is None:
+                return False  # already acked or expired: nothing to release
+            self.pending[handle] = entry[0]
+            if refund:
+                # a first-party handback is not a failed attempt (see
+                # QueueBase.nack); third-party force_release preserves the
+                # count so crash deliveries accrue
+                count = self.receives.get(handle, 0)
+                if count > 0:
+                    self.receives[handle] = count - 1
+            return True
 
     def receive_count(self, handle: str) -> int:
-        return self.receives.get(handle, 0)
+        with self._lock:
+            return self.receives.get(handle, 0)
 
     def dead_letter(self, handle: str, reason: str = "") -> None:
-        entry = self.invisible.pop(handle, None)
-        body = entry[0] if entry else self.pending.pop(handle, None)
-        if body is None:
-            return
-        self.dead[handle] = {
-            "body": body, "reason": reason,
-            "receives": self.receives.pop(handle, 0), "t": time.time(),
-        }
+        with self._lock:
+            entry = self.invisible.pop(handle, None)
+            body = entry[0] if entry else self.pending.pop(handle, None)
+            if body is None:
+                return
+            self.dead[handle] = {
+                "body": body, "reason": reason,
+                "receives": self.receives.pop(handle, 0), "t": time.time(),
+            }
 
     def dead_letters(self) -> List[dict]:
-        return [self._present(entry) for entry in self.dead.values()]
+        with self._lock:
+            return [self._present(entry) for entry in self.dead.values()]
 
     def requeue_dead(self) -> int:
-        count = 0
-        for handle, entry in list(self.dead.items()):
-            del self.dead[handle]
-            # the stored body is still the wire envelope: the requeued
-            # task keeps its original trace id, fresh retry budget
-            self.pending[handle] = entry["body"]
-            count += 1
-        return count
+        with self._lock:
+            count = 0
+            for handle, entry in list(self.dead.items()):
+                del self.dead[handle]
+                # the stored body is still the wire envelope: the requeued
+                # task keeps its original trace id, fresh retry budget
+                self.pending[handle] = entry["body"]
+                count += 1
+            return count
 
     def stats(self) -> dict:
-        self._requeue_expired()
-        return {
-            "pending": len(self.pending),
-            "inflight": len(self.invisible),
-            "dead": len(self.dead),
-            "receives": sum(self.receives.values()),
-        }
+        with self._lock:
+            self._requeue_expired()
+            return {
+                "pending": len(self.pending),
+                "inflight": len(self.invisible),
+                "dead": len(self.dead),
+                "receives": sum(self.receives.values()),
+            }
 
     def __len__(self) -> int:
-        self._requeue_expired()
-        return len(self.pending)
+        with self._lock:
+            self._requeue_expired()
+            return len(self.pending)
 
 
 class FileQueue(QueueBase):

@@ -313,10 +313,32 @@ def serve(
 
     server = ThreadingHTTPServer((host, port), Handler)
     if background:
-        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread = threading.Thread(target=server.serve_forever, daemon=True,
+                                  name=f"http-{server.server_address[1]}")
+        # keep the handle ON the server: every caller that only holds
+        # the server (start_serving, start_metrics_exporter) can still
+        # join the listener thread at shutdown instead of dropping it
+        server._serve_thread = thread
         thread.start()
         return server, thread
     server.serve_forever()  # pragma: no cover
+
+
+def shutdown_server(server, timeout: float = 5.0) -> None:
+    """Tear down a background listener from :func:`serve`/
+    :func:`start_metrics_exporter`/``start_serving``: stop
+    ``serve_forever``, close the listening socket, and JOIN the server
+    thread. ``server.shutdown()`` alone leaves the daemon thread handle
+    dropped — harmless for one server, a thread leak for every
+    start/stop cycle a test suite or an elastic fleet performs. None is
+    accepted (the telemetry-disabled exporter returns no server)."""
+    if server is None:
+        return
+    server.shutdown()
+    server.server_close()
+    thread = getattr(server, "_serve_thread", None)
+    if thread is not None and thread.is_alive():
+        thread.join(timeout=timeout)
 
 
 # ---------------------------------------------------------------------------

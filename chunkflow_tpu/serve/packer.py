@@ -238,9 +238,14 @@ class PatchPacker:
                 handle._fail(PackerClosed("packer is shut down"))
                 return handle
             while (len(self._items) + req.n > self.max_queue_patches
-                   and not self._stop):
+                   and self._items and not self._stop):
                 # bounded queue: submission backpressure rather than
-                # unbounded host memory under a traffic spike
+                # unbounded host memory under a traffic spike. The
+                # `self._items` term keeps the predicate satisfiable: a
+                # single request larger than the whole bound is admitted
+                # once the queue has drained, instead of waiting on a
+                # condition that can never become true (a request with
+                # n > max_queue_patches used to hang submit forever)
                 self._cv.wait(0.05)
             if self._stop:
                 handle._fail(PackerClosed("packer is shut down"))

@@ -1,4 +1,9 @@
-"""Deterministic test harnesses (fault injection, fixtures).
+"""Deterministic test harnesses (fault injection, lock sanitizing).
+
+``chaos`` kills lifecycle stages at seeded boundaries; ``locksmith``
+proxies this codebase's Lock/RLock/Condition constructions and raises
+on lock-order cycles (potential deadlocks) — both opt-in by env var,
+both default-exercised by the tier-1 suite.
 
 Import-light by design: modules here are imported from production hot
 paths (``flow/runtime.py`` consults the chaos harness per operator), so

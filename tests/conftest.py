@@ -35,6 +35,18 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Locksmith concurrency sanitizer (chunkflow_tpu/testing/locksmith.py):
+# proxy every Lock/RLock/Condition this codebase creates and raise on
+# lock-order cycles, so the whole tier-1 suite doubles as a concurrency
+# test. Installed BEFORE any chunkflow module import so module-level
+# locks (scheduler watermark, profiling state, telemetry registry) are
+# covered too. Default ON for the suite; CHUNKFLOW_LOCKSMITH=0 disables
+# (and then install() is a strict no-op — no proxies, no files).
+os.environ.setdefault("CHUNKFLOW_LOCKSMITH", "1")
+from chunkflow_tpu.testing import locksmith  # noqa: E402
+
+locksmith.install()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

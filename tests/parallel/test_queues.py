@@ -448,3 +448,43 @@ class TestSQSQueue:
         assert q.requeue_dead() == 1
         handle, body = q.receive()
         assert body == "poison"
+
+
+class TestMemoryQueueConcurrency:
+    def test_concurrent_receive_claims_each_task_exactly_once(self):
+        """Regression (concurrency plane): ``receive`` is a compound
+        claim-and-make-invisible. Unlocked, two LocalBackend worker
+        threads could claim the same handle (double execution) or die
+        on the second ``del``; under the queue lock every task is
+        claimed exactly once across racing threads."""
+        import threading
+
+        q = MemoryQueue("t-concurrent-claims", visibility_timeout=100)
+        n_tasks, n_threads = 300, 8
+        q.send_messages([f"task-{i}" for i in range(n_tasks)])
+        claimed, errors = [], []
+        claimed_lock = threading.Lock()
+
+        def worker():
+            while True:
+                try:
+                    item = q.receive()
+                except Exception as exc:  # noqa: BLE001 — the regression
+                    errors.append(exc)
+                    return
+                if item is None:
+                    return
+                with claimed_lock:
+                    claimed.append(item)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, f"receive raced: {errors[:3]}"
+        bodies = sorted(body for _h, body in claimed)
+        assert bodies == sorted(f"task-{i}" for i in range(n_tasks))
+        handles = [h for h, _b in claimed]
+        assert len(set(handles)) == len(handles)  # no double-claims

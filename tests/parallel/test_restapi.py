@@ -16,6 +16,7 @@ from chunkflow_tpu.parallel.restapi import (
     render_prometheus,
     scrape_worker,
     serve,
+    shutdown_server,
     start_metrics_exporter,
 )
 from chunkflow_tpu.parallel.task_tree import SpatialTaskTree
@@ -302,3 +303,17 @@ def test_achieved_mvox_s_derivation():
     # no voxel count yet: the figure is simply absent
     assert achieved_mvox_s({"chunkflow_pipeline_compute_sum": 1.0}) is None
     assert achieved_mvox_s({}) is None
+
+
+def test_shutdown_server_joins_listener_thread(clean_telemetry):
+    """Regression (GL013 audit): callers holding only the server object
+    (start_metrics_exporter, start_serving) used to have no way to join
+    the listener thread — shutdown() left the handle dropped, a thread
+    leak per start/stop cycle. The thread now rides on the server and
+    shutdown_server joins it."""
+    server = start_metrics_exporter(0, host="127.0.0.1")
+    thread = server._serve_thread
+    assert thread.is_alive()
+    shutdown_server(server)
+    assert not thread.is_alive()
+    shutdown_server(None)  # telemetry-off exporter returns None: no-op

@@ -305,3 +305,28 @@ def test_serve_modules_are_graftlint_clean():
     assert not findings, [
         f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
     ]
+
+
+def test_request_larger_than_queue_bound_completes(clean):
+    """Regression (GL014 audit): a request with more patches than
+    ``max_queue_patches`` used to spin forever in submit's backpressure
+    loop — the predicate ``len(items) + n <= bound`` can never become
+    true when ``n > bound``. Oversized requests are now admitted once
+    the queue has drained."""
+    inferencer = make_inferencer()
+    rng = np.random.default_rng(11)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    ref = np.asarray(inferencer(chunk).array)
+    packer = PatchPacker(inferencer, max_wait_ms=1.0, max_queue_patches=2)
+    done = threading.Event()
+    out = {}
+
+    def go():
+        out["chunk"] = packer.submit(chunk).result(timeout=30)
+        done.set()
+
+    thread = threading.Thread(target=go, daemon=True)
+    thread.start()
+    assert done.wait(30), "submit hung: oversized-request livelock is back"
+    packer.close()
+    assert np.array_equal(np.asarray(out["chunk"].array), ref)

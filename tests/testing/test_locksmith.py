@@ -1,0 +1,259 @@
+"""Locksmith runtime sanitizer tests: seeded AB/BA inversion detection,
+RLock reentrancy, proxy transparency, the kill switch, hold ceilings.
+
+These tests manage the sanitizer's global state themselves (uninstall +
+reset around each) because conftest enables locksmith for the whole
+tier-1 suite — the fixture hands each test a clean graph.
+"""
+import queue
+import threading
+import time
+
+import pytest
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.testing import locksmith
+
+
+def own_violations(rep):
+    """Violations whose lock sites live in THIS file — daemon threads
+    from earlier suite tests (packer dispatchers, heartbeats) may still
+    be recording into the global graph while these tests run."""
+    return [v for v in rep["violations"]
+            if "test_locksmith" in v.get("detail", "")]
+
+
+@pytest.fixture
+def fresh(monkeypatch):
+    was_installed = locksmith.installed()
+    locksmith.uninstall()
+    locksmith.reset_state()
+    monkeypatch.setenv("CHUNKFLOW_LOCKSMITH", "1")
+    monkeypatch.delenv("CHUNKFLOW_LOCKSMITH_MODE", raising=False)
+    monkeypatch.delenv("CHUNKFLOW_LOCKSMITH_HOLD_MS", raising=False)
+    yield locksmith
+    locksmith.uninstall()
+    locksmith.reset_state()
+    if was_installed:
+        locksmith.install()
+
+
+def test_detects_seeded_ab_ba_inversion(fresh):
+    """The acceptance fixture: thread 1 takes A then B, thread 2 takes
+    B then A — deterministic (sequential threads), no real contention,
+    and the second thread's inner acquire must raise BEFORE acquiring."""
+    assert fresh.install()
+    a = threading.Lock()
+    b = threading.Lock()
+    caught = []
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        try:
+            with b:
+                with a:  # closes the cycle: must raise here
+                    pytest.fail("inverted acquire went through")
+        except locksmith.LockOrderError as exc:
+            caught.append(exc)
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join(timeout=10)
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join(timeout=10)
+    assert len(caught) == 1
+    assert "cycle" in str(caught[0])
+    assert not a.locked() and not b.locked()  # clean unwinding
+    mine = own_violations(fresh.report())
+    assert mine and mine[0]["kind"] == "lock-order-cycle"
+
+
+def test_transitive_cycle_through_three_locks(fresh):
+    fresh.install()
+    a, b, c = threading.Lock(), threading.Lock(), threading.Lock()
+    caught = []
+
+    def run(first, second, expect_raise=False):
+        def body():
+            try:
+                with first:
+                    with second:
+                        pass
+            except locksmith.LockOrderError as exc:
+                caught.append(exc)
+        t = threading.Thread(target=body)
+        t.start()
+        t.join(timeout=10)
+
+    run(a, b)
+    run(b, c)
+    run(c, a)  # a -> b -> c -> a
+    assert len(caught) == 1
+
+
+def test_single_thread_both_orders_not_flagged(fresh):
+    """One thread running A->B then B->A sequentially cannot deadlock
+    against itself — the diversity criterion keeps tier-1 false-positive
+    free."""
+    fresh.install()
+    a, b = threading.Lock(), threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert own_violations(fresh.report()) == []
+
+
+def test_rlock_reentrancy_not_flagged(fresh):
+    fresh.install()
+    r = threading.RLock()
+    with r:
+        with r:
+            with r:
+                pass
+    assert own_violations(fresh.report()) == []
+
+
+def test_plain_lock_self_deadlock_detected(fresh):
+    fresh.install()
+    lk = threading.Lock()
+    lk.acquire()
+    with pytest.raises(locksmith.LockOrderError, match="re-acquires"):
+        lk.acquire()
+    lk.release()
+
+
+def test_proxy_transparency(fresh):
+    fresh.install()
+    lk = threading.Lock()
+    assert lk.acquire(timeout=0.5) is True
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()
+    assert lk.acquire(False) is True  # non-blocking
+    lk.release()
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+    r = threading.RLock()
+    with r:
+        assert r.acquire(timeout=0.1) is True
+        r.release()
+    assert own_violations(fresh.report()) == []
+
+
+def test_condition_wait_notify_across_threads(fresh):
+    """Condition over a proxied lock: wait shows as release+reacquire,
+    the handoff works, and no violation is recorded."""
+    fresh.install()
+    cv = threading.Condition()
+    shared_cv = threading.Condition(threading.Lock())
+    items = []
+
+    def consumer():
+        with cv:
+            while not items:
+                cv.wait(timeout=5)
+            items.pop()
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        items.append(1)
+        cv.notify()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    with shared_cv:  # plain-lock condition path
+        assert shared_cv.wait(timeout=0.01) is False
+    assert own_violations(fresh.report()) == []
+
+
+def test_kill_switch_creates_no_proxies(fresh, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_LOCKSMITH", "0")
+    assert locksmith.install() is False
+    assert threading.Lock is locksmith._ORIG_LOCK
+    assert threading.Condition is locksmith._ORIG_CONDITION
+    lk = threading.Lock()
+    assert not hasattr(lk, "_ls_id")
+    assert not locksmith.installed()
+
+
+def test_out_of_scope_construction_gets_real_locks(fresh):
+    # stdlib frames (queue.Queue internals) must never be proxied
+    fresh.install()
+    q = queue.Queue()
+    assert not hasattr(q.mutex, "_ls_id")
+    lk = threading.Lock()  # this file IS in scope (tests/)
+    assert hasattr(lk, "_ls_id")
+
+
+def test_hold_ceiling_records_violation(fresh, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_LOCKSMITH_HOLD_MS", "10")
+    fresh.install()
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.05)
+    mine = [h for h in fresh.report()["hold_violations"]
+            if "test_locksmith" in h["lock"]]
+    assert mine
+    assert mine[0]["held_s"] >= 0.01
+
+
+def test_log_mode_records_without_raising(fresh, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_LOCKSMITH_MODE", "log")
+    fresh.install()
+    a, b = threading.Lock(), threading.Lock()
+
+    def order(first, second):
+        def body():
+            with first:
+                with second:
+                    pass
+        t = threading.Thread(target=body)
+        t.start()
+        t.join(timeout=10)
+
+    order(a, b)
+    order(b, a)  # would raise in raise mode; log mode records
+    assert len(own_violations(fresh.report())) == 1
+
+
+def test_report_and_publish_counters(fresh):
+    fresh.install()
+    telemetry.reset()
+    lk = threading.Lock()
+    with lk:
+        pass
+    rep = fresh.report()
+    assert rep["enabled"] and rep["locks"] >= 1 and rep["acquires"] >= 1
+    locksmith.publish()
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["locksmith/locks"] >= 1
+    assert gauges["locksmith/acquires"] >= 1
+    telemetry.reset()
+
+
+def test_thread_tokens_never_reused(fresh):
+    """Regression: threading.get_ident() is recycled after a thread
+    exits, which made two sequential threads look like one and
+    suppressed a genuine AB/BA inversion mid-suite. The registry's own
+    tokens are monotonic and never reused."""
+    fresh.install()
+    tokens = []
+
+    def grab():
+        tokens.append(locksmith._registry._thread_token())
+
+    for _ in range(3):
+        t = threading.Thread(target=grab)
+        t.start()
+        t.join(timeout=10)
+    assert len(set(tokens)) == 3

@@ -1,0 +1,225 @@
+"""graftlint result cache + SARIF output + CLI surface tests (ISSUE 10
+satellites): warm runs skip re-analysis and are measurably faster, cache
+keys track content/config/linter versions, --no-cache bypasses, --output
+sarif emits valid SARIF 2.1.0.
+"""
+import json
+import textwrap
+import time
+
+import pytest
+
+from tools.graftlint.config import Config
+from tools.graftlint.engine import lint_paths
+
+#: nontrivial enough that cold analysis costs real time per file
+SOURCE_TEMPLATE = """\
+import threading
+import numpy as np
+import jax
+
+
+@jax.jit
+def program_{i}(x):
+    return x * {i}
+
+
+class Worker{i}:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+
+    def bump(self):
+        with self.lock:
+            self.count += 1
+
+
+def host_{i}(chunk):
+    out = np.asarray(chunk)
+    return out.sum(dtype=np.float32)
+"""
+
+
+def make_tree(tmp_path, n=40):
+    pkg = tmp_path / "chunkflow_tpu" / "flow"
+    pkg.mkdir(parents=True)
+    for i in range(n):
+        (pkg / f"mod_{i}.py").write_text(SOURCE_TEMPLATE.format(i=i))
+    return tmp_path
+
+
+def test_warm_run_skips_analysis_and_is_faster(tmp_path, monkeypatch):
+    repo = make_tree(tmp_path)
+    config = Config(cache_dir=str(tmp_path / ".graftlint_cache"))
+
+    import tools.graftlint.engine as engine_mod
+
+    real_lint_file = engine_mod.lint_file
+    calls = {"n": 0}
+
+    def counting_lint_file(*args, **kwargs):
+        calls["n"] += 1
+        return real_lint_file(*args, **kwargs)
+
+    monkeypatch.setattr(engine_mod, "lint_file", counting_lint_file)
+
+    t0 = time.perf_counter()
+    cold, sup_cold = lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+    cold_s = time.perf_counter() - t0
+    assert calls["n"] == 40
+
+    t0 = time.perf_counter()
+    warm, sup_warm = lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+    warm_s = time.perf_counter() - t0
+    assert calls["n"] == 40  # zero re-analysis on the warm run
+    assert warm_s < cold_s  # and measurably faster wall-clock
+    assert [f.as_dict() for f in warm] == [f.as_dict() for f in cold]
+    assert sup_warm == sup_cold
+
+
+def test_edited_file_reanalyzed_others_cached(tmp_path, monkeypatch):
+    repo = make_tree(tmp_path, n=10)
+    config = Config(cache_dir=str(tmp_path / ".graftlint_cache"))
+    lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+
+    import tools.graftlint.engine as engine_mod
+
+    real_lint_file = engine_mod.lint_file
+    analyzed = []
+
+    def counting_lint_file(path, *args, **kwargs):
+        analyzed.append(path)
+        return real_lint_file(path, *args, **kwargs)
+
+    monkeypatch.setattr(engine_mod, "lint_file", counting_lint_file)
+    target = repo / "chunkflow_tpu" / "flow" / "mod_3.py"
+    target.write_text(target.read_text() + "\nEXTRA = 1\n")
+    lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+    assert analyzed == ["chunkflow_tpu/flow/mod_3.py"]
+
+
+def test_config_change_invalidates(tmp_path, monkeypatch):
+    repo = make_tree(tmp_path, n=3)
+    cache_dir = str(tmp_path / ".graftlint_cache")
+    lint_paths(["chunkflow_tpu"], Config(cache_dir=cache_dir),
+               repo_root=repo)
+
+    import tools.graftlint.engine as engine_mod
+
+    real_lint_file = engine_mod.lint_file
+    calls = {"n": 0}
+
+    def counting_lint_file(*args, **kwargs):
+        calls["n"] += 1
+        return real_lint_file(*args, **kwargs)
+
+    monkeypatch.setattr(engine_mod, "lint_file", counting_lint_file)
+    lint_paths(["chunkflow_tpu"],
+               Config(cache_dir=cache_dir, select=["GL001"]),
+               repo_root=repo)
+    assert calls["n"] == 3  # different select -> different keys
+
+
+def test_no_cache_bypasses(tmp_path, monkeypatch):
+    repo = make_tree(tmp_path, n=3)
+    config = Config(cache_dir=str(tmp_path / ".graftlint_cache"))
+    lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+
+    import tools.graftlint.engine as engine_mod
+
+    real_lint_file = engine_mod.lint_file
+    calls = {"n": 0}
+
+    def counting_lint_file(*args, **kwargs):
+        calls["n"] += 1
+        return real_lint_file(*args, **kwargs)
+
+    monkeypatch.setattr(engine_mod, "lint_file", counting_lint_file)
+    lint_paths(["chunkflow_tpu"], config, repo_root=repo,
+               use_cache=False)
+    assert calls["n"] == 3
+
+    # Config(cache_dir=None) disables too
+    lint_paths(["chunkflow_tpu"], Config(cache_dir=None), repo_root=repo)
+    assert calls["n"] == 6
+
+
+def test_torn_cache_entry_is_a_miss(tmp_path):
+    repo = make_tree(tmp_path, n=1)
+    config = Config(cache_dir=str(tmp_path / ".graftlint_cache"))
+    cold, _ = lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+    for entry in (tmp_path / ".graftlint_cache").rglob("*.json"):
+        entry.write_text("{ torn")
+    warm, _ = lint_paths(["chunkflow_tpu"], config, repo_root=repo)
+    assert [f.as_dict() for f in warm] == [f.as_dict() for f in cold]
+
+
+# ------------------------------------------------------------------ SARIF
+BAD_SOURCE = """\
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    return np.asarray(x).item()
+"""
+
+
+@pytest.fixture
+def bad_repo(tmp_path, monkeypatch):
+    pkg = tmp_path / "chunkflow_tpu" / "ops"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(textwrap.dedent(BAD_SOURCE))
+    (tmp_path / "pyproject.toml").write_text(
+        '[tool.graftlint]\ninclude = ["chunkflow_tpu"]\n'
+        'baseline = "baseline.json"\n'
+    )
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_cli_sarif_output(bad_repo, capsys):
+    from tools.graftlint.cli import main
+
+    assert main(["--output", "sarif", "--no-cache"]) == 1
+    log = json.loads(capsys.readouterr().out)
+    assert log["version"] == "2.1.0"
+    run = log["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graftlint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"GL001", "GL010", "GL011", "GL012", "GL013",
+            "GL014"} <= rule_ids
+    results = run["results"]
+    assert results and all(r["ruleId"] == "GL001" for r in results)
+    loc = results[0]["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "chunkflow_tpu/ops/bad.py"
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1  # SARIF columns are 1-based
+
+
+def test_cli_sarif_clean_run_has_no_results(bad_repo, capsys):
+    from tools.graftlint.cli import main
+
+    (bad_repo / "chunkflow_tpu" / "ops" / "bad.py").write_text(
+        "x = 1\n")
+    assert main(["--output", "sarif", "--no-cache"]) == 0
+    log = json.loads(capsys.readouterr().out)
+    assert log["runs"][0]["results"] == []
+
+
+def test_cli_stats_prints_rule_families(bad_repo, capsys):
+    from tools.graftlint.cli import main
+
+    assert main(["--stats", "--no-cache"]) == 1
+    out = capsys.readouterr().out
+    assert "graftlint stats:" in out
+    assert "jit" in out and "concurrency" in out
+    assert "GL001=2" in out
+
+
+def test_cli_json_alias_still_works(bad_repo, capsys):
+    from tools.graftlint.cli import main
+
+    assert main(["--json", "--no-baseline", "--no-cache"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert {f["code"] for f in payload["new"]} == {"GL001"}

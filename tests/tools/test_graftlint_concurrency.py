@@ -1,0 +1,553 @@
+"""GL010-series concurrency rule tests: one positive and one suppressed
+case per rule (the established graftlint pattern), plus the thread/lock
+model they rest on (tools/graftlint/threads.py).
+"""
+import textwrap
+
+from tools.graftlint.config import Config
+from tools.graftlint.engine import lint_file
+
+
+def run(src, path="chunkflow_tpu/flow/example.py", config=None):
+    findings, suppressed = lint_file(
+        path, textwrap.dedent(src), config or Config()
+    )
+    return findings, suppressed
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# ---------------------------------------------------------------- GL010
+GL010_POSITIVE = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.lock = threading.Lock()
+            self.count = 0
+            self.thread = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            self.count += 1
+
+        def snapshot(self):
+            with self.lock:
+                return self.count
+"""
+
+
+def test_gl010_detects_unlocked_shared_write():
+    findings, _ = run(GL010_POSITIVE)
+    assert codes(findings).count("GL010") == 1
+    assert "self.count" in findings[0].message
+
+
+def test_gl010_suppressed():
+    src = GL010_POSITIVE.replace(
+        "self.count += 1",
+        "self.count += 1  # graftlint: disable=GL010",
+    )
+    findings, suppressed = run(src)
+    assert "GL010" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl010_locked_write_is_clean():
+    src = GL010_POSITIVE.replace(
+        "        self.count += 1",
+        "        with self.lock:\n            self.count += 1",
+    )
+    findings, _ = run(src)
+    assert "GL010" not in codes(findings)
+
+
+def test_gl010_thread_private_state_is_clean():
+    # an attribute only the thread itself touches is not shared
+    findings, _ = run("""\
+        import threading
+
+        class Worker:
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.scratch = 0
+                self.scratch += 1
+    """)
+    assert "GL010" not in codes(findings)
+
+
+def test_gl010_propagates_through_local_calls():
+    # _step is thread-context because the thread target calls it
+    findings, _ = run("""\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.total = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                self.total += 1
+
+            def read(self):
+                return self.total
+    """)
+    assert codes(findings).count("GL010") == 1
+
+
+def test_gl010_module_global_write():
+    findings, _ = run("""\
+        import threading
+
+        _COUNT = 0
+        _LOCK = threading.Lock()
+
+        def _pump():
+            global _COUNT
+            _COUNT += 1
+
+        def start():
+            threading.Thread(target=_pump, daemon=True).start()
+    """)
+    assert codes(findings).count("GL010") == 1
+    findings, _ = run("""\
+        import threading
+
+        _COUNT = 0
+        _LOCK = threading.Lock()
+
+        def _pump():
+            global _COUNT
+            with _LOCK:
+                _COUNT += 1
+
+        def start():
+            threading.Thread(target=_pump, daemon=True).start()
+    """)
+    assert "GL010" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL011
+GL011_POSITIVE = """\
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self.a = threading.Lock()
+            self.b = threading.Lock()
+
+        def one(self):
+            with self.a:
+                with self.b:
+                    pass
+
+        def two(self):
+            with self.b:
+                with self.a:
+                    pass
+"""
+
+
+def test_gl011_detects_inversion():
+    findings, _ = run(GL011_POSITIVE)
+    assert codes(findings).count("GL011") == 1  # the pair reported once
+
+
+def test_gl011_suppressed():
+    src = GL011_POSITIVE.replace(
+        "            with self.b:\n                    pass",
+        "            with self.b:  # graftlint: disable=GL011\n"
+        "                    pass",
+    )
+    findings, _ = run(src)
+    assert "GL011" not in codes(findings)
+
+
+def test_gl011_consistent_order_is_clean():
+    findings, _ = run("""\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """)
+    assert "GL011" not in codes(findings)
+
+
+def test_gl011_inversion_through_call():
+    # two() holds b and calls helper(), which acquires a
+    findings, _ = run("""\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    self.helper()
+
+            def helper(self):
+                with self.a:
+                    pass
+    """)
+    assert codes(findings).count("GL011") == 1
+
+
+def test_gl011_condition_over_same_lock_is_one_mutex():
+    # two conditions wrapping one lock are NOT a second lock: the
+    # scheduler's _AdaptiveQueue shape must stay clean
+    findings, _ = run("""\
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._not_empty = threading.Condition(self._lock)
+                self._not_full = threading.Condition(self._lock)
+
+            def put(self):
+                with self._not_full:
+                    self._not_full.notify()
+
+            def close(self):
+                with self._lock:
+                    self._not_empty.notify_all()
+    """)
+    assert "GL011" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL012
+GL012_POSITIVE = """\
+    import threading
+    import urllib.request
+
+    class Client:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def fetch(self, url, q, thread):
+            with self._lock:
+                data = urllib.request.urlopen(url)
+                item = q.get()
+                thread.join()
+            return data, item
+"""
+
+
+def test_gl012_detects_blocking_calls_under_lock():
+    findings, _ = run(GL012_POSITIVE)
+    assert codes(findings).count("GL012") == 3  # urlopen, .get(), .join()
+
+
+def test_gl012_suppressed():
+    src = GL012_POSITIVE.replace(
+        "data = urllib.request.urlopen(url)",
+        "data = urllib.request.urlopen(url)  # graftlint: disable=GL012",
+    ).replace(
+        "item = q.get()",
+        "item = q.get()  # graftlint: disable=GL012",
+    ).replace(
+        "thread.join()",
+        "thread.join()  # graftlint: disable=GL012",
+    )
+    findings, suppressed = run(src)
+    assert "GL012" not in codes(findings)
+    assert suppressed == 3
+
+
+def test_gl012_bounded_waits_are_clean():
+    findings, _ = run("""\
+        import threading
+
+        class Client:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self, q, thread):
+                with self._lock:
+                    item = q.get(timeout=1.0)
+                    thread.join(timeout=2.0)
+                return item
+    """)
+    assert "GL012" not in codes(findings)
+
+
+def test_gl012_condition_wait_is_exempt():
+    # cv.wait releases the lock while waiting — that is the point
+    findings, _ = run("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def get(self):
+                with self._cv:
+                    while True:
+                        self._cv.wait(0.1)
+    """)
+    assert "GL012" not in codes(findings)
+
+
+def test_gl012_event_wait_and_device_sync_under_lock():
+    findings, _ = run("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def drain(self, out):
+                with self._lock:
+                    self._done.wait()
+                    out.block_until_ready()
+    """)
+    assert codes(findings).count("GL012") == 2
+
+
+def test_gl012_outside_lock_is_clean():
+    findings, _ = run("""\
+        def fetch(q, thread):
+            item = q.get()
+            thread.join()
+            return item
+    """)
+    assert "GL012" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL013
+GL013_POSITIVE = """\
+    import threading
+
+    def spawn():
+        t = threading.Thread(target=print)
+        t.start()
+        return t
+"""
+
+
+def test_gl013_detects_leaked_thread():
+    findings, _ = run(GL013_POSITIVE)
+    assert codes(findings).count("GL013") == 1
+
+
+def test_gl013_suppressed():
+    src = GL013_POSITIVE.replace(
+        "t = threading.Thread(target=print)",
+        "t = threading.Thread(target=print)  # graftlint: disable=GL013",
+    )
+    findings, _ = run(src)
+    assert "GL013" not in codes(findings)
+
+
+def test_gl013_daemon_and_joined_are_clean():
+    findings, _ = run("""\
+        import threading
+
+        def fire_and_forget():
+            threading.Thread(target=print, daemon=True).start()
+
+        def bounded():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+    """)
+    assert "GL013" not in codes(findings)
+
+
+def test_gl013_handle_joined_in_other_method():
+    findings, _ = run("""\
+        import threading
+
+        class Pump:
+            def start(self):
+                self._thread = threading.Thread(target=self._run)
+                self._thread.start()
+
+            def _run(self):
+                pass
+
+            def stop(self):
+                self._thread.join(timeout=5.0)
+    """)
+    assert "GL013" not in codes(findings)
+
+
+def test_gl013_pool_joined_via_loop():
+    # the LocalBackend shape: a list of threads joined in close()
+    findings, _ = run("""\
+        import threading
+
+        class Pool:
+            def __init__(self, n):
+                self._threads = [
+                    threading.Thread(target=self._run) for _ in range(n)
+                ]
+
+            def _run(self):
+                pass
+
+            def close(self):
+                for t in self._threads:
+                    t.join(timeout=1.0)
+    """)
+    assert "GL013" not in codes(findings)
+
+
+def test_gl013_dropped_handle():
+    findings, _ = run("""\
+        import threading
+
+        def spawn():
+            threading.Thread(target=print).start()
+    """)
+    assert codes(findings).count("GL013") == 1
+
+
+# ---------------------------------------------------------------- GL014
+GL014_POSITIVE = """\
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self._ready = False
+
+        def get(self):
+            with self._cv:
+                if not self._ready:
+                    self._cv.wait()
+                return self._ready
+"""
+
+
+def test_gl014_detects_wait_outside_loop():
+    findings, _ = run(GL014_POSITIVE)
+    assert codes(findings).count("GL014") == 1
+
+
+def test_gl014_suppressed():
+    src = GL014_POSITIVE.replace(
+        "self._cv.wait()",
+        "self._cv.wait()  # graftlint: disable=GL014",
+    )
+    findings, _ = run(src)
+    assert "GL014" not in codes(findings)
+
+
+def test_gl014_predicate_loop_is_clean():
+    src = GL014_POSITIVE.replace(
+        "if not self._ready:", "while not self._ready:"
+    )
+    findings, _ = run(src)
+    assert "GL014" not in codes(findings)
+
+
+def test_gl014_wait_for_is_clean():
+    findings, _ = run("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._ready = False
+
+            def get(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self._ready)
+                    return self._ready
+    """)
+    assert "GL014" not in codes(findings)
+
+
+def test_gl014_event_wait_not_flagged():
+    # Event.wait is level-triggered: no predicate loop required
+    findings, _ = run("""\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._done = threading.Event()
+
+            def get(self):
+                self._done.wait()
+    """)
+    assert "GL014" not in codes(findings)
+
+
+# ------------------------------------------------- thread/lock model
+def test_model_entries_via_submit_and_timer():
+    from tools.graftlint.context import FileContext
+    from tools.graftlint.threads import get_model
+
+    src = textwrap.dedent("""\
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        def pumped():
+            pass
+
+        def timed():
+            pass
+
+        def start(pool: ThreadPoolExecutor):
+            pool.submit(pumped)
+            threading.Timer(1.0, timed).start()
+    """)
+    model = get_model(FileContext("chunkflow_tpu/x.py", src))
+    names = {fn.name for fn in model.thread_entries}
+    assert names == {"pumped", "timed"}
+
+
+def test_model_iter_held_tracks_nested_with():
+    import ast
+
+    from tools.graftlint.context import FileContext
+    from tools.graftlint.threads import get_model
+
+    src = textwrap.dedent("""\
+        import threading
+
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def f():
+            with _A:
+                with _B:
+                    x = 1
+            y = 2
+    """)
+    ctx = FileContext("chunkflow_tpu/x.py", src)
+    model = get_model(ctx)
+    fn = next(n for n in ctx.functions)
+    held_at = {}
+    for node, held in model.iter_held(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            held_at[node.id] = tuple(t for t, _k in held)
+    assert held_at["x"] == (("mod", "_A"), ("mod", "_B"))
+    assert held_at["y"] == ()

@@ -1,11 +1,14 @@
-"""graftlint: a JAX/TPU correctness linter purpose-built for chunkflow-tpu.
+"""graftlint: a JAX/TPU correctness + concurrency linter for chunkflow-tpu.
 
 Chunkflow's throughput rests on invariants the compiler cannot see: jitted
 hot paths must stay free of host syncs, numpy ops must not touch traced
 values, Python control flow must not branch on tracers, accumulators must
 stay float32, big chunk buffers should be donated, and every axis shuffle
-on a zyx chunk needs its order spelled out. graftlint checks those
-statically, with a per-rule baseline so CI only fails on NEW violations.
+on a zyx chunk needs its order spelled out. Its host side is seriously
+concurrent, so the same goes for thread/lock discipline. graftlint checks
+both statically, with a per-rule baseline so CI only fails on NEW
+violations, and a content-hash result cache so reruns only re-analyze
+changed files.
 
 Rules
 -----
@@ -15,13 +18,24 @@ GL003  Python control flow on a tracer-derived value (recompile/leak)
 GL004  implicit float64 literal or dtype promotion in ops/ and inference/
 GL005  chunk-sized array passed to jax.jit without donate_argnums
 GL006  axis shuffle on a chunk array without an axis-order comment/helper
+GL007  telemetry/wall-clock call inside a jit-traced function
+GL010  shared mutable attribute written from a thread without a lock
+GL011  lock-acquisition-order inversion within one class/module
+GL012  blocking call (queue get/put, join, device sync, HTTP) under a lock
+GL013  threading.Thread neither daemonized nor joined
+GL014  Condition.wait outside a predicate loop
+
+The GL010-series' runtime twin is the locksmith lock-order sanitizer
+(chunkflow_tpu/testing/locksmith.py), default-on under the tier-1 suite.
 
 Usage
 -----
     python -m tools.graftlint chunkflow_tpu/            # human output
-    python -m tools.graftlint --json chunkflow_tpu/     # machine output
+    python -m tools.graftlint --output json             # machine output
+    python -m tools.graftlint --output sarif            # SARIF 2.1.0
     python -m tools.graftlint --write-baseline          # grandfather all
-    python -m tools.graftlint --explain GL003           # rule docs
+    python -m tools.graftlint --explain GL011           # rule docs
+    python -m tools.graftlint --stats                   # per-family counts
 
 Suppress a single line with ``# graftlint: disable=GL001`` (comma-separate
 several codes; bare ``disable`` silences every rule on that line) or a

@@ -1,0 +1,115 @@
+"""Per-file graftlint result cache keyed by content hash.
+
+The run_tests.sh gate and the pre-commit hook re-lint the whole tree on
+every invocation; the AST analysis is pure per (path, source, config,
+linter version), so results are memoized under ``.graftlint_cache/``.
+A cache entry's key folds in:
+
+- the file's repo-relative path (GL004/GL010 scope by path, and the
+  path is part of every Finding),
+- the file's content (sha256),
+- the effective config (select, float64_paths — anything that changes
+  rule behavior),
+- the linter's own source (sha256 over ``tools/graftlint/*.py``), so
+  editing a rule invalidates every entry at once.
+
+Entries are one small JSON file each, written atomically; a torn or
+unreadable entry is treated as a miss, never an error — the cache must
+never be the thing that breaks CI. ``--no-cache`` (or
+``Config(cache_dir=None)``) bypasses it entirely.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import List, Optional, Tuple
+
+from tools.graftlint.model import Finding
+
+#: bumped when the entry layout itself changes
+_SCHEMA = 1
+
+_TOOL_HASH: Optional[str] = None
+
+
+def tool_hash() -> str:
+    """sha256 over the linter's own sources: any rule/engine edit
+    invalidates the whole cache."""
+    global _TOOL_HASH
+    if _TOOL_HASH is None:
+        h = hashlib.sha256()
+        pkg = Path(__file__).resolve().parent
+        for src in sorted(pkg.glob("*.py")):
+            h.update(src.name.encode())
+            h.update(src.read_bytes())
+        _TOOL_HASH = h.hexdigest()
+    return _TOOL_HASH
+
+
+def config_fingerprint(config) -> str:
+    payload = {
+        "select": sorted(config.select) if config.select else None,
+        "float64_paths": sorted(config.float64_paths),
+        "schema": _SCHEMA,
+    }
+    return hashlib.sha256(
+        json.dumps(payload, sort_keys=True).encode()
+    ).hexdigest()
+
+
+def entry_key(path: str, source: str, config) -> str:
+    h = hashlib.sha256()
+    h.update(path.encode())
+    h.update(b"\x00")
+    h.update(source.encode())
+    h.update(b"\x00")
+    h.update(config_fingerprint(config).encode())
+    h.update(b"\x00")
+    h.update(tool_hash().encode())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """Content-addressed (findings, suppressed) store for one run."""
+
+    def __init__(self, cache_dir: str, repo_root: Optional[Path] = None):
+        root = Path(cache_dir)
+        if not root.is_absolute() and repo_root is not None:
+            root = repo_root / root
+        self.dir = root
+        self.hits = 0
+        self.misses = 0
+
+    def _entry_path(self, key: str) -> Path:
+        return self.dir / key[:2] / f"{key}.json"
+
+    def get(
+        self, path: str, source: str, config
+    ) -> Optional[Tuple[List[Finding], int]]:
+        entry = self._entry_path(entry_key(path, source, config))
+        try:
+            data = json.loads(entry.read_text())
+            findings = [Finding(**f) for f in data["findings"]]
+            suppressed = int(data["suppressed"])
+        except (OSError, ValueError, TypeError, KeyError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return findings, suppressed
+
+    def put(self, path: str, source: str, config,
+            findings: List[Finding], suppressed: int) -> None:
+        entry = self._entry_path(entry_key(path, source, config))
+        payload = json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": suppressed,
+        })
+        try:
+            entry.parent.mkdir(parents=True, exist_ok=True)
+            tmp = entry.with_suffix(f".tmp-{os.getpid()}")
+            tmp.write_text(payload)
+            os.replace(tmp, entry)
+        except OSError:
+            pass  # a read-only checkout just runs uncached

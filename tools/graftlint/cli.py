@@ -1,4 +1,5 @@
-"""graftlint command line: human/JSON output, baseline gate, --explain.
+"""graftlint command line: human/JSON/SARIF output, baseline gate,
+result cache, --explain, --stats.
 
 Exit codes: 0 clean (all findings grandfathered), 1 new findings (or a
 parse failure), 2 usage/config error.
@@ -9,6 +10,7 @@ import argparse
 import inspect
 import json
 import sys
+from collections import Counter
 from pathlib import Path
 from typing import List
 
@@ -43,16 +45,53 @@ def _print_human(new: List[Finding], grandfathered: int, stale: int,
     print("graftlint: " + ", ".join(bits))
 
 
+#: rule code -> family label for --stats (GL001-GL007 are the jit/tracer
+#: correctness rules, GL010+ the concurrency soundness plane)
+def rule_family(code: str) -> str:
+    try:
+        number = int(code[2:])
+    except ValueError:
+        return "other"
+    if number == 0:
+        return "parse"
+    return "concurrency" if number >= 10 else "jit"
+
+
+def _print_stats(all_findings: List[Finding], new: List[Finding],
+                 suppressed: int) -> None:
+    """Per-rule and per-family hit counts (run_tests.sh prints this so
+    the CI log shows which rule families carry weight)."""
+    per_rule = Counter(f.code for f in all_findings)
+    families = Counter(rule_family(f.code) for f in all_findings)
+    print("graftlint stats:")
+    for family in ("parse", "jit", "concurrency", "other"):
+        if family not in families and family != "concurrency" \
+                and family != "jit":
+            continue
+        rules = ", ".join(
+            f"{code}={per_rule[code]}"
+            for code in sorted(per_rule)
+            if rule_family(code) == family
+        ) or "clean"
+        print(f"  {family:<12} {families.get(family, 0):>3} "
+              f"finding(s)  [{rules}]")
+    print(f"  new={len(new)} suppressed_inline={suppressed}")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX/TPU correctness linter for chunkflow-tpu "
-                    "(rules GL001..GL006; see docs/linting.md)",
+        description="JAX/TPU correctness + concurrency linter for "
+                    "chunkflow-tpu (rules GL001..GL014; see "
+                    "docs/linting.md)",
     )
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: config include)")
+    parser.add_argument("--output", choices=("human", "json", "sarif"),
+                        default=None,
+                        help="output format (default: human)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable output")
+                        help="alias for --output json")
     parser.add_argument("--select", metavar="GL001,GL002",
                         help="comma-separated rule codes to run")
     parser.add_argument("--baseline", metavar="FILE",
@@ -61,6 +100,11 @@ def main(argv=None) -> int:
                         help="ignore the baseline: report every finding")
     parser.add_argument("--write-baseline", action="store_true",
                         help="grandfather all current findings and exit 0")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass the per-file result cache "
+                             "(.graftlint_cache/)")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-rule-family hit counts")
     parser.add_argument("--config", metavar="PYPROJECT",
                         help="pyproject.toml to read [tool.graftlint] from")
     parser.add_argument("--explain", metavar="GLXXX",
@@ -77,6 +121,7 @@ def main(argv=None) -> int:
         print(inspect.cleandoc(rule.__doc__ or "(no documentation)"))
         return 0
 
+    output = args.output or ("json" if args.as_json else "human")
     try:
         config = load_config(Path(args.config) if args.config else None)
         if args.select:
@@ -85,7 +130,9 @@ def main(argv=None) -> int:
         if args.baseline:
             config.baseline = args.baseline
         roots = args.paths or config.include
-        findings, suppressed = lint_paths(roots, config)
+        findings, suppressed = lint_paths(
+            roots, config, use_cache=not args.no_cache
+        )
     except (ValueError, OSError) as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
@@ -108,13 +155,20 @@ def main(argv=None) -> int:
     else:
         new, grandfathered, stale = findings, 0, 0
 
-    if args.as_json:
+    if output == "json":
         print(json.dumps({
             "new": [f.as_dict() for f in new],
             "grandfathered": grandfathered,
             "stale_baseline_entries": stale,
             "suppressed": suppressed,
         }, indent=2))
+    elif output == "sarif":
+        from tools.graftlint import __version__
+        from tools.graftlint.sarif import render_sarif
+
+        print(json.dumps(render_sarif(new, __version__), indent=2))
     else:
         _print_human(new, grandfathered, stale, suppressed, gate)
+    if args.stats:
+        _print_stats(findings, new, suppressed)
     return 1 if new else 0

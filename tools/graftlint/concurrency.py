@@ -1,0 +1,406 @@
+"""The GL010-series: thread-aware concurrency rules.
+
+PRs 4-9 put ``threading.Thread``/``Lock``/``Condition`` into a dozen
+modules (scheduler pumps, lifecycle heartbeats, the fleet supervisor,
+the serving front-end, the cross-task packer); these rules catch the
+bug shapes that repeatedly slipped past review there — unlocked shared
+writes, lock-order inversions, blocking calls under a lock, leaked
+threads, and non-looped condition waits. The runtime half of the same
+plane is the locksmith sanitizer (chunkflow_tpu/testing/locksmith.py),
+which cross-checks lock ordering dynamically over the whole tier-1
+suite.
+
+All analysis is module-local and name-based (tools/graftlint/
+threads.py); inline ``# graftlint: disable=GL01x`` comments absorb the
+deliberate exceptions, each with a justification.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from tools.graftlint.context import FileContext, func_name
+from tools.graftlint.model import Finding, make_finding
+from tools.graftlint.rules import Rule
+from tools.graftlint.threads import (
+    LockToken,
+    ThreadModel,
+    enclosing_class,
+    get_model,
+    token_display,
+)
+
+
+class SharedWriteWithoutLock(Rule):
+    """Shared mutable attribute written from a thread without a lock.
+
+    A ``self.X`` attribute that is written inside a function running on
+    a spawned thread (``threading.Thread(target=...)``, ``executor.
+    submit``, timers) and is also accessed from other methods of the
+    class is shared mutable state: unless the write sits inside a
+    ``with <lock>:`` block (any lock of the class or module), two
+    threads can interleave on it — torn read-modify-writes, lost
+    updates, stale reads. Either guard the write with the class's lock
+    or, when the access pattern is provably safe (single writer +
+    GIL-atomic read, an ``Event`` doing the signaling), suppress with a
+    comment saying why.
+    """
+
+    code = "GL010"
+    name = "shared-write-without-lock"
+
+    #: attribute writes in these methods precede any thread spawn on the
+    #: same object, so they cannot race with it
+    SETUP_METHODS = {"__init__", "__new__", "__post_init__"}
+
+    def _global_writes(self, ctx, model) -> Iterator[Finding]:
+        """Module-global writes (``global X`` declared) from a
+        thread-context function without a lock held — the module-level
+        twin of the unguarded ``self.X`` write."""
+        for fn in ctx.functions:
+            if fn not in model.thread_entries or isinstance(fn, ast.Lambda):
+                continue
+            declared: Set[str] = set()
+            for node, _held in model.iter_held(fn):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            for node, held in model.iter_held(fn):
+                if held or not isinstance(node, ast.Name) or \
+                        not isinstance(node.ctx, (ast.Store, ast.Del)) or \
+                        node.id not in declared:
+                    continue
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"module global `{node.id}` is written in "
+                    f"thread-context `{func_name(fn)}` without holding "
+                    f"a lock — guard the write or suppress with a "
+                    f"justification",
+                )
+
+    def _attr_accesses(
+        self, model: ThreadModel, cls_name: str
+    ) -> Dict[str, List[Tuple[ast.AST, str, bool, tuple]]]:
+        """attr -> [(node, method name, is_write, held)] over every
+        ``self.X`` use in the class's direct methods."""
+        out: Dict[str, List[Tuple[ast.AST, str, bool, tuple]]] = {}
+        for (cname, mname), fn in model.methods.items():
+            if cname != cls_name:
+                continue
+            for node, held in model.iter_held(fn):
+                if not (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    continue
+                is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+                # augmented writes (self.n += 1) parse as Store too
+                out.setdefault(node.attr, []).append(
+                    (node, mname, is_write, held)
+                )
+        return out
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        model = get_model(ctx)
+        if not model.thread_entries:
+            return
+        yield from self._global_writes(ctx, model)
+        classes = {cname for cname, _m in model.methods}
+        for cls_name in sorted(classes):
+            thread_methods = {
+                mname for (cname, mname), fn in model.methods.items()
+                if cname == cls_name and fn in model.thread_entries
+            }
+            if not thread_methods:
+                continue
+            locks = model.class_locks.get(cls_name, {})
+            accesses = self._attr_accesses(model, cls_name)
+            for attr, uses in sorted(accesses.items()):
+                if attr in locks or attr.startswith("__"):
+                    continue
+                outside = [u for u in uses if u[1] not in thread_methods]
+                if not outside:
+                    continue  # thread-private state: no sharing
+                for node, mname, is_write, held in uses:
+                    if not is_write or mname not in thread_methods \
+                            or mname in self.SETUP_METHODS:
+                        continue
+                    if held:
+                        continue  # guarded by some lock
+                    yield make_finding(
+                        ctx, node, self.code,
+                        f"`self.{attr}` is written in thread-context "
+                        f"`{mname}` without holding a lock, but is also "
+                        f"accessed from "
+                        f"`{sorted({u[1] for u in outside})[0]}` — "
+                        f"guard the write or suppress with a "
+                        f"justification (single-writer, GIL-atomic)",
+                    )
+
+
+class LockOrderInversion(Rule):
+    """Lock-acquisition-order inversion across one class/module.
+
+    If one code path acquires lock A then (still holding A) lock B,
+    while another path acquires B then A — directly or through a
+    module-local call made under the lock — two threads can each take
+    their first lock and deadlock waiting for the other. The static
+    graph covers the locks visible in one file (``self.X`` attributes,
+    module globals, locals); the locksmith runtime sanitizer covers the
+    cross-module rest. Fix by picking one global order (document it
+    where the locks are created); conditions built over an existing
+    lock count as that lock.
+    """
+
+    code = "GL011"
+    name = "lock-order-inversion"
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        model = get_model(ctx)
+        edges = model.order_edges()
+        if not edges:
+            return
+        reported: Set[frozenset] = set()
+        adjacency: Dict[LockToken, Set[LockToken]] = {}
+        for (a, b) in edges:
+            adjacency.setdefault(a, set()).add(b)
+
+        def reaches(start: LockToken, goal: LockToken) -> bool:
+            seen, stack = set(), [start]
+            while stack:
+                cur = stack.pop()
+                if cur == goal:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(adjacency.get(cur, ()))
+            return False
+
+        ordered = sorted(
+            edges.items(),
+            key=lambda kv: (kv[1].lineno, kv[1].col_offset),
+        )
+        for (a, b), site in ordered:
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            if reaches(b, a):
+                reported.add(pair)
+                other = edges.get((b, a))
+                where = (f" (reverse order at line {other.lineno})"
+                         if other is not None else
+                         " (reverse order via an intermediate lock)")
+                yield make_finding(
+                    ctx, site, self.code,
+                    f"lock-order inversion: `{token_display(b)}` is "
+                    f"acquired while holding `{token_display(a)}` here, "
+                    f"but the opposite order also exists{where} — "
+                    f"two threads taking their first lock each will "
+                    f"deadlock; pick one order",
+                )
+
+
+class BlockingCallUnderLock(Rule):
+    """Blocking call while holding a lock.
+
+    A ``queue.get()``/``.put()`` without timeout, an unbounded
+    ``thread.join()``/``future.result()``, ``block_until_ready`` (a
+    device sync can take a full chunk's compute time), a socket/HTTP
+    round trip, or a ``time.sleep`` executed inside a ``with <lock>:``
+    block stalls every other thread that needs the lock for the whole
+    wait — and if the thing being waited on itself needs the lock, the
+    program deadlocks. Move the wait outside the critical section, or
+    bound it with a timeout. ``Condition.wait`` on a held condition is
+    exempt (it releases the lock while waiting — that is the point).
+    """
+
+    code = "GL012"
+    name = "blocking-call-under-lock"
+
+    BLOCKING_FUNCS = {
+        "time.sleep",
+        "urllib.request.urlopen",
+        "socket.create_connection",
+        "requests.get", "requests.post", "requests.put",
+        "requests.request",
+        "subprocess.run", "subprocess.check_output",
+        "subprocess.check_call", "subprocess.call",
+        "jax.block_until_ready",
+    }
+
+    @staticmethod
+    def _has_kwarg(call: ast.Call, *names: str) -> bool:
+        return any(kw.arg in names for kw in call.keywords)
+
+    def _blocking_reason(self, ctx, model, call: ast.Call, fn,
+                         held) -> str:
+        resolved = ctx.imports.resolve(call.func)
+        if resolved in self.BLOCKING_FUNCS:
+            return f"`{resolved}`"
+        if not isinstance(call.func, ast.Attribute) or resolved is not None:
+            return ""
+        attr = call.func.attr
+        if attr == "block_until_ready":
+            return "`.block_until_ready()` (device sync)"
+        receiver = model.lock_token(call.func.value, fn)
+        if attr == "wait":
+            if receiver is not None and receiver[1] == "condition":
+                return ""  # releases the lock while waiting (GL014's job)
+            if receiver is not None and receiver[1] == "event" and \
+                    not call.args and not self._has_kwarg(call, "timeout"):
+                return "`.wait()` on an Event without timeout"
+            return ""
+        if attr == "join" and not call.args and not call.keywords:
+            return "unbounded `.join()`"
+        if attr in ("get", "result") and not call.args and \
+                not self._has_kwarg(call, "timeout", "block"):
+            return f"blocking `.{attr}()` without timeout"
+        if attr == "put" and not self._has_kwarg(call, "timeout", "block"):
+            root = call.func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            name = root.id if isinstance(root, ast.Name) else ""
+            if "queue" in name.lower() or name == "q":
+                return "blocking `.put()` without timeout"
+        return ""
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        model = get_model(ctx)
+        for fn in ctx.functions:
+            for node, held in model.iter_held(fn):
+                if not held or not isinstance(node, ast.Call):
+                    continue
+                reason = self._blocking_reason(ctx, model, node, fn, held)
+                if not reason:
+                    continue
+                lock = token_display(held[-1][0])
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"{reason} while holding `{lock}` in "
+                    f"`{func_name(fn)}` — every thread needing the lock "
+                    f"stalls for the whole wait; move the wait outside "
+                    f"the critical section or bound it with a timeout",
+                )
+
+
+class LeakedThread(Rule):
+    """``threading.Thread`` that is neither daemonized nor joined.
+
+    A non-daemon thread whose handle is dropped (or never ``join``ed)
+    keeps the process alive after main exits and leaks under repeated
+    construction; at interpreter shutdown it can race module teardown.
+    Every spawned thread needs an owner: pass ``daemon=True`` for
+    fire-and-forget helpers, or keep the handle and ``join`` it on the
+    shutdown path (the repo's pump/heartbeat/dispatcher threads all do
+    one or the other). The check is module-wide: a handle stored on
+    ``self`` and joined from another method counts.
+    """
+
+    code = "GL013"
+    name = "leaked-thread"
+
+    @staticmethod
+    def _root_matches(node: ast.AST, key: Tuple[str, str]) -> bool:
+        kind, name = key
+        if kind == "name":
+            return isinstance(node, ast.Name) and node.id == name
+        return (isinstance(node, ast.Attribute) and node.attr == name
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self")
+
+    def _handled(self, ctx: FileContext, spawn) -> bool:
+        key = spawn.target_key
+        if key is None:
+            return False
+        loop_vars: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.comprehension)) and \
+                    self._root_matches(node.iter, key) and \
+                    isinstance(node.target, ast.Name):
+                loop_vars.add(node.target.id)
+        for node in ast.walk(ctx.tree):
+            # X.daemon = True  /  X.setDaemon(True)
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            target.attr == "daemon" and \
+                            self._root_matches(target.value, key):
+                        return True
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            if node.func.attr not in ("join", "setDaemon"):
+                continue
+            value = node.func.value
+            if self._root_matches(value, key):
+                return True
+            if isinstance(value, ast.Name) and value.id in loop_vars:
+                return True  # for t in self._threads: t.join(...)
+        return False
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        model = get_model(ctx)
+        for spawn in model.spawns:
+            if spawn.daemon or self._handled(ctx, spawn):
+                continue
+            yield make_finding(
+                ctx, spawn.call, self.code,
+                "thread is neither daemonized nor joined anywhere in "
+                "this module — pass daemon=True for a fire-and-forget "
+                "helper, or keep the handle and join it on the "
+                "shutdown path",
+            )
+
+
+class ConditionWaitOutsideLoop(Rule):
+    """``Condition.wait`` not inside a loop re-checking its predicate.
+
+    ``wait()`` can return spuriously, and between the notify and the
+    wake another thread may have consumed the state change — so the
+    predicate must be RE-CHECKED after every wake. A wait that is not
+    enclosed in a ``while``/``for`` loop acts on the first wake no
+    matter what is actually true, which is a latent lost-wakeup /
+    spurious-wakeup bug. Use ``while not pred: cv.wait()`` or
+    ``cv.wait_for(pred)`` (which loops internally).
+    """
+
+    code = "GL014"
+    name = "condition-wait-outside-loop"
+
+    def run(self, ctx: FileContext, config) -> Iterator[Finding]:
+        model = get_model(ctx)
+        for fn in ctx.functions:
+            for node, _held in model.iter_held(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "wait"):
+                    continue
+                receiver = model.lock_token(node.func.value, fn)
+                if receiver is None or receiver[1] != "condition":
+                    continue
+                cur = getattr(node, "parent", None)
+                in_loop = False
+                while cur is not None and cur is not fn:
+                    if isinstance(cur, (ast.While, ast.For)):
+                        in_loop = True
+                        break
+                    cur = getattr(cur, "parent", None)
+                if in_loop:
+                    continue
+                yield make_finding(
+                    ctx, node, self.code,
+                    f"`{token_display(receiver[0])}.wait()` outside a "
+                    f"predicate loop in `{func_name(fn)}` — spurious "
+                    f"wakeups and notify races act on the first wake; "
+                    f"use `while not pred: wait()` or `wait_for(pred)`",
+                )
+
+
+CONCURRENCY_RULES: List[Rule] = [
+    SharedWriteWithoutLock(),
+    LockOrderInversion(),
+    BlockingCallUnderLock(),
+    LeakedThread(),
+    ConditionWaitOutsideLoop(),
+]

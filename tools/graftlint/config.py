@@ -8,6 +8,7 @@ Recognized keys (all optional)::
     select = ["GL001", "GL002", ...]       # enabled rules (default: all)
     baseline = "tools/graftlint/baseline.json"
     float64_paths = ["chunkflow_tpu/ops", "chunkflow_tpu/inference"]
+    cache_dir = ".graftlint_cache"         # per-file result cache
 
 CLI flags override file config. Python 3.10 has no tomllib, so parsing
 uses the already-vendored ``tomli`` when present and degrades to defaults
@@ -34,6 +35,9 @@ class Config:
             "chunkflow_tpu/ops", "chunkflow_tpu/inference",
         ]
     )
+    #: per-file result cache directory (tools/graftlint/cache.py);
+    #: None disables caching entirely (the --no-cache escape hatch)
+    cache_dir: Optional[str] = ".graftlint_cache"
 
     def is_excluded(self, relpath: str) -> bool:
         return any(fnmatch(relpath, pat) for pat in self.exclude)
@@ -67,4 +71,7 @@ def load_config(pyproject: Optional[Path] = None) -> Config:
             setattr(cfg, key, list(section[key]))
     if "baseline" in section:
         cfg.baseline = str(section["baseline"])
+    if "cache_dir" in section:
+        raw = section["cache_dir"]
+        cfg.cache_dir = str(raw) if raw else None
     return cfg

@@ -70,15 +70,33 @@ def lint_paths(
     roots: Iterable[str],
     config: Optional[Config] = None,
     repo_root: Optional[Path] = None,
+    use_cache: bool = True,
 ) -> Tuple[List[Finding], int]:
-    """Lint every .py under the given roots; (findings, suppressed)."""
+    """Lint every .py under the given roots; (findings, suppressed).
+
+    With ``use_cache`` (and ``config.cache_dir`` set), per-file results
+    are memoized by content hash under the cache dir, so reruns only
+    re-analyze changed files (tools/graftlint/cache.py).
+    """
     config = config or Config()
     repo_root = (repo_root or Path.cwd()).resolve()
+    cache = None
+    if use_cache and config.cache_dir:
+        from tools.graftlint.cache import ResultCache
+
+        cache = ResultCache(config.cache_dir, repo_root)
     all_findings: List[Finding] = []
     suppressed = 0
     for f in iter_python_files(roots, config, repo_root):
         rel = f.relative_to(repo_root).as_posix()
-        found, sup = lint_file(rel, f.read_text(), config)
+        source = f.read_text()
+        cached = cache.get(rel, source, config) if cache else None
+        if cached is not None:
+            found, sup = cached
+        else:
+            found, sup = lint_file(rel, source, config)
+            if cache is not None:
+                cache.put(rel, source, config, found, sup)
         all_findings.extend(found)
         suppressed += sup
     all_findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))

@@ -1,4 +1,5 @@
-"""The graftlint rule registry: GL001..GL007.
+"""The graftlint rule registry: GL001..GL007 (jit/tracer correctness)
+plus the GL010-series concurrency rules (tools/graftlint/concurrency.py).
 
 Each rule is a class with ``code``, ``name`` and ``run(ctx, config)``
 yielding Findings. Register new rules by appending to ``RULES`` (see
@@ -431,5 +432,12 @@ RULES: List[Rule] = [
     AxisOrderHazard(),
     TelemetryInJit(),
 ]
+
+# The GL010-series concurrency rules live in their own module (they rest
+# on the thread/lock model, not the jit-trace analysis); the import is
+# deferred to the bottom because concurrency.py subclasses Rule.
+from tools.graftlint.concurrency import CONCURRENCY_RULES  # noqa: E402
+
+RULES.extend(CONCURRENCY_RULES)
 
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,81 @@
+"""SARIF 2.1.0 rendering for graftlint findings.
+
+SARIF (Static Analysis Results Interchange Format) is what code-review
+UIs (GitHub code scanning, VS Code SARIF viewer) ingest; ``--output
+sarif`` makes the gate's findings reviewable inline instead of as CI
+log text. One run, one tool (``graftlint``), rule metadata from the
+registry docstrings, one result per NEW finding (grandfathered and
+suppressed findings are by definition not actionable and are omitted,
+matching the human/JSON outputs' exit semantics).
+"""
+from __future__ import annotations
+
+import inspect
+from typing import List
+
+from tools.graftlint.model import Finding
+from tools.graftlint.rules import RULES
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _rule_descriptor(rule) -> dict:
+    doc = inspect.cleandoc(rule.__doc__ or "")
+    short = doc.splitlines()[0] if doc else rule.name
+    return {
+        "id": rule.code,
+        "name": rule.name,
+        "shortDescription": {"text": short},
+        "fullDescription": {"text": doc},
+        "defaultConfiguration": {"level": "error"},
+    }
+
+
+def render_sarif(findings: List[Finding], version: str) -> dict:
+    """The findings as a SARIF 2.1.0 log (a plain dict, ready for
+    ``json.dumps``)."""
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.code,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": f.col + 1,  # SARIF is 1-based
+                        "snippet": {"text": f.text},
+                    },
+                },
+                "logicalLocations": [{
+                    "fullyQualifiedName": f.context,
+                    "kind": "function",
+                }],
+            }],
+        })
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graftlint",
+                    "informationUri":
+                        "https://github.com/seung-lab/chunkflow",
+                    "version": version,
+                    "rules": [_rule_descriptor(r) for r in RULES],
+                }
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }

@@ -1,0 +1,385 @@
+"""Thread/lock model backing the GL010-series concurrency rules.
+
+The concurrency half of graftlint needs to know three things about a
+file that the jit-trace analysis (context.py) does not track:
+
+1. **Which functions run on spawned threads** — the *thread context*.
+   Seeds: ``threading.Thread(target=...)`` / ``threading.Timer``
+   callbacks, ``executor.submit(fn, ...)``. Propagated to a fixpoint
+   over the module-local call graph (``self.method()`` calls resolve
+   within the enclosing class, bare names lexically), mirroring how
+   traced-function membership propagates.
+2. **Which objects are locks** — ``threading.Lock/RLock/Condition/
+   Semaphore/Event`` constructions bound to module globals, ``self.X``
+   attributes, or function locals. A ``Condition(existing_lock)`` is
+   aliased to its underlying lock for ordering purposes (two conditions
+   over one lock are ONE mutex).
+3. **What is held where** — for every AST node, the stack of lock
+   guards whose ``with`` block encloses it (:meth:`ThreadModel.
+   iter_held`), plus lock-acquisition order edges across the functions
+   of one class/module (:meth:`ThreadModel.order_edges`).
+
+Like the traced analysis this is module-local and name-based on
+purpose: cross-module lock graphs are the runtime sanitizer's job
+(chunkflow_tpu/testing/locksmith.py), and inline suppressions absorb
+the residual blind spots.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.graftlint.context import (
+    FUNC_TYPES,
+    FileContext,
+    FuncNode,
+    enclosing_function,
+)
+
+#: constructor -> synchronization-object kind
+LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Event": "event",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "rlock",
+    "multiprocessing.Condition": "condition",
+    "multiprocessing.Event": "event",
+}
+
+#: kinds whose ``with X:`` block is a critical section (an Event is a
+#: flag, not a guard; a Barrier cannot be held)
+GUARD_KINDS = ("lock", "rlock", "condition", "semaphore")
+
+#: a lock's identity within one file: ("mod", name) for module globals,
+#: ("cls", ClassName, attr) for self attributes, ("loc", func_id, name)
+#: for function locals
+LockToken = Tuple[str, ...]
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = getattr(cur, "parent", None)
+    return None
+
+
+def token_display(token: LockToken) -> str:
+    """Human-readable lock name for findings: ``self._lock`` /
+    ``_STATE_LOCK``."""
+    if token[0] == "cls":
+        return f"self.{token[2]}"
+    return str(token[-1])
+
+
+def get_model(ctx: FileContext) -> "ThreadModel":
+    """The (cached) thread/lock model for one file context."""
+    model = getattr(ctx, "_thread_model", None)
+    if model is None:
+        model = ThreadModel(ctx)
+        ctx._thread_model = model  # type: ignore[attr-defined]
+    return model
+
+
+class ThreadSpawn:
+    """One ``threading.Thread(...)`` / ``Timer(...)`` construction site
+    (GL013's unit of analysis)."""
+
+    __slots__ = ("call", "daemon", "target_key", "in_collection")
+
+    def __init__(self, call: ast.Call):
+        self.call = call
+        self.daemon = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in call.keywords
+        )
+        #: ("name", n) / ("attr", a) — where the handle lands, if bound
+        self.target_key: Optional[Tuple[str, str]] = None
+        #: handle stored inside a list/dict/comprehension (joined via a
+        #: loop over the container, not directly)
+        self.in_collection = False
+
+
+class ThreadModel:
+    """Everything the GL01x rules need to know about one file."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.module_locks: Dict[str, str] = {}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.local_locks: Dict[Tuple[int, str], str] = {}
+        #: condition token -> the lock token it wraps (Condition(lock))
+        self.cond_alias: Dict[LockToken, LockToken] = {}
+        self.thread_entries: Set[FuncNode] = set()
+        self.spawns: List[ThreadSpawn] = []
+        #: (class name, method name) -> def node (direct class body only)
+        self.methods: Dict[Tuple[str, str], FuncNode] = {}
+        self._acquires_closure: Dict[int, Set[LockToken]] = {}
+        self._collect_methods()
+        self._collect_locks()
+        self._collect_entries()
+
+    # -- structure ----------------------------------------------------
+    def _collect_methods(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.methods[(node.name, item.name)] = item
+
+    def _lock_ctor_kind(self, value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        return LOCK_CTORS.get(self.ctx.imports.resolve(value.func))
+
+    def _collect_locks(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            kind = self._lock_ctor_kind(value)
+            if kind is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            fn = enclosing_function(node)
+            for target in targets:
+                token = self._bind_target(target, fn, kind)
+                if token is None or kind != "condition":
+                    continue
+                # Condition(existing_lock): same mutex for ordering
+                if isinstance(value, ast.Call) and value.args:
+                    wrapped = self.lock_token(value.args[0], fn)
+                    if wrapped is not None:
+                        self.cond_alias[token] = wrapped[0]
+
+    def _bind_target(self, target: ast.AST, fn: Optional[FuncNode],
+                     kind: str) -> Optional[LockToken]:
+        if isinstance(target, ast.Name):
+            if fn is None:
+                self.module_locks[target.id] = kind
+                return ("mod", target.id)
+            self.local_locks[(id(fn), target.id)] = kind
+            return ("loc", str(id(fn)), target.id)
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self" and fn is not None:
+            cls = enclosing_class(fn)
+            if cls is not None:
+                self.class_locks.setdefault(cls.name, {})[target.attr] = kind
+                return ("cls", cls.name, target.attr)
+        return None
+
+    # -- lock tokens ---------------------------------------------------
+    def lock_token(
+        self, expr: ast.AST, fn: Optional[FuncNode]
+    ) -> Optional[Tuple[LockToken, str]]:
+        """(token, kind) when ``expr`` names a known synchronization
+        object from ``fn``'s point of view; None otherwise."""
+        if isinstance(expr, ast.Name):
+            scope = fn
+            while scope is not None:
+                kind = self.local_locks.get((id(scope), expr.id))
+                if kind is not None:
+                    return ("loc", str(id(scope)), expr.id), kind
+                scope = enclosing_function(scope)
+            kind = self.module_locks.get(expr.id)
+            if kind is not None:
+                return ("mod", expr.id), kind
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and fn is not None:
+            cls = enclosing_class(fn)
+            if cls is not None:
+                kind = self.class_locks.get(cls.name, {}).get(expr.attr)
+                if kind is not None:
+                    return ("cls", cls.name, expr.attr), kind
+        return None
+
+    def order_token(self, token: LockToken) -> LockToken:
+        """The token used for lock-ORDER identity: a condition built
+        over an existing lock is that lock."""
+        return self.cond_alias.get(token, token)
+
+    # -- thread-context analysis ---------------------------------------
+    def _callee(self, expr: ast.AST, site: ast.AST) -> Optional[FuncNode]:
+        """Resolve a callable reference: a lambda, a lexically visible
+        function name, or a ``self.method`` of the enclosing class."""
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return self.ctx.resolve_local(expr.id, site)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = enclosing_class(site)
+            if cls is not None:
+                return self.methods.get((cls.name, expr.attr))
+        return None
+
+    def _collect_entries(self) -> None:
+        seeds: Set[FuncNode] = set()
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self.ctx.imports.resolve(node.func)
+            if resolved in ("threading.Thread", "threading.Timer"):
+                spawn = ThreadSpawn(node)
+                self._bind_spawn(spawn)
+                self.spawns.append(spawn)
+                target = None
+                if resolved == "threading.Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            target = kw.value
+                elif len(node.args) >= 2:  # Timer(interval, function)
+                    target = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        target = kw.value
+                if target is not None:
+                    callee = self._callee(target, node)
+                    if callee is not None:
+                        seeds.add(callee)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("submit", "call_soon_threadsafe") \
+                    and node.args:
+                callee = self._callee(node.args[0], node)
+                if callee is not None:
+                    seeds.add(callee)
+        # fixpoint over the module-local call graph: a function called
+        # from a thread entry runs on that thread too
+        worklist = list(seeds)
+        entries = set(seeds)
+        while worklist:
+            fn = worklist.pop()
+            for node, _held in self.iter_held(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self._callee(node.func, node)
+                if callee is not None and callee not in entries:
+                    entries.add(callee)
+                    worklist.append(callee)
+        self.thread_entries = entries
+
+    def _bind_spawn(self, spawn: ThreadSpawn) -> None:
+        """Find where a Thread construction's handle is stored (walking
+        out through list/dict/comprehension wrappers)."""
+        node: ast.AST = spawn.call
+        parent = getattr(node, "parent", None)
+        while isinstance(parent, (ast.List, ast.Tuple, ast.Dict,
+                                  ast.ListComp, ast.comprehension,
+                                  ast.IfExp)):
+            spawn.in_collection = True
+            node = parent
+            parent = getattr(parent, "parent", None)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+            target = parent.targets[0]
+        elif isinstance(parent, (ast.AnnAssign, ast.AugAssign)):
+            target = parent.target
+        else:
+            return
+        if isinstance(target, ast.Name):
+            spawn.target_key = ("name", target.id)
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            spawn.target_key = ("attr", target.attr)
+
+    # -- held-lock traversal -------------------------------------------
+    def iter_held(
+        self, fn: FuncNode
+    ) -> Iterator[Tuple[ast.AST, Tuple[Tuple[LockToken, str], ...]]]:
+        """Yield every node in ``fn``'s own body (not nested functions)
+        with the tuple of (token, kind) guards held at that point —
+        guards being ``with <lock>`` blocks over known lock objects."""
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in body:
+            yield from self._iter(stmt, (), fn)
+
+    def _iter(self, node, held, fn):
+        yield node, held
+        if isinstance(node, FUNC_TYPES):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    yield sub, held
+                tok = self.lock_token(item.context_expr, fn)
+                if tok is not None and tok[1] in GUARD_KINDS:
+                    inner.append(tok)
+            for stmt in node.body:
+                yield from self._iter(stmt, tuple(inner), fn)
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from self._iter(child, held, fn)
+
+    # -- lock-order edges ----------------------------------------------
+    def _direct_acquires(self, fn: FuncNode) -> Set[LockToken]:
+        out: Set[LockToken] = set()
+        for node, _held in self.iter_held(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    tok = self.lock_token(item.context_expr, fn)
+                    if tok is not None and tok[1] in GUARD_KINDS:
+                        out.add(self.order_token(tok[0]))
+        return out
+
+    def acquires_closure(self, fn: FuncNode) -> Set[LockToken]:
+        """Every lock ``fn`` may acquire, directly or through
+        module-local callees (fixpoint, cycle-safe)."""
+        cached = self._acquires_closure.get(id(fn))
+        if cached is not None:
+            return cached
+        self._acquires_closure[id(fn)] = set()  # cycle guard
+        out = set(self._direct_acquires(fn))
+        for node, _held in self.iter_held(fn):
+            if isinstance(node, ast.Call):
+                callee = self._callee(node.func, node)
+                if callee is not None and callee is not fn:
+                    out |= self.acquires_closure(callee)
+        self._acquires_closure[id(fn)] = out
+        return out
+
+    def order_edges(
+        self,
+    ) -> Dict[Tuple[LockToken, LockToken], ast.AST]:
+        """Directed lock-order edges over the whole file:
+        ``(held, acquired) -> first AST node establishing the edge``.
+        Includes edges through one level of module-local calls (holding
+        A while calling a function whose closure acquires B)."""
+        edges: Dict[Tuple[LockToken, LockToken], ast.AST] = {}
+
+        def add(a: LockToken, b: LockToken, site: ast.AST) -> None:
+            if a != b and (a, b) not in edges:
+                edges[(a, b)] = site
+
+        for fn in self.ctx.functions:
+            for node, held in self.iter_held(fn):
+                if not held:
+                    continue
+                held_tokens = [self.order_token(t) for t, _k in held]
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        tok = self.lock_token(item.context_expr, fn)
+                        if tok is None or tok[1] not in GUARD_KINDS:
+                            continue
+                        acquired = self.order_token(tok[0])
+                        for h in held_tokens:
+                            add(h, acquired, node)
+                elif isinstance(node, ast.Call):
+                    callee = self._callee(node.func, node)
+                    if callee is None:
+                        continue
+                    for acquired in self.acquires_closure(callee):
+                        for h in held_tokens:
+                            add(h, acquired, node)
+        return edges

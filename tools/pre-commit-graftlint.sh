@@ -1,0 +1,33 @@
+#!/bin/bash
+# Local graftlint gate: run the same check CI runs (run_tests.sh stage 2)
+# before a commit ever leaves the machine. Wired either as a classic git
+# hook —
+#
+#     ln -s ../../tools/pre-commit-graftlint.sh .git/hooks/pre-commit
+#
+# — or through the pre-commit framework (.pre-commit-config.yaml ships a
+# `local` hook entry pointing here). The per-file result cache
+# (.graftlint_cache/, keyed by content hash) makes the warm path ~20x
+# faster than a cold run, so the hook costs well under 100ms when only a
+# few files changed. GRAFTLINT_PRECOMMIT_SKIP=1 bypasses (matching
+# CHUNKFLOW_SKIP_LINT for the CI stage).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ "${GRAFTLINT_PRECOMMIT_SKIP:-0}" = "1" ]; then
+    echo "graftlint pre-commit: skipped (GRAFTLINT_PRECOMMIT_SKIP=1)"
+    exit 0
+fi
+
+# Lint the full configured include set, not just the staged files: a
+# staged edit can create a NEW finding in an unstaged neighbor (the
+# thread model and traced-function analysis are module-wide), and the
+# cache makes whole-tree reruns cheap anyway.
+python -m tools.graftlint --stats
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo >&2
+    echo "graftlint pre-commit: new findings (or parse error) — fix them" >&2
+    echo "or suppress with an inline justification (docs/linting.md)." >&2
+fi
+exit $rc
