@@ -55,6 +55,18 @@ FLEET_COUNTERS = (
     "fleet/crash_backoffs",
 )
 
+#: storage-plane counters (volume/storage.py, docs/storage.md),
+#: reported as their own block: on an overlapping task grid, "how many
+#: block reads the hot cache absorbed and how many bytes actually moved"
+#: is the storage story — the same signal the fleet supervisor uses to
+#: tell cache-cold network-bound from genuinely load-bound
+STORAGE_COUNTERS = (
+    "storage/hits", "storage/misses", "storage/block_reads",
+    "storage/bytes_read", "storage/bytes_written",
+    "storage/aligned_writes", "storage/unaligned_writes",
+    "storage/evictions",
+)
+
 #: serving-plane counters (chunkflow_tpu/serve/, docs/serving.md),
 #: reported as their own block: under request traffic, "how many
 #: requests were admitted / shed / late and how full the device batches
@@ -465,6 +477,43 @@ def print_serving_block(agg: dict, indent: str = "") -> bool:
     return True
 
 
+def print_storage_block(agg: dict, indent: str = "") -> bool:
+    """The STORAGE block (docs/storage.md): block cache hit rate, bytes
+    moved, and the aligned/unaligned write split. Quiet (returns False)
+    for runs that never touched the storage plane."""
+    storage = {
+        name: agg["counters"][name]
+        for name in STORAGE_COUNTERS if agg["counters"].get(name)
+    }
+    if not storage:
+        return False
+    print(f"{indent}storage (docs/storage.md):")
+    for name in STORAGE_COUNTERS:
+        if name in storage:
+            print(f"{indent}  {name:<28} {storage[name]:>7g}")
+    hits = storage.get("storage/hits", 0)
+    misses = storage.get("storage/misses", 0)
+    parts = []
+    if hits + misses:
+        parts.append(f"block cache hit rate {hits / (hits + misses):.0%}")
+    cache_bytes = agg["gauges"].get("storage/cache_bytes")
+    if cache_bytes is not None:
+        parts.append(f"cache {cache_bytes['last'] / 2**20:.1f} MiB")
+    read_span = agg["spans"].get("storage/read")
+    write_span = agg["spans"].get("storage/write")
+    if read_span:
+        parts.append(f"read {read_span['total_s']:.3f}s")
+    if write_span:
+        parts.append(f"write {write_span['total_s']:.3f}s")
+    if parts:
+        print(f"{indent}  -> " + ", ".join(parts))
+    if hits + misses and hits / (hits + misses) < 0.25 and misses > 16:
+        print(f"{indent}  -> cache-cold: overlapping reads mostly miss "
+              f"— raise CHUNKFLOW_STORAGE_CACHE_MB or check the task "
+              f"grid ordering (docs/storage.md)")
+    return True
+
+
 def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
     """Human report over a metrics dir; returns the aggregate (None when
     the dir holds no events — e.g. the run had CHUNKFLOW_TELEMETRY=0)."""
@@ -499,6 +548,7 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 "  -> dead-lettered tasks pending triage: inspect with "
                 "`chunkflow dead-letter -q <queue>`"
             )
+    print_storage_block(agg)
     print_serving_block(agg)
     fleet = {
         name: agg["counters"][name]
@@ -613,6 +663,13 @@ def summarize_fleet(events: List[dict]) -> dict:
             "cache_hit_rate": (
                 hits / (hits + builds) if (hits + builds) else None
             ),
+            "storage_hit_rate": (
+                counters.get("storage/hits", 0)
+                / (counters.get("storage/hits", 0)
+                   + counters.get("storage/misses", 0))
+                if (counters.get("storage/hits", 0)
+                    + counters.get("storage/misses", 0)) else None
+            ),
             "device_bytes_in_use": (
                 device_mem["last"] if device_mem else None
             ),
@@ -669,6 +726,9 @@ def print_fleet_summary(metrics_dir: str,
             print(f"    -> dominant phase: {info['dominant']}")
         if info["cache_hit_rate"] is not None:
             print(f"  cache hit rate: {100 * info['cache_hit_rate']:.1f}%")
+        if info.get("storage_hit_rate") is not None:
+            print(f"  storage block cache hit rate: "
+                  f"{100 * info['storage_hit_rate']:.1f}%")
         if info.get("serving_requests"):
             from chunkflow_tpu.core import telemetry as _telemetry
 

@@ -158,16 +158,19 @@ DEFAULT_DEPTHS = {
     "inflight": 2,  # dispatched-but-undrained device outputs
     "post": 2,      # drain + host post-processing tasks in the worker pool
     "write": 2,     # tasks with storage writes still in flight
+    "storage": 8,   # concurrent block reads per cutout (volume/storage.py;
+                    # floored at the live read_concurrency() in __init__)
 }
 
 #: growth ceilings — past these, more depth is more memory for no overlap
 DEPTH_LIMITS = {
     "prefetch": 8, "ring": 4, "inflight": 8, "post": 4, "write": 8,
+    "storage": 32,
 }
 
 #: stall phase -> knobs the controller widens when that phase dominates
 PHASE_KNOBS = {
-    "scheduler/load": ("prefetch",),
+    "scheduler/load": ("prefetch", "storage"),
     "pipeline/stage": ("prefetch",),
     "pipeline/dispatch": (),  # compile time: a knob can't help (watchdog can)
     "pipeline/compute": (),   # device-bound is the design goal
@@ -204,6 +207,20 @@ class DepthController:
             k: max(v, self.depths.get(k, 0))
             for k, v in dict(DEPTH_LIMITS, **(limits or {})).items()
         }
+        # the storage knob mirrors the live per-cutout block-read
+        # parallelism (volume/storage.py): start from whatever the env
+        # knob resolved to, so the first controller raise widens it
+        # instead of clamping it back down
+        from chunkflow_tpu.volume import storage as _vol_storage
+
+        if not depths or "storage" not in depths:
+            self.depths["storage"] = max(
+                self.depths.get("storage", 1),
+                _vol_storage.read_concurrency(),
+            )
+        self.limits["storage"] = max(
+            self.limits.get("storage", 1), self.depths["storage"]
+        )
         self.initial = dict(self.depths)
         self.interval = interval if interval else _controller_interval()
         self.watermark_bytes = (
@@ -226,7 +243,12 @@ class DepthController:
         self._slot_bytes = max(self._slot_bytes, int(nbytes))
 
     def resident_slots(self) -> int:
-        return sum(self.depths.values())
+        # the storage knob is block-read parallelism, not a chunk-sized
+        # slot: blocks are orders of magnitude smaller than chunks and
+        # already bounded by the hot-block cache's own byte budget
+        return sum(
+            v for k, v in self.depths.items() if k != "storage"
+        )
 
     def _would_fit(self) -> bool:
         # 2x: each slot can pin an input and an output chunk at once;
@@ -269,6 +291,13 @@ class DepthController:
             if old >= self.limits[knob] or not self._would_fit():
                 continue  # ceiling or watermark: graceful static fallback
             self.depths[knob] = old + 1
+            if knob == "storage":
+                # push the widened block-read parallelism to the live
+                # storage plane (volume/storage.py consumes it per
+                # cutout; the next read wave picks it up)
+                from chunkflow_tpu.volume import storage as _vol_storage
+
+                _vol_storage.set_read_concurrency(old + 1)
             applied.append((knob, old, old + 1))
             self.changes.append((self._tasks, knob, old, old + 1))
             telemetry.event(

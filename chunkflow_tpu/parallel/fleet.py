@@ -169,6 +169,10 @@ class WorkerHandle:
         self.handles_truncated = False
         self.inflight_leases = 0
         self.dominant_stall: Optional[dict] = None
+        # last-scraped storage block-cache counters ({"hits", "misses"},
+        # None until the worker reports any) — lets a storage-bound
+        # hold tell cache-cold from genuinely load-bound
+        self.storage_cache: Optional[dict] = None
         self.drill = False
         self.drain_deadline: Optional[float] = None
 
@@ -233,6 +237,7 @@ class FleetSupervisor:
         mem_watermark_gb: float = 2.0,
         worker_mem_est_gb: float = 0.5,
         storage_hold_share: float = 0.5,
+        cache_warm_share: float = 0.5,
         dead_letter_surge: int = 3,
         crash_limit: int = 3,
         crash_window: float = 60.0,
@@ -271,6 +276,7 @@ class FleetSupervisor:
         self.mem_watermark_gb = float(mem_watermark_gb)
         self.worker_mem_est_gb = float(worker_mem_est_gb)
         self.storage_hold_share = float(storage_hold_share)
+        self.cache_warm_share = float(cache_warm_share)
         self.dead_letter_surge = int(dead_letter_surge)
         self.crash_limit = int(crash_limit)
         self.crash_window = float(crash_window)
@@ -414,6 +420,13 @@ class FleetSupervisor:
             worker.handles_truncated = bool(
                 health.get("inflight_handles_truncated"))
             worker.dominant_stall = sample.get("dominant_stall")
+            metrics = sample.get("metrics") or {}
+            hits = metrics.get("chunkflow_storage_hits_total")
+            misses = metrics.get("chunkflow_storage_misses_total")
+            worker.storage_cache = (
+                {"hits": float(hits or 0), "misses": float(misses or 0)}
+                if (hits is not None or misses is not None) else None
+            )
             return
         if worker.state == "starting" and \
                 now - worker.started < self.startup_grace:
@@ -568,6 +581,21 @@ class FleetSupervisor:
                 if w.active and w.dominant_stall)
         return {"phase": phase, "share": totals[phase] / n}
 
+    def _storage_hit_rate(self) -> Optional[float]:
+        """Fleet-wide storage block-cache hit rate from the last worker
+        scrapes; None when no active worker reports storage counters
+        (pre-storage-plane workers, telemetry off)."""
+        hits = misses = 0.0
+        seen = False
+        for worker in self.workers:
+            if worker.active and worker.storage_cache is not None:
+                seen = True
+                hits += worker.storage_cache.get("hits", 0.0)
+                misses += worker.storage_cache.get("misses", 0.0)
+        if not seen or hits + misses <= 0:
+            return None
+        return hits / (hits + misses)
+
     def _mem_ok(self) -> bool:
         available = self.mem_probe()
         if available is None:
@@ -636,7 +664,19 @@ class FleetSupervisor:
         dominant = self._fleet_dominant()
         if dominant and dominant["phase"] in STORAGE_BOUND_PHASES \
                 and dominant["share"] >= self.storage_hold_share:
-            self._hold(f"storage-bound:{dominant['phase']}")
+            # qualify the hold with the block-cache hit rate when the
+            # workers report one (volume/storage.py): a cold cache means
+            # the stall is transient re-fetch traffic the warming LRU
+            # will absorb; a warm cache still storage-bound means the
+            # shared store genuinely is the limit — different 3 a.m.
+            # responses (wait vs. shard the volume / add bandwidth)
+            reason = f"storage-bound:{dominant['phase']}"
+            hit_rate = self._storage_hit_rate()
+            if hit_rate is not None:
+                reason += (":cold-cache"
+                           if hit_rate < self.cache_warm_share
+                           else ":load-bound")
+            self._hold(reason)
             return
         if not self._mem_ok():
             self._hold("memory-watermark")

@@ -1,16 +1,36 @@
 """Load a chunk from an N5 dataset via tensorstore's n5 driver
-(reference plugins/load_n5.py used zarr.N5FSStore; tensorstore subsumes it)."""
+(reference plugins/load_n5.py used zarr.N5FSStore; tensorstore subsumes
+it). Rides the same storage-plane path as load_tensorstore: one cached
+dataset handle per process, block-decomposed concurrent reads, shared
+hot-block LRU (volume/storage.py, docs/storage.md)."""
 from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.volume.storage import (
+    blockwise_cutout,
+    open_backend_cached,
+    serial_cutout,
+    shared_cache,
+    storage_mode,
+)
 
 
 def execute(bbox, n5_dir: str = None, group_path: str = None,
-            voxel_size: tuple = None):
-    import tensorstore as ts
-
-    dataset = ts.open({
+            voxel_size: tuple = None, cache: int = None):
+    backend = open_backend_cached({
         "driver": "n5",
         "kvstore": {"driver": "file", "path": n5_dir},
         "path": group_path or "",
-    }).result()
-    array = dataset[bbox.slices].read().result()
-    return Chunk(array, voxel_offset=bbox.start, voxel_size=voxel_size)
+    })
+    dlo, dhi = backend.domain
+    lo = tuple(bbox.start) + dlo[3:]
+    hi = tuple(bbox.stop) + dhi[3:]
+    if storage_mode() == "serial":
+        array = serial_cutout(backend, lo, hi)
+    else:
+        array = blockwise_cutout(
+            backend, lo, hi, cache=shared_cache() if cache else None
+        )
+    return Chunk(
+        array,
+        voxel_offset=bbox.start,
+        voxel_size=voxel_size if voxel_size is not None else (1, 1, 1),
+    )

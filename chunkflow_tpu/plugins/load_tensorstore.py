@@ -1,24 +1,55 @@
 """Load a chunk from any tensorstore-supported dataset
-(reference plugins/load_tensorstore.py).
+(reference plugins/load_tensorstore.py), routed through the storage
+plane (volume/storage.py, docs/storage.md): the dataset handle is
+opened once per process, the cutout decomposes into storage-block-
+aligned concurrent reads, and with ``cache`` truthy the blocks ride the
+shared hot-chunk LRU — overlapping/halo reads of already-fetched blocks
+hit host memory instead of the driver.
 
 args example:
-    driver=zarr;kvstore=file:///tmp/store;voxel_size=(40,4,4)
+    driver=zarr;kvstore=file:///tmp/store;voxel_size=(40,4,4);cache=1
+
+``cache`` historically sized a per-open tensorstore ``cache_pool``;
+it now opts the read into the process-wide shared block LRU
+(``CHUNKFLOW_STORAGE_CACHE_MB`` governs the byte budget). The bbox
+indexes the dataset's first three dimensions, as before; extra trailing
+dimensions are read whole.
 """
 from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.volume.storage import (
+    blockwise_cutout,
+    open_backend_cached,
+    serial_cutout,
+    shared_cache,
+    storage_mode,
+)
+
+
+def parse_kvstore(kvstore):
+    """``scheme://path`` shorthand -> tensorstore kvstore spec."""
+    if isinstance(kvstore, str) and "://" in kvstore:
+        kv_driver, path = kvstore.split("://", 1)
+        kv_driver = "file" if kv_driver == "" else kv_driver
+        return {"driver": kv_driver, "path": path}
+    return kvstore
 
 
 def execute(bbox, driver: str = "zarr", kvstore: str = None,
             cache: int = None, voxel_size: tuple = None):
-    import tensorstore as ts
-
-    if isinstance(kvstore, str) and "://" in kvstore:
-        kv_driver, path = kvstore.split("://", 1)
-        kv_driver = "file" if kv_driver == "" else kv_driver
-        kvstore = {"driver": kv_driver, "path": path}
-    spec = {"driver": driver, "kvstore": kvstore}
-    if cache:
-        spec["context"] = {"cache_pool": {"total_bytes_limit": cache}}
-        spec["recheck_cached_data"] = "open"
-    dataset = ts.open(spec).result()
-    array = dataset[bbox.slices].read().result()
-    return Chunk(array, voxel_offset=bbox.start, voxel_size=voxel_size)
+    backend = open_backend_cached(
+        {"driver": driver, "kvstore": parse_kvstore(kvstore)}
+    )
+    dlo, dhi = backend.domain
+    lo = tuple(bbox.start) + dlo[3:]
+    hi = tuple(bbox.stop) + dhi[3:]
+    if storage_mode() == "serial":
+        array = serial_cutout(backend, lo, hi)
+    else:
+        array = blockwise_cutout(
+            backend, lo, hi, cache=shared_cache() if cache else None
+        )
+    return Chunk(
+        array,
+        voxel_offset=bbox.start,
+        voxel_size=voxel_size if voxel_size is not None else (1, 1, 1),
+    )

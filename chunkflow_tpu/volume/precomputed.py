@@ -12,6 +12,14 @@ one module: everything outside sees czyx Chunks.
 Storage layout note: chunks aligned to the storage block size never share a
 file, so parallel writers cannot conflict — the write-safety contract that
 replaces locking (reference docs "block ... ensures no writing conflict").
+
+All I/O rides the storage plane (volume/storage.py, docs/storage.md):
+cutouts decompose into storage-block-aligned concurrent reads served
+through the shared hot-block LRU, saves take the coalescing write path
+(aligned blocks commit as concurrent per-block futures, cache updated
+write-through; unaligned writes invalidate), and the sidecar/existence
+KV handle is opened once per volume and cached. ``CHUNKFLOW_STORAGE=
+serial`` restores the historical single-read path bit-identically.
 """
 from __future__ import annotations
 
@@ -24,6 +32,16 @@ import numpy as np
 from chunkflow_tpu.chunk.base import Chunk, LayerType, as_native_dtype
 from chunkflow_tpu.core.bbox import BoundingBox
 from chunkflow_tpu.core.cartesian import Cartesian, to_cartesian
+from chunkflow_tpu.volume.storage import (
+    KVBackend,
+    TensorStoreBackend,
+    blockwise_cutout,
+    blockwise_save,
+    open_kv,
+    serial_cutout,
+    shared_cache,
+    storage_mode,
+)
 
 _LAYER_TO_PRECOMPUTED = {
     LayerType.IMAGE: "image",
@@ -59,40 +77,36 @@ class PrecomputedVolume:
         self.path = path
         self.kvstore = _kvstore_spec(path)
         self._stores = {}
+        self._backends = {}
+        self._kv: Optional[KVBackend] = None
         self._info = None
 
     # ------------------------------------------------------------------
     @property
+    def kv(self) -> KVBackend:
+        """The volume root's sidecar/existence plane — ONE handle,
+        opened lazily and cached alongside ``_stores`` (never re-opened
+        per info/read_json/has_all_blocks call)."""
+        if self._kv is None:
+            self._kv = open_kv(self.kvstore)
+        return self._kv
+
+    @property
     def info(self) -> dict:
         if self._info is None:
-            local = _local_root(self.path)
-            if local is not None:
-                with open(os.path.join(local, "info")) as f:
-                    self._info = json.load(f)
-            else:
-                import tensorstore as ts
-
-                kv = ts.KvStore.open(self.kvstore).result()
-                self._info = json.loads(kv.read("info").result().value)
+            data = self.kv.read_bytes("info")
+            if data is None:
+                raise FileNotFoundError(f"no info file under {self.path}")
+            self._info = json.loads(data)
         return self._info
 
     def read_json(self, name: str):
         """Read a JSON sidecar file from the volume root (e.g.
         blackout_section_ids.json); None if absent."""
-        local = _local_root(self.path)
-        if local is not None:
-            p = os.path.join(local, name)
-            if not os.path.exists(p):
-                return None
-            with open(p) as f:
-                return json.load(f)
-        import tensorstore as ts
-
-        kv = ts.KvStore.open(self.kvstore).result()
-        result = kv.read(name).result()
-        if not result.value:
+        data = self.kv.read_bytes(name)
+        if not data:
             return None
-        return json.loads(result.value)
+        return json.loads(data)
 
     @property
     def num_mips(self) -> int:
@@ -148,6 +162,27 @@ class PrecomputedVolume:
             ).result()
         return self._stores[mip]
 
+    def _backend(self, mip: int) -> TensorStoreBackend:
+        """The storage-plane view of one mip's dataset (xyzc index
+        space, block grid anchored at the scale's voxel offset),
+        cached alongside ``_stores``."""
+        if mip not in self._backends:
+            block = self.block_size(mip)
+            offset = self.voxel_offset(mip)
+            self._backends[mip] = TensorStoreBackend(
+                self._store(mip),
+                token=f"{self.path}|mip{mip}",
+                block_shape=(block.x, block.y, block.z,
+                             self.num_channels),
+                grid_offset=(offset.x, offset.y, offset.z, 0),
+            )
+        return self._backends[mip]
+
+    def _xyzc_bounds(self, bbox: BoundingBox) -> Tuple[tuple, tuple]:
+        """zyx bbox -> (lo, hi) in the store's xyzc index space."""
+        s, e = bbox.start, bbox.stop
+        return (s.x, s.y, s.z, 0), (e.x, e.y, e.z, self.num_channels)
+
     def cutout(
         self,
         bbox: BoundingBox,
@@ -159,15 +194,24 @@ class PrecomputedVolume:
         tensorstore reads absent storage blocks as zeros (the reference's
         fill_missing=True semantics); pass ``fill_missing=False`` to instead
         raise when any covering block is absent (strict mode).
+
+        The read is block-decomposed: storage-block-aligned sub-reads
+        issued as concurrent futures through the shared hot-block LRU
+        (volume/storage.py) and assembled host-side — bit-identical to
+        the historical single blocking read (``CHUNKFLOW_STORAGE=
+        serial`` restores it exactly).
         """
         if not fill_missing and not self.has_all_blocks(bbox, mip=mip):
             raise FileNotFoundError(
                 f"missing storage blocks under {self.path} for {bbox} "
                 f"at mip {mip} (strict read)"
             )
-        store = self._store(mip)
-        sl_xyz = tuple(reversed(bbox.slices))  # zyx -> xyz
-        arr = store[sl_xyz + (slice(None),)].read().result()
+        backend = self._backend(mip)
+        lo, hi = self._xyzc_bounds(bbox)
+        if storage_mode() == "serial":
+            arr = serial_cutout(backend, lo, hi)
+        else:
+            arr = blockwise_cutout(backend, lo, hi, cache=shared_cache())
         # xyzc -> czyx
         arr = np.ascontiguousarray(np.transpose(arr, (3, 2, 1, 0)))
         if arr.shape[0] == 1:
@@ -189,12 +233,18 @@ class PrecomputedVolume:
         greyscale instead of silently collapsing to {0, 1}.
 
         With ``wait=False`` the blocking commit is skipped and the
-        tensorstore write future is returned — the caller OWNS the
-        barrier (the CLI drains futures before the task ack so the
+        write future is returned — the caller OWNS the barrier (the CLI
+        drains futures before the task ack so the
         ack-after-durable-write protocol holds; see
         runtime.drain_pending_writes).
+
+        The write rides the coalescing path (volume/storage.py):
+        block-aligned saves commit as concurrent per-block futures (no
+        read-modify-write) and update the hot-block cache write-through;
+        unaligned saves fall back to one driver write and invalidate the
+        covered blocks — read-after-write through the cache returns the
+        written bytes either way.
         """
-        store = self._store(mip)
         arr = as_native_dtype(np.asarray(chunk.array))
         if arr.ndim == 3:
             arr = arr[None]
@@ -211,17 +261,15 @@ class PrecomputedVolume:
             arr = np.clip(arr, 0.0, 1.0) * 255.0
         arr = arr.astype(self.dtype, copy=False)
         arr_xyzc = np.transpose(arr, (3, 2, 1, 0))  # czyx -> xyzc
-        sl_xyz = tuple(reversed(chunk.bbox.slices))
-        future = store[sl_xyz + (slice(None),)].write(arr_xyzc)
-        if wait:
-            future.result()
-            return None
-        # await the COPY leg (tensorstore reading the source buffer,
-        # which may alias chunk.array when no conversion was needed) so
-        # callers may freely reuse/mutate the chunk; only the storage
-        # COMMIT stays asynchronous until the drain barrier
-        future.copy.result()
-        return future
+        lo, _hi = self._xyzc_bounds(chunk.bbox)
+        # blockwise_save awaits the COPY legs itself under wait=False
+        # (tensorstore may alias chunk.array when no conversion was
+        # needed), so callers may freely reuse/mutate the chunk; only
+        # the storage COMMIT stays asynchronous until the drain barrier
+        return blockwise_save(
+            self._backend(mip), lo, arr_xyzc,
+            cache=shared_cache(), wait=wait,
+        )
 
     # ------------------------------------------------------------------
     def block_names(self, bbox: BoundingBox, mip: int = 0) -> List[str]:
@@ -247,18 +295,12 @@ class PrecomputedVolume:
 
         True iff every storage block covering ``bbox`` already exists, so a
         re-submitted task can be skipped (reference volume.py:194-209).
+        The check is batched stat-style through the volume's cached KV
+        handle (one key listing / one concurrent wave — never a
+        full-value download per block; volume/storage.py).
         """
-        local = _local_root(self.path)
         names = self.block_names(bbox, mip)
-        if local is not None:
-            return all(os.path.exists(os.path.join(local, n)) for n in names)
-        import tensorstore as ts
-
-        kv = ts.KvStore.open(self.kvstore).result()
-        for name in names:
-            if kv.read(name).result().state == "missing":
-                return False
-        return True
+        return all(self.kv.exists_many(names).values())
 
     # ------------------------------------------------------------------
     @classmethod
@@ -312,15 +354,14 @@ class PrecomputedVolume:
         local = _local_root(path)
         if local is not None:
             os.makedirs(local, exist_ok=True)
-            with open(os.path.join(local, "info"), "w") as f:
-                json.dump(info, f)
-        else:
-            import tensorstore as ts
-
-            kv = ts.KvStore.open(_kvstore_spec(path)).result()
-            kv.write("info", json.dumps(info).encode()).result()
         vol = cls(path)
+        vol.kv.write_bytes("info", json.dumps(info).encode())
         vol._info = info
+        # a recreated volume must not serve a predecessor's hot blocks
+        cache = shared_cache()
+        if cache is not None:
+            for mip in range(num_mips):
+                cache.invalidate_token(f"{path}|mip{mip}")
         return vol
 
     # ---- reference-spelling compatibility surface ----------------------

@@ -150,6 +150,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py serving_throughput --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "serving throughput gate"
 
+# --- storage throughput gate -------------------------------------------------
+# Serial uncached reads vs concurrent block reads + hot block cache on an
+# overlapping-halo cutout grid (docs/storage.md). Reports the >=1.3x
+# target as gate_pass (asserted slow-marked in tests/test_bench.py); the
+# process only fails below 1.1x. The run itself raises on any
+# bit-divergence between the serial, concurrent and cached legs.
+echo "== storage throughput gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py storage_throughput --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "storage throughput gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

@@ -228,6 +228,46 @@ def test_compute_bound_fleet_does_scale(tmp_path):
     assert sup.target == 2
 
 
+def _storage_bound_scrape(hits, misses):
+    def scrape(endpoint):
+        return {"endpoint": endpoint, "healthz": {"inflight_leases": 1},
+                "metrics": {"chunkflow_storage_hits_total": hits,
+                            "chunkflow_storage_misses_total": misses},
+                "dominant_stall": {"phase": "scheduler/load",
+                                   "share": 0.8},
+                "error": None}
+    return scrape
+
+
+def test_storage_hold_qualified_cold_cache(tmp_path):
+    """ISSUE 11: a storage-bound hold whose workers report a COLD block
+    cache (mostly misses) is qualified ':cold-cache' — the stall is
+    transient re-fetch traffic the warming LRU will absorb, not a
+    reason to re-shard the volume at 3 a.m."""
+    sup = make_supervisor(tmp_path, [IDLE, DEEP],
+                          scrape=_storage_bound_scrape(5, 95))
+    for _ in range(3):
+        sup.step()
+    assert sup.target == 1
+    holds = [e["reason"] for e in _fleet_events(sup)
+             if e["name"] == "fleet/hold"]
+    assert "storage-bound:scheduler/load:cold-cache" in holds
+
+
+def test_storage_hold_qualified_load_bound(tmp_path):
+    """ISSUE 11: storage-bound WITH a warm cache means the shared store
+    genuinely is the limit (network/volume bandwidth) — qualified
+    ':load-bound' so ops know adding workers or waiting won't help."""
+    sup = make_supervisor(tmp_path, [IDLE, DEEP],
+                          scrape=_storage_bound_scrape(90, 10))
+    for _ in range(3):
+        sup.step()
+    assert sup.target == 1
+    holds = [e["reason"] for e in _fleet_events(sup)
+             if e["name"] == "fleet/hold"]
+    assert "storage-bound:scheduler/load:load-bound" in holds
+
+
 def test_dead_letter_surge_holds_scale_up(tmp_path):
     """A dead-letter flood means the workload is poisoned — adding
     workers would just dead-letter faster."""

@@ -148,7 +148,7 @@ def test_e2e_overlap_microbench(tmp_path):
     # telemetry JSONL (incl. the scheduler/final depths event) landed
     # where we pointed it
     assert set(best["final_depths"]) == {
-        "prefetch", "ring", "inflight", "post", "write"
+        "prefetch", "ring", "inflight", "post", "write", "storage"
     }, best
     jsonls = [n for n in os.listdir(tmp_path) if n.endswith(".jsonl")]
     assert jsonls, best.get("telemetry_jsonl")
@@ -578,3 +578,60 @@ def test_serving_throughput_microbench(tmp_path):
     # the win is occupancy by construction: the packer must actually
     # have filled its batches from cross-request traffic
     assert best["packed_occupancy"] >= 0.9, best
+
+
+@pytest.mark.bench
+@pytest.mark.slow
+def test_storage_throughput_microbench(tmp_path):
+    """The hot block cache + concurrent block reads must beat the
+    historical serial whole-range read on the overlapping-halo cutout
+    grid (ISSUE 11 acceptance: >= 1.3x with a hot cache) and stay
+    bit-identical — run_storage_throughput itself raises on any
+    divergence between the serial, concurrent and cached legs.
+
+    Marked slow/bench like the other load-sensitive ratio gates (the
+    PR 7 deflake convention); run_tests.sh runs the same workload as a
+    standalone gate after serving_throughput. Fresh-subprocess +
+    best-of-3 pattern shared with them."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)  # the 8-device virtual mesh (conftest.py)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "storage_throughput"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] > best["value"]:
+            best = stats
+        if best["value"] >= 1.3:
+            break
+    assert best["metric"] == "storage_throughput_speedup"
+    assert best["value"] >= 1.3, best
+    assert best["gate_pass"] is True, best
+    # the win is the cache by construction: the hot pass must be pure
+    # hits, and the cold pass must already hit on grid overlap
+    assert best["hot_cache_misses"] == 0, best
+    assert best["cold_cache_hits"] > 0, best
+    assert best["concurrent_cold_s"] < best["serial_s"], best
+    # the run's storage counters landed in the telemetry JSONL for
+    # log-summary (the acceptance visibility criterion)
+    jsonls = [n for n in os.listdir(tmp_path) if n.endswith(".jsonl")]
+    assert jsonls, best.get("telemetry_jsonl")
+    events = []
+    for name in jsonls:
+        with open(os.path.join(tmp_path, name)) as f:
+            events += [json.loads(line) for line in f if line.strip()]
+    snaps = [e for e in events if e.get("kind") == "snapshot"]
+    assert snaps, "no snapshot event in the run's JSONL"
+    counters = snaps[-1].get("counters") or {}
+    assert counters.get("storage/hits", 0) > 0, counters
+    assert counters.get("storage/misses", 0) > 0, counters
+    assert counters.get("storage/bytes_read", 0) > 0, counters

@@ -183,3 +183,152 @@ def test_save_async_future_and_barrier(tmp_path):
     future.result()
     back = vol.cutout(BoundingBox.from_delta((0, 0, 0), (8, 16, 16)))
     np.testing.assert_allclose(np.asarray(back.array), data, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: the storage plane under PrecomputedVolume
+# ---------------------------------------------------------------------------
+@pytest.fixture(autouse=True)
+def _fresh_storage_plane():
+    from chunkflow_tpu.volume import storage
+
+    storage.reset_shared_cache()
+    yield
+    storage.reset_shared_cache()
+
+
+@pytest.mark.parametrize("dtype,channels", [
+    ("uint8", 1), ("uint16", 1), ("float32", 1), ("float32", 3),
+])
+def test_concurrent_cutout_bit_identical_to_serial(
+        tmp_path, monkeypatch, dtype, channels):
+    """Acceptance: concurrent cached cutouts are bit-identical to the
+    serial uncached reference read, on ragged grids including the
+    channel dim, for uint8/uint16/float32."""
+    rng = np.random.default_rng(3)
+    shape = (channels, 24, 40, 56) if channels > 1 else (24, 40, 56)
+    arr = (rng.random(shape) * 200).astype(dtype) + 1
+    vol = PrecomputedVolume.from_chunk(
+        Chunk(arr.astype(dtype)), str(tmp_path / "v"),
+        block_size=(16, 16, 16),
+    )
+    windows = [
+        BoundingBox((0, 0, 0), (24, 40, 56)),   # whole (ragged blocks)
+        BoundingBox((3, 5, 7), (21, 39, 55)),   # nothing aligned
+        BoundingBox((16, 16, 16), (24, 32, 32)),
+        BoundingBox((23, 39, 55), (24, 40, 56)),  # trailing voxel
+    ]
+    for window in windows:
+        monkeypatch.setenv("CHUNKFLOW_STORAGE", "serial")
+        ref = vol.cutout(window)
+        monkeypatch.setenv("CHUNKFLOW_STORAGE", "concurrent")
+        cold = vol.cutout(window)
+        hot = vol.cutout(window)  # cache-served repeat
+        np.testing.assert_array_equal(
+            np.asarray(cold.array), np.asarray(ref.array))
+        np.testing.assert_array_equal(
+            np.asarray(hot.array), np.asarray(ref.array))
+        assert cold.dtype == np.dtype(dtype)
+
+
+def test_read_after_write_through_cache(tmp_path):
+    """Acceptance: read-after-write through the cache returns the
+    written bytes — for aligned writes even if storage is later poked
+    out-of-band (the blocks are cache-served write-through)."""
+    from chunkflow_tpu.volume.storage import shared_cache
+
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "v"), volume_size=(32, 32, 32), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(16, 16, 16),
+    )
+    rng = np.random.default_rng(4)
+    data = rng.integers(1, 255, size=(32, 32, 32), dtype=np.uint8)
+    vol.save(Chunk(data))
+    assert shared_cache() is not None and len(shared_cache()) > 0
+    # poke storage behind the cache's back: the aligned write must be
+    # cache-served, proving read-after-write comes from the written bytes
+    vol._store(0)[0:32, 0:32, 0:32, 0:1].write(
+        np.zeros((32, 32, 32, 1), dtype=np.uint8)).result()
+    out = vol.cutout(BoundingBox((0, 0, 0), (32, 32, 32)))
+    np.testing.assert_array_equal(np.asarray(out.array), data)
+    # an UNALIGNED overwrite invalidates: the next read sees storage
+    patch = np.full((8, 8, 8), 9, dtype=np.uint8)
+    vol.save(Chunk(patch, voxel_offset=(4, 4, 4)))
+    out = vol.cutout(BoundingBox((4, 4, 4), (12, 12, 12)))
+    np.testing.assert_array_equal(np.asarray(out.array), patch)
+
+
+def test_save_uint16_roundtrip(tmp_path):
+    """uint16 passes through the dtype auto-conversion untouched."""
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "v16"), volume_size=(16, 16, 16), dtype="uint16",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 65535, size=(16, 16, 16), dtype=np.uint16)
+    vol.save(Chunk(data))
+    out = vol.cutout(BoundingBox((0, 0, 0), (16, 16, 16)))
+    assert out.dtype == np.uint16
+    np.testing.assert_array_equal(np.asarray(out.array), data)
+
+
+def test_save_float_clip_path_roundtrip(tmp_path):
+    """The float->uint8 clip path (reference latent-bug fix): values
+    outside [0,1] clip instead of wrapping on the truncating astype."""
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "vclip"), volume_size=(8, 8, 8), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(8, 8, 8),
+    )
+    data = np.array([-0.5, 0.0, 0.25, 0.999, 1.0, 1.5, 100.0, 0.5],
+                    dtype=np.float32).reshape(1, 1, 8)
+    full = np.tile(data, (8, 8, 1))
+    vol.save(Chunk(full))
+    out = vol.cutout(BoundingBox((0, 0, 0), (8, 8, 8)))
+    want = (np.clip(full, 0.0, 1.0) * 255.0).astype(np.uint8)
+    np.testing.assert_array_equal(np.asarray(out.array), want)
+
+
+def test_strict_read_through_concurrent_path(tmp_path):
+    """fill_missing=False must stay strict through the new concurrent
+    cutout path: raise while any covering block is absent, then read
+    bit-identically to serial once all blocks exist."""
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "vs"), volume_size=(32, 32, 32), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(16, 16, 16),
+    )
+    chunk = Chunk.create((16, 32, 32), dtype=np.uint8)
+    vol.save(Chunk(np.asarray(chunk.array)))  # top half only
+    with pytest.raises(FileNotFoundError):
+        vol.cutout(BoundingBox((0, 0, 0), (32, 32, 32)),
+                   fill_missing=False)
+    ok = vol.cutout(BoundingBox((0, 0, 0), (16, 32, 32)),
+                    fill_missing=False)
+    np.testing.assert_array_equal(
+        np.asarray(ok.array), np.asarray(chunk.array))
+
+
+def test_kv_handle_opened_once_and_cached(tmp_path, vol):
+    """Satellite: info/read_json/has_all_blocks share ONE cached KV
+    handle instead of reopening a store per call."""
+    kv_first = vol.kv
+    assert vol.info is not None
+    assert vol.read_json("nope.json") is None
+    vol.has_all_blocks(BoundingBox((0, 0, 0), (32, 32, 32)))
+    assert vol.kv is kv_first
+
+
+def test_has_all_blocks_remote_path_is_batched(tmp_path):
+    """Satellite: the remote existence check goes through the batched
+    TensorStoreKV.exists_many (key listing), not per-name full-value
+    downloads — forced here by installing the remote KV plane over the
+    file root."""
+    from chunkflow_tpu.volume.storage import TensorStoreKV
+
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "vr"), volume_size=(32, 32, 32), dtype="uint8",
+        voxel_size=(1, 1, 1), block_size=(16, 16, 16),
+    )
+    vol.save(Chunk.create((16, 32, 32), dtype=np.uint8))
+    vol._kv = TensorStoreKV(vol.kvstore)  # the remote code path
+    assert vol.has_all_blocks(BoundingBox((0, 0, 0), (16, 32, 32)))
+    assert not vol.has_all_blocks(BoundingBox((0, 0, 0), (32, 32, 32)))
