@@ -66,8 +66,8 @@ from chunkflow_tpu.core import telemetry
 __all__ = [
     "instrument_program", "catalog", "write_catalog", "device_peaks",
     "capture", "maybe_capture", "note_retrace", "note_stall",
-    "start_task_window", "note_task_done", "wait_for_captures",
-    "capture_base_dir",
+    "note_slo_page", "start_task_window", "note_task_done",
+    "wait_for_captures", "capture_base_dir",
 ]
 
 
@@ -520,6 +520,17 @@ def note_retrace(label: str) -> None:
     is paying an unplanned XLA compile per chunk — exactly the moment a
     bounded trace is worth its cost."""
     maybe_capture(f"retrace-{_safe_name(label)}")
+
+
+def note_slo_page(objective: str) -> None:
+    """A page-severity SLO burn-rate alert fired (core/slo.py): the
+    serving plane is burning error budget fast enough to page a human —
+    grab one bounded trace while the regression is still live, so the
+    evidence is on disk before anyone is awake. Rides the same cooldown
+    and kill switches as every other anomaly capture: an alert storm
+    cannot fill the disk, and a second alert inside the cooldown
+    captures nothing."""
+    maybe_capture(f"slo-{_safe_name(objective)}")
 
 
 def note_stall(phase: str, share: float) -> None:

@@ -60,6 +60,19 @@ claim/retry/requeue hops between workers. The task context is a
 ``contextvars.ContextVar``: thread- and generator-safe on the host
 side, and statically banned inside jitted code like every other
 telemetry call (graftlint GL007).
+
+Time series (docs/observability.md "SLO view"): the registry alone
+answers "how much, total" — an SLO plane needs "how fast, lately".
+:func:`start_timeseries` runs a bounded ring sampler in a daemon
+thread: every ``CHUNKFLOW_TS_INTERVAL`` seconds it derives counter
+*rates*, copies gauges, and estimates qhist p50/p99 into per-metric
+``(t, value)`` rings of ``CHUNKFLOW_TS_POINTS`` points
+(:func:`timeseries` reads them), flushes one ``timeseries``-kind event
+— including the raw cumulative qhist buckets, which sum across workers
+— to the JSONL stream so history survives worker death, and then runs
+the registered :func:`add_tick_hook` callbacks (the SLO evaluator,
+core/slo.py, rides here). ``CHUNKFLOW_TELEMETRY=0`` creates no sampler
+thread, no rings, no events.
 """
 from __future__ import annotations
 
@@ -69,7 +82,8 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "enabled", "configure", "configured_path", "inc", "gauge", "observe",
@@ -77,7 +91,9 @@ __all__ = [
     "hist_totals", "worker_id", "task_context", "current_trace_id",
     "snapshot_interval", "add_flush_hook", "add_reset_hook",
     "observe_quantile", "quantile", "quantile_from_buckets",
-    "QUANTILE_BOUNDS",
+    "QUANTILE_BOUNDS", "timeseries", "start_timeseries",
+    "stop_timeseries", "timeseries_running", "add_tick_hook",
+    "remove_tick_hook", "ts_interval", "ts_points",
 ]
 
 _OFF_VALUES = ("0", "off", "false", "no")
@@ -94,22 +110,29 @@ def enabled() -> bool:
 # fleet identity + per-task trace context
 # ---------------------------------------------------------------------------
 _WORKER_ID: Optional[str] = None
+_WORKER_ID_LOCK = threading.Lock()
 
 
 def worker_id() -> str:
     """Stable identity of this worker process: ``<hostname>-<pid>``, or
     the ``CHUNKFLOW_WORKER_ID`` env override (pid-namespaced containers
     where every worker is pid 1, and tests simulating a fleet in one
-    process). Cached after first use; :func:`reset` clears the cache (a
-    forked child should call :func:`configure`/:func:`reset` anyway —
-    it must not inherit the parent's sink)."""
+    process). Cached after first use — double-checked under a lock,
+    since the time-series sampler thread stamps events too; :func:`reset`
+    clears the cache (a forked child should call
+    :func:`configure`/:func:`reset` anyway — it must not inherit the
+    parent's sink)."""
     global _WORKER_ID
-    if _WORKER_ID is None:
-        _WORKER_ID = (
-            os.environ.get("CHUNKFLOW_WORKER_ID")
-            or f"{socket.gethostname()}-{os.getpid()}"
-        )
-    return _WORKER_ID
+    wid = _WORKER_ID
+    if wid is None:
+        with _WORKER_ID_LOCK:
+            if _WORKER_ID is None:
+                _WORKER_ID = (
+                    os.environ.get("CHUNKFLOW_WORKER_ID")
+                    or f"{socket.gethostname()}-{os.getpid()}"
+                )
+            wid = _WORKER_ID
+    return wid
 
 
 _TASK_CTX: contextvars.ContextVar = contextvars.ContextVar(
@@ -186,6 +209,20 @@ def _max_sink_bytes() -> int:
     except ValueError:
         mb = 256.0
     return int(mb * (1 << 20))
+
+
+def _keep_generations() -> int:
+    """Total JSONL generations kept per worker, live file included
+    (``CHUNKFLOW_TELEMETRY_KEEP``, default 2 = the live file plus one
+    ``.1`` rotation; minimum 1 = rotation truncates outright). A long
+    SLO run whose time-series history must survive rotation raises
+    this — each extra generation is another ``CHUNKFLOW_TELEMETRY_MAX_MB``
+    of history ``load_telemetry_dir`` can still read."""
+    raw = os.environ.get("CHUNKFLOW_TELEMETRY_KEEP", "")
+    try:
+        return max(1, int(raw)) if raw else 2
+    except ValueError:
+        return 2
 
 
 #: Upper bucket bounds (seconds) of the quantile histograms — log-spaced
@@ -303,18 +340,35 @@ class _Registry:
                 self._rotate_locked()
 
     def _rotate_locked(self) -> None:
-        """Size-capped rotation (caller holds the lock): the current
-        file moves to ``<path>.1`` (replacing any previous rotation) and
-        a fresh file opens at ``<path>`` — a long-lived worker keeps at
-        most two generations on disk. ``load_telemetry_dir`` reads both
-        (flow/log_summary.py)."""
+        """Size-capped rotation (caller holds the lock): generations
+        shift up one suffix (``<path>.1`` is the youngest rotation,
+        ``<path>.N`` the oldest) and a fresh file opens at ``<path>``
+        — a long-lived worker keeps at most ``CHUNKFLOW_TELEMETRY_KEEP``
+        generations on disk (default 2: live + ``.1``), anything older
+        is swept, including stale generations left by a previously
+        higher KEEP. ``load_telemetry_dir`` reads every surviving
+        generation oldest-first (flow/log_summary.py), so the
+        time-series/SLO history window is KEEP × MAX_MB, not one file."""
         try:
             self.sink.close()
         except OSError:
             pass
+        base = self.sink_path
+        rotations = _keep_generations() - 1
         try:
-            os.replace(self.sink_path, self.sink_path + ".1")
-            self.sink = open(self.sink_path, "a", buffering=1)
+            # shift from the oldest kept slot down so nothing clobbers
+            for n in range(rotations, 1, -1):
+                if os.path.exists(f"{base}.{n - 1}"):
+                    os.replace(f"{base}.{n - 1}", f"{base}.{n}")
+            if rotations >= 1:
+                os.replace(base, base + ".1")
+            else:
+                os.remove(base)  # KEEP=1: truncate, keep no history
+            n = rotations + 1
+            while os.path.exists(f"{base}.{n}"):
+                os.remove(f"{base}.{n}")
+                n += 1
+            self.sink = open(base, "a", buffering=1)
             self.sink_bytes = 0
         except OSError:
             self.sink = None  # unrotatable sink: stop emitting, keep computing
@@ -534,6 +588,199 @@ def snapshot() -> dict:
         return snap
 
 
+# ---------------------------------------------------------------------------
+# time-series ring sampler (the SLO plane's history substrate)
+# ---------------------------------------------------------------------------
+def ts_interval() -> float:
+    """Seconds between time-series samples (``CHUNKFLOW_TS_INTERVAL``,
+    default 10.0; <=0 disables the sampler entirely)."""
+    raw = os.environ.get("CHUNKFLOW_TS_INTERVAL", "")
+    try:
+        return float(raw) if raw else 10.0
+    except ValueError:
+        return 10.0
+
+
+def ts_points() -> int:
+    """Ring capacity per sampled metric (``CHUNKFLOW_TS_POINTS``,
+    default 360 — an hour of history at the default interval)."""
+    raw = os.environ.get("CHUNKFLOW_TS_POINTS", "")
+    try:
+        return max(2, int(raw)) if raw else 360
+    except ValueError:
+        return 360
+
+
+# tick hooks survive sampler restarts (the sampler reads the list each
+# tick); cleared by reset() — a hooked plane's state is per-run
+_TICK_HOOKS: list = []
+
+
+def add_tick_hook(fn) -> None:
+    """Register ``fn(now: float)`` to run after every time-series
+    sample (idempotent by identity) — how the SLO evaluator
+    (core/slo.py) gets its periodic record/evaluate clock without a
+    second thread. Hooks run outside all telemetry locks and are
+    best-effort: a raising hook is dropped from that tick, never the
+    pipeline."""
+    if fn not in _TICK_HOOKS:
+        _TICK_HOOKS.append(fn)
+
+
+def remove_tick_hook(fn) -> None:
+    try:
+        _TICK_HOOKS.remove(fn)
+    except ValueError:
+        pass
+
+
+class _TimeSeriesSampler:
+    """Bounded in-memory (t, value) rings over the registry, fed by one
+    daemon thread. Each sample derives counters-as-rates against the
+    previous tick, copies gauges, and estimates qhist p50/p99; when a
+    sink is configured it also flushes one ``timeseries``-kind event
+    carrying the sampled values plus the raw cumulative qhist buckets
+    (fixed bounds: summable across workers, so ``log-summary --slo``
+    can reconstruct a fleet p99 timeline from merged JSONL alone)."""
+
+    def __init__(self, interval: float, points: int):
+        self.interval = max(0.01, float(interval))
+        self.points = int(points)
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {}
+        self._prev: Optional[Tuple[float, dict]] = None
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        # baseline sample: establishes the counter snapshot rates are
+        # derived against, so a run shorter than one interval still
+        # gets a meaningful sample out of the final flush()
+        try:
+            self.sample()
+        except Exception:
+            pass
+        self._thread = threading.Thread(
+            target=self._run, name="chunkflow-timeseries", daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.interval):
+            if not enabled():
+                continue  # mid-run disable: stop sampling, keep idling
+            try:
+                self.sample()
+            except Exception:
+                pass  # a sampling hiccup must never take a worker down
+
+    def sample(self, now: Optional[float] = None) -> Dict[str, float]:
+        """One sample tick (the thread's body; tests and flush() call it
+        directly). Returns the sampled ``{name: value}`` map."""
+        if now is None:
+            now = time.time()
+        snap = snapshot()
+        qhists = snap.get("qhists") or {}
+        values: Dict[str, float] = {}
+        with self._lock:
+            prev = self._prev
+            if prev is not None and now > prev[0]:
+                dt = now - prev[0]
+                for name, value in snap["counters"].items():
+                    values[f"rate:{name}"] = round(
+                        (value - prev[1].get(name, 0.0)) / dt, 6)
+            self._prev = (now, dict(snap["counters"]))
+            for name, value in snap["gauges"].items():
+                values[f"gauge:{name}"] = value
+            for name, h in qhists.items():
+                p50 = quantile_from_buckets(h, 0.5)
+                if p50 is not None:
+                    values[f"p50:{name}"] = p50
+                    values[f"p99:{name}"] = quantile_from_buckets(h, 0.99)
+            for name, value in values.items():
+                ring = self._rings.get(name)
+                if ring is None:
+                    ring = self._rings[name] = deque(maxlen=self.points)
+                ring.append((now, value))
+        if values or qhists:
+            event(
+                "timeseries", "timeseries/sample", interval_s=self.interval,
+                values=values,
+                qhists={
+                    name: {"count": h["count"], "buckets": h["buckets"]}
+                    for name, h in qhists.items()
+                },
+            )
+        for hook in list(_TICK_HOOKS):
+            try:
+                hook(now)
+            except Exception:
+                pass
+        return values
+
+    def series(self) -> Dict[str, List[Tuple[float, float]]]:
+        with self._lock:
+            return {name: list(ring) for name, ring in self._rings.items()}
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=timeout)
+
+
+_SAMPLER_LOCK = threading.Lock()
+_SAMPLER: Optional[_TimeSeriesSampler] = None
+
+
+def start_timeseries(interval: Optional[float] = None,
+                     points: Optional[int] = None):
+    """Start the time-series sampler thread (idempotent: an already
+    running sampler is returned as-is). Returns None — creating **no
+    thread and no rings** — when telemetry is disabled or the interval
+    knob is <=0; the CLI calls this whenever a metrics dir is
+    configured, so every instrumented run gets history for free."""
+    global _SAMPLER
+    if not enabled():
+        return None
+    if interval is None:
+        interval = ts_interval()
+    if interval <= 0:
+        return None
+    with _SAMPLER_LOCK:
+        if _SAMPLER is not None:
+            return _SAMPLER
+        sampler = _TimeSeriesSampler(interval,
+                                     ts_points() if points is None
+                                     else points)
+        _SAMPLER = sampler
+    sampler.start()
+    return sampler
+
+
+def stop_timeseries() -> None:
+    """Stop and join the sampler thread (reset() calls this)."""
+    global _SAMPLER
+    with _SAMPLER_LOCK:
+        sampler, _SAMPLER = _SAMPLER, None
+    if sampler is not None:
+        sampler.stop()
+
+
+def timeseries_running() -> bool:
+    return _SAMPLER is not None
+
+
+def timeseries() -> Dict[str, List[Tuple[float, float]]]:
+    """Copy of the per-metric ``[(t, value), ...]`` rings — ``rate:<counter>``,
+    ``gauge:<name>``, ``p50:<qhist>``/``p99:<qhist>`` — or ``{}`` when no
+    sampler is running (telemetry off, or never started)."""
+    sampler = _SAMPLER
+    if sampler is None:
+        return {}
+    return sampler.series()
+
+
 # Layer hooks: other observability planes (core/profiling.py's program
 # cost ledger) ride the same flush/reset lifecycle without telemetry
 # importing them (this module stays zero-dependency). Flush hooks get
@@ -564,6 +811,14 @@ def flush() -> None:
     they are aggregate-only during the run."""
     if not enabled():
         return
+    # one last time-series sample (and SLO tick) so a run shorter than
+    # the sampling interval still leaves history + a final evaluation
+    sampler = _SAMPLER
+    if sampler is not None:
+        try:
+            sampler.sample()
+        except Exception:
+            pass
     metrics_dir = (
         os.path.dirname(_REG.sink_path) if _REG.sink_path else None
     )
@@ -585,10 +840,12 @@ def flush() -> None:
 
 
 def reset() -> None:
-    """Clear all metrics, close the sink, and drop the cached worker
-    identity (tests; each CLI invocation is one process, so production
-    never needs this)."""
+    """Clear all metrics, close the sink, stop the time-series sampler,
+    and drop the cached worker identity (tests; each CLI invocation is
+    one process, so production never needs this)."""
     global _WORKER_ID
+    stop_timeseries()
+    _TICK_HOOKS.clear()
     with _REG.lock:
         _REG.counters.clear()
         _REG.gauges.clear()
@@ -601,7 +858,8 @@ def reset() -> None:
                 pass
         _REG.sink, _REG.sink_path = None, None
         _REG.sink_bytes = 0
-    _WORKER_ID = None
+    with _WORKER_ID_LOCK:
+        _WORKER_ID = None
     for hook in list(_RESET_HOOKS):
         try:
             hook()

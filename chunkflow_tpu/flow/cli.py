@@ -93,8 +93,15 @@ def name_option(default):
                    "ephemeral port; CHUNKFLOW_METRICS_PORT is the env "
                    "equivalent). CHUNKFLOW_TELEMETRY=0 creates no "
                    "listener (docs/observability.md \"Fleet view\")")
+@click.option("--slo-config", type=str, default=None,
+              help="TOML file overriding the SLO objectives / burn-rate "
+                   "rules (top level = the [tool.chunkflow.slo] table; "
+                   "docs/observability.md \"SLO view\"). Defaults + any "
+                   "pyproject [tool.chunkflow.slo] apply without it; "
+                   "CHUNKFLOW_SLO=0 disables the evaluator, "
+                   "CHUNKFLOW_TELEMETRY=0 the whole plane")
 def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
-         metrics_port):
+         metrics_port, slo_config):
     """chunkflow-tpu: compose chunk operators into a pipeline.
 
     \b
@@ -130,6 +137,16 @@ def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
     log-summary / tools/analyze_trace.py; POST /profile?seconds=N on
     the metrics port profiles a live worker on demand.
     CHUNKFLOW_TELEMETRY=0 disables the entire plane.
+
+    \b
+    SLO plane (docs/observability.md "SLO view"): with --metrics-dir
+    (or --slo-config) a time-series sampler records counter rates /
+    gauges / latency quantiles (CHUNKFLOW_TS_INTERVAL, default 10 s;
+    CHUNKFLOW_TS_POINTS ring size) and the burn-rate evaluator fires
+    alert events against the configured objectives; GET /alerts on the
+    metrics port shows live burn/budget state, log-summary --slo
+    reconstructs the same from JSONL; CHUNKFLOW_SLO=0 disables just
+    the evaluator.
     """
     from chunkflow_tpu.core import telemetry
 
@@ -144,6 +161,15 @@ def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
         # configure BEFORE any stage runs so operator construction
         # (engine load, program cache) is visible in the stream too
         telemetry.configure(metrics_dir)
+    if metrics_dir or slo_config:
+        # the SLO plane (docs/observability.md "SLO view"): a bounded
+        # time-series sampler over the registry plus burn-rate
+        # evaluation against the configured objectives; both are
+        # no-ops (no threads, no files) under CHUNKFLOW_TELEMETRY=0
+        from chunkflow_tpu.core import slo
+
+        telemetry.start_timeseries()
+        slo.start_slo(slo_config)
     from chunkflow_tpu.parallel.restapi import (
         exporter_port_from_env,
         start_metrics_exporter,
@@ -211,7 +237,7 @@ def _print_run_telemetry(verbose: int) -> None:
 
 @main.result_callback()
 def run_pipeline(stages, mip, dry_run, verbose, profile_dir, profile_tasks,
-                 metrics_dir, metrics_port):
+                 metrics_dir, metrics_port, slo_config):
     window = None
     if profile_dir:
         # windowed capture (core/profiling.py): the trace covers the
@@ -898,6 +924,12 @@ def fleet_status_cmd(queue_name, workers, timeout, fleet_state):
             mvox = achieved_mvox_s(metrics)
             if mvox is not None:
                 line += f" achieved={mvox:.2f} Mvox/s"
+            if sample.get("slo_firing"):
+                # out-of-spec workers lead with their firing objectives
+                # (chunkflow_slo_*_firing gauges; docs/observability.md
+                # "SLO view" — full detail on the worker's /alerts)
+                line += (" SLO-FIRING: "
+                         + ",".join(sample["slo_firing"]))
             print(line)
             serving = sample.get("serving")
             if serving:
@@ -1726,13 +1758,20 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
               help="with --fleet: also print this task's merged "
                    "cross-worker timeline (submit → claim(s) → retries → "
                    "commit/dead-letter)")
+@click.option("--slo/--no-slo", "slo_view", default=False,
+              help="print the SLO block: alert timeline with burn-rate/"
+                   "budget attributes, per-objective fleet state, and "
+                   "sparkline timelines fleet-merged from the JSONL "
+                   "timeseries events (docs/observability.md \"SLO "
+                   "view\") — reconstructable after every worker died")
 @cartesian_option("--output-size", default=None)
 def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
-                    output_size):
+                    slo_view, output_size):
     """Aggregate per-task timing logs and/or telemetry JSONL into a
     throughput + stall-attribution report."""
     from chunkflow_tpu.flow.log_summary import (
         print_fleet_summary,
+        print_slo_summary,
         print_summary,
         print_telemetry_summary,
     )
@@ -1741,9 +1780,9 @@ def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
         raise click.UsageError(
             "log-summary needs --log-dir and/or --metrics-dir"
         )
-    if (fleet or trace_id) and summary_metrics_dir is None:
+    if (fleet or trace_id or slo_view) and summary_metrics_dir is None:
         raise click.UsageError(
-            "log-summary --fleet/--trace-id needs --metrics-dir"
+            "log-summary --fleet/--trace-id/--slo needs --metrics-dir"
         )
 
     @generator
@@ -1757,8 +1796,10 @@ def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
         if summary_metrics_dir is not None:
             if fleet or trace_id:
                 print_fleet_summary(summary_metrics_dir, trace_id=trace_id)
-            else:
+            elif not slo_view:
                 print_telemetry_summary(summary_metrics_dir)
+            if slo_view:
+                print_slo_summary(summary_metrics_dir)
         return
         yield  # pragma: no cover
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 from typing import List, Optional
 
@@ -178,22 +179,31 @@ def print_summary(log_dir: str, output_size=None) -> None:
 # ---------------------------------------------------------------------------
 # telemetry JSONL aggregation (core/telemetry.py event stream)
 # ---------------------------------------------------------------------------
+_ROTATION_RE = re.compile(r"^(?P<base>.+\.jsonl)(?:\.(?P<gen>\d+))?$")
+
+
 def load_telemetry_dir(metrics_dir: str) -> List[dict]:
-    """Parse every ``telemetry-*.jsonl`` (plus size-capped ``.jsonl.1``
-    rotations, read first so a worker's stream stays in order) under
-    ``metrics_dir`` into a flat event list — one file per worker; the
-    aggregate is the fleet view. Torn trailing lines (a worker killed
-    mid-write) are skipped, not fatal."""
+    """Parse every ``telemetry-*.jsonl`` (plus every size-capped
+    ``.jsonl.<N>`` rotation generation — ``CHUNKFLOW_TELEMETRY_KEEP``
+    controls how many survive — read oldest-first so a worker's stream
+    stays in order) under ``metrics_dir`` into a flat event list — one
+    file per worker; the aggregate is the fleet view. Torn trailing
+    lines (a worker killed mid-write) are skipped, not fatal."""
     events: List[dict] = []
     if not os.path.isdir(metrics_dir):
         return events
-    names = [
-        name for name in os.listdir(metrics_dir)
-        if name.endswith(".jsonl") or name.endswith(".jsonl.1")
-    ]
-    # "<base>.jsonl.1" holds the OLDER events of "<base>.jsonl": sort
-    # rotations immediately before their live file
-    names.sort(key=lambda n: (n[:-2], 0) if n.endswith(".1") else (n, 1))
+    matches = {
+        name: m for name in os.listdir(metrics_dir)
+        if (m := _ROTATION_RE.match(name)) is not None
+    }
+    # "<base>.jsonl.N" holds OLDER events than ".jsonl.N-1" holds OLDER
+    # events than the live "<base>.jsonl": sort each base's generations
+    # highest-suffix-first, immediately before their live file
+    names = sorted(
+        matches,
+        key=lambda n: (matches[n].group("base"),
+                       -int(matches[n].group("gen") or 0)),
+    )
     for name in names:
         with open(os.path.join(metrics_dir, name)) as f:
             for line in f:
@@ -512,6 +522,244 @@ def print_storage_block(agg: dict, indent: str = "") -> bool:
               f"— raise CHUNKFLOW_STORAGE_CACHE_MB or check the task "
               f"grid ordering (docs/storage.md)")
     return True
+
+
+# ---------------------------------------------------------------------------
+# SLO view: fleet-merged time series, sparklines, alert timeline
+# ---------------------------------------------------------------------------
+#: sparkline glyphs, lowest to highest (an empty bin renders as space)
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(points: List[tuple], width: int = 48) -> str:
+    """A one-line timeline of ``[(t, value), ...]``: values resampled
+    to at most ``width`` buckets (bucket mean), scaled min→max across
+    the 8 block glyphs. Constant series render mid-scale; empty series
+    render empty."""
+    values = [float(v) for _, v in points if v is not None]
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [
+            float(np.mean(values[int(i * step):max(int(i * step) + 1,
+                                                   int((i + 1) * step))]))
+            for i in range(width)
+        ]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK_BLOCKS[3] * len(values)
+    scale = (len(_SPARK_BLOCKS) - 1) / (hi - lo)
+    return "".join(
+        _SPARK_BLOCKS[int(round((v - lo) * scale))] for v in values
+    )
+
+
+def summarize_timeseries(events: List[dict]) -> dict:
+    """Fleet-merge the ``timeseries``-kind sampler events
+    (core/telemetry.py) into per-metric timelines::
+
+        {"series": {name: [(bin_t, value), ...]}, "bin_s": float}
+
+    Binned to the sampler interval; within a bin, ``rate:*`` series SUM
+    across workers (a fleet serves the sum of its workers' request
+    rates) while ``gauge:``/``p50:``/``p99:`` series average. Per-worker
+    latency quantiles do not merge, so fleet quantiles are rebuilt the
+    only correct way: each event carries its worker's raw cumulative
+    qhist buckets, consecutive events difference into per-bin bucket
+    deltas, deltas sum across workers (fixed bounds!), and the summed
+    delta histogram yields a ``fleet_p99:<qhist>``/``fleet_p50:<qhist>``
+    point per bin — the fleet's latency distribution in that window."""
+    from chunkflow_tpu.core import telemetry as _telemetry
+
+    ts_events = [e for e in events if e.get("kind") == "timeseries"]
+    if not ts_events:
+        return {"series": {}, "bin_s": None}
+    intervals = sorted(
+        float(e.get("interval_s") or 0) for e in ts_events
+        if e.get("interval_s")
+    )
+    bin_s = max(intervals[len(intervals) // 2], 1e-3) if intervals else 10.0
+
+    # worker -> [(t, values, qhists)] in time order
+    by_worker: dict = {}
+    for e in ts_events:
+        by_worker.setdefault(_event_worker(e), []).append(e)
+    # bin -> name -> worker -> [values]  (then mean per worker, merge)
+    bins: dict = {}
+    qbins: dict = {}  # bin -> qname -> summed delta {"count", "buckets"}
+    for worker, stream in by_worker.items():
+        stream.sort(key=lambda e: e.get("t", 0.0))
+        prev_qh: dict = {}
+        for e in stream:
+            t = float(e.get("t", 0.0))
+            b = int(t // bin_s)
+            for name, value in (e.get("values") or {}).items():
+                if value is None:
+                    continue
+                bins.setdefault(b, {}).setdefault(
+                    name, {}).setdefault(worker, []).append(float(value))
+            for qname, h in (e.get("qhists") or {}).items():
+                buckets = list(h.get("buckets") or [])
+                count = float(h.get("count", 0))
+                prev = prev_qh.get(qname)
+                if prev is not None:
+                    d_count = count - prev[0]
+                    d_buckets = [
+                        cur - old for cur, old in zip(
+                            buckets, prev[1] + [0] * len(buckets))
+                    ]
+                    if d_count > 0:
+                        agg = qbins.setdefault(b, {}).setdefault(
+                            qname, {"count": 0.0,
+                                    "buckets": [0.0] * len(d_buckets)})
+                        agg["count"] += d_count
+                        for i, d in enumerate(d_buckets):
+                            if i < len(agg["buckets"]):
+                                agg["buckets"][i] += max(0.0, d)
+                            else:
+                                agg["buckets"].append(max(0.0, d))
+                prev_qh[qname] = (count, buckets)
+
+    series: dict = {}
+    for b in sorted(bins):
+        bin_t = (b + 0.5) * bin_s
+        for name, per_worker in bins[b].items():
+            worker_means = [sum(vs) / len(vs)
+                            for vs in per_worker.values()]
+            if name.startswith("rate:"):
+                value = sum(worker_means)  # fleet rate = sum of workers
+            else:
+                value = sum(worker_means) / len(worker_means)
+            series.setdefault(name, []).append((bin_t, value))
+    for b in sorted(qbins):
+        bin_t = (b + 0.5) * bin_s
+        for qname, agg in qbins[b].items():
+            for q, label in ((0.5, "fleet_p50"), (0.99, "fleet_p99")):
+                value = _telemetry.quantile_from_buckets(agg, q)
+                if value is not None:
+                    series.setdefault(
+                        f"{label}:{qname}", []).append((bin_t, value))
+    return {"series": series, "bin_s": bin_s}
+
+
+#: merged series worth a timeline in the SLO block, in display order
+#: (prefix match); everything else stays queryable via the returned agg
+_SLO_TIMELINE_PREFIXES = (
+    "rate:serving/requests", "rate:serving/errors",
+    "rate:serving/deadline_missed", "rate:tasks/dead_lettered",
+    "fleet_p99:", "fleet_p50:", "gauge:serving/inflight",
+    "gauge:slo/",
+)
+
+
+def _slo_gauge_state(events: List[dict]) -> dict:
+    """Last-seen ``slo/*`` gauge values per worker, from gauge events
+    (stream order) with snapshot-gauge hole-filling — the same recovery
+    contract as the rest of the summary: a SIGKILLed worker's final
+    periodic snapshot still tells us whether it was firing."""
+    state: dict = {}  # worker -> {gauge_name: value}
+    for record in events:
+        worker = _event_worker(record)
+        if record.get("kind") == "gauge" and \
+                str(record.get("name", "")).startswith("slo/"):
+            state.setdefault(worker, {})[record["name"]] = float(
+                record.get("value", 0.0))
+        elif record.get("kind") == "snapshot":
+            for name, value in (record.get("gauges") or {}).items():
+                if name.startswith("slo/"):
+                    state.setdefault(worker, {}).setdefault(
+                        name, float(value))
+    return state
+
+
+def print_slo_block(events: List[dict], indent: str = "",
+                    width: int = 48) -> bool:
+    """The SLO block (docs/observability.md "SLO view"): every alert
+    event in the merged stream (fired and resolved, with burn-rate and
+    budget attributes), per-objective fleet state from the ``slo/*``
+    gauges, and fleet-merged sparkline timelines from the timeseries
+    events — all reconstructed from JSONL alone, so it works on the
+    metrics dir of a fleet that is already dead. Quiet (returns False)
+    when the stream carries no SLO plane at all."""
+    fired = [e for e in events if e.get("kind") == "alert"
+             and e.get("state", "firing") == "firing"]
+    resolved = [e for e in events if e.get("kind") == "alert"
+                and e.get("state") == "resolved"]
+    gauge_state = _slo_gauge_state(events)
+    ts = summarize_timeseries(events)
+    if not fired and not resolved and not gauge_state and not ts["series"]:
+        return False
+    print(f"{indent}slo (docs/observability.md \"SLO view\"):")
+    print(f"{indent}  alerts fired: {len(fired)} "
+          f"({len(resolved)} resolved)")
+    for e in sorted(fired, key=lambda e: e.get("t", 0.0)):
+        print(
+            f"{indent}    [{_event_worker(e)}] {e.get('alert', '?')} "
+            f"{e.get('severity', '?')} "
+            f"burn_short={e.get('burn_short', 0):g} "
+            f"burn_long={e.get('burn_long', 0):g} "
+            f"budget_remaining={e.get('budget_remaining', 0):g}"
+        )
+    # per-objective fleet state: a worker is firing if its last gauge
+    # said so; budget is the worst (minimum) across workers
+    objectives: dict = {}
+    for worker, gauges in gauge_state.items():
+        for name, value in gauges.items():
+            parts = name.split("/")
+            if len(parts) != 3:
+                continue
+            _, obj, field = parts
+            entry = objectives.setdefault(
+                obj, {"firing": [], "budget": None, "burn": None})
+            if field == "firing" and value >= 1.0:
+                entry["firing"].append(worker)
+            elif field == "budget_remaining":
+                entry["budget"] = (value if entry["budget"] is None
+                                   else min(entry["budget"], value))
+            elif field == "burn_rate":
+                entry["burn"] = (value if entry["burn"] is None
+                                 else max(entry["burn"], value))
+    for obj in sorted(objectives):
+        entry = objectives[obj]
+        line = f"{indent}  objective {obj}:"
+        if entry["budget"] is not None:
+            line += f" budget remaining {entry['budget']:.1%}"
+        if entry["burn"] is not None:
+            line += f" burn {entry['burn']:g}x"
+        if entry["firing"]:
+            line += f" FIRING ({', '.join(sorted(entry['firing']))})"
+        print(line)
+    if ts["series"]:
+        shown = []
+        for prefix in _SLO_TIMELINE_PREFIXES:
+            shown += sorted(
+                name for name in ts["series"]
+                if name.startswith(prefix) and name not in shown
+            )
+        if shown:
+            print(f"{indent}  timelines (fleet-merged, "
+                  f"~{ts['bin_s']:g}s bins):")
+        for name in shown[:12]:
+            points = ts["series"][name]
+            line = sparkline(points, width=width)
+            last = points[-1][1]
+            print(f"{indent}    {name:<32} {line} last={last:g}")
+    return True
+
+
+def print_slo_summary(metrics_dir: str, width: int = 48) -> Optional[dict]:
+    """The ``log-summary --slo`` report over a metrics dir; returns the
+    merged timeseries aggregate (None when the dir has no events)."""
+    events = load_telemetry_dir(metrics_dir)
+    if not events:
+        print(f"no telemetry events found in {metrics_dir}")
+        return None
+    print(f"telemetry: {len(events)} events from {metrics_dir}")
+    if not print_slo_block(events, width=width):
+        print("no SLO events in this stream (run with --metrics-dir and "
+              "the SLO plane enabled; docs/observability.md \"SLO view\")")
+    return summarize_timeseries(events)
 
 
 def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:

@@ -173,6 +173,10 @@ class WorkerHandle:
         # None until the worker reports any) — lets a storage-bound
         # hold tell cache-cold from genuinely load-bound
         self.storage_cache: Optional[dict] = None
+        # last-scraped firing SLO objectives (restapi.firing_alerts);
+        # the supervisor annotates its scale/hold events with these so
+        # the ops timeline shows WHAT was out of spec when it decided
+        self.slo_firing: List[str] = []
         self.drill = False
         self.drain_deadline: Optional[float] = None
 
@@ -427,6 +431,7 @@ class FleetSupervisor:
                 {"hits": float(hits or 0), "misses": float(misses or 0)}
                 if (hits is not None or misses is not None) else None
             )
+            worker.slo_firing = list(sample.get("slo_firing") or [])
             return
         if worker.state == "starting" and \
                 now - worker.started < self.startup_grace:
@@ -621,9 +626,27 @@ class FleetSupervisor:
                              if now - t <= window]
         return sum(d for _, d in self._recent_dead) >= self.dead_letter_surge
 
+    def _fleet_slo_firing(self) -> List[str]:
+        """Union of the firing SLO objectives across the last active
+        worker scrapes (restapi.firing_alerts) — the annotation every
+        scale/hold decision carries. Annotation ONLY in this PR: the
+        controller does not yet act on it (the policy half of the SLO
+        closed loop is a later PR), but the ops timeline already shows
+        what was out of spec at each decision."""
+        firing: set = set()
+        for worker in self.workers:
+            if worker.active:
+                firing.update(worker.slo_firing)
+        return sorted(firing)
+
+    def _slo_attrs(self) -> dict:
+        firing = self._fleet_slo_firing()
+        return {"slo_firing": firing} if firing else {}
+
     def _hold(self, reason: str) -> None:
         telemetry.inc("fleet/holds")
-        telemetry.event("fleet", "fleet/hold", reason=reason)
+        telemetry.event("fleet", "fleet/hold", reason=reason,
+                        **self._slo_attrs())
 
     def _decide(self, stats: dict, now: float) -> None:
         """One controller tick: move ``self.target`` by at most one,
@@ -648,6 +671,7 @@ class FleetSupervisor:
             telemetry.event(
                 "fleet", "fleet/scale", direction="down",
                 target=self.min_workers, reason="idle-queue",
+                **self._slo_attrs(),
             )
             self.target = self.min_workers
             return
@@ -690,6 +714,7 @@ class FleetSupervisor:
             "fleet", "fleet/scale", direction="up", target=self.target,
             reason="deep-queue", pending=pending,
             dominant=(dominant or {}).get("phase"),
+            **self._slo_attrs(),
         )
 
     def _enact(self, now: float) -> None:

@@ -13,6 +13,9 @@ required). Endpoints:
   telemetry registry snapshot (counters/gauges/span summaries + derived
   stall shares), the scrape surface a fleet supervisor polls
 - ``GET /healthz``              -> worker identity + in-flight lease count
+- ``GET /alerts``               -> live SLO state: per-objective burn
+  rates, error-budget remaining, firing alerts (core/slo.py;
+  docs/observability.md "SLO view")
 
 Workers coordinate hierarchical jobs (meshing/agglomeration merges) through
 this service; flat grid jobs should keep using queues (SURVEY §5.8 — the
@@ -32,7 +35,7 @@ import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from chunkflow_tpu.core import telemetry
 from chunkflow_tpu.parallel.task_tree import GlobalIdAllocator, SpatialTaskTree
@@ -212,6 +215,8 @@ class CoordinationService:
             return 200, render_prometheus()
         if method == "GET" and path == "/healthz":
             return 200, worker_health()
+        if method == "GET" and path == "/alerts":
+            return self._handle_alerts()
         if method == "POST" and path.split("?", 1)[0] == "/profile":
             return self._handle_profile(path)
         m = re.fullmatch(r"/objids/(\d+)", path)
@@ -237,6 +242,30 @@ class CoordinationService:
                 return 404, {"error": "no task tree configured"}
             return 200, self.tree.to_dict()
         return 404, {"error": f"unknown endpoint {method} {path}"}
+
+    @staticmethod
+    def _handle_alerts():
+        """``GET /alerts``: this worker's live SLO state (docs/
+        observability.md "SLO view") — per-objective burn rates, error
+        budget remaining, and the currently-firing alert list the fleet
+        supervisor annotates its decisions with. Under
+        ``CHUNKFLOW_TELEMETRY=0`` the route does not exist (404, and
+        the exporter never opened a socket anyway); a worker running
+        without an SLO evaluator answers ``enabled: false`` rather
+        than erroring — dashboards must render around it."""
+        if not telemetry.enabled():
+            return 404, {"error": "telemetry disabled "
+                                  "(CHUNKFLOW_TELEMETRY=0)"}
+        from chunkflow_tpu.core import slo
+
+        evaluator = slo.current()
+        if evaluator is None:
+            return 200, {"enabled": False, "worker": telemetry.worker_id(),
+                         "firing": [], "objectives": []}
+        payload = evaluator.status()
+        payload["enabled"] = True
+        payload["worker"] = telemetry.worker_id()
+        return 200, payload
 
     @staticmethod
     def _handle_profile(path: str):
@@ -526,17 +555,37 @@ def serving_stats(text: str) -> Optional[dict]:
     return out
 
 
+_SLO_FIRING_PREFIX = "chunkflow_slo_"
+_SLO_FIRING_SUFFIX = "_firing"
+
+
+def firing_alerts(metrics: Dict[str, float]) -> List[str]:
+    """Objective names whose SLO alert is firing, from one worker's
+    parsed ``/metrics`` sample: every ``chunkflow_slo_<objective>_firing``
+    gauge at 1. The flat-name form (vs. the richer ``/alerts`` JSON) is
+    what the fleet supervisor reads during its normal scrape — no extra
+    round trip on the decision tick."""
+    return sorted(
+        name[len(_SLO_FIRING_PREFIX):-len(_SLO_FIRING_SUFFIX)]
+        for name, value in (metrics or {}).items()
+        if name.startswith(_SLO_FIRING_PREFIX)
+        and name.endswith(_SLO_FIRING_SUFFIX) and value >= 1.0
+    )
+
+
 def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
     """Sample one worker's observability endpoints for ``fleet-status``
     and the fleet supervisor: ``{"endpoint", "healthz": dict|None,
     "metrics": {name: value}|None, "dominant_stall": dict|None,
-    "error": str|None}``. ``endpoint`` is ``host:port`` or a full URL;
-    unreachable workers report the error instead of raising — a fleet
-    dashboard must render around dead workers."""
+    "slo_firing": [objective, ...], "error": str|None}``. ``endpoint``
+    is ``host:port`` or a full URL; unreachable workers report the
+    error instead of raising — a fleet dashboard must render around
+    dead workers."""
     base = endpoint if "://" in endpoint else f"http://{endpoint}"
     base = base.rstrip("/")
     out = {"endpoint": base, "healthz": None, "metrics": None,
-           "dominant_stall": None, "serving": None, "error": None}
+           "dominant_stall": None, "serving": None, "slo_firing": [],
+           "error": None}
     try:
         with urllib.request.urlopen(f"{base}/healthz",
                                     timeout=timeout) as resp:
@@ -547,6 +596,7 @@ def scrape_worker(endpoint: str, timeout: float = 1.0) -> dict:
         out["metrics"] = parse_prometheus(text)
         out["dominant_stall"] = dominant_stall(text)
         out["serving"] = serving_stats(text)
+        out["slo_firing"] = firing_alerts(out["metrics"])
     except Exception as exc:  # noqa: BLE001 — any failure = unreachable
         out["error"] = f"{type(exc).__name__}: {exc}"
     return out

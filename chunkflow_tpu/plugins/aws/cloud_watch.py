@@ -24,6 +24,10 @@ Published, namespace ``chunkflow-tpu``:
 * per-phase span totals as Seconds, plus the derived per-phase stall
   shares and the dominant-stall share (``stall/dominant_share``) — the
   autoscaling signal;
+* quantile-histogram p50/p99 estimates (``serving/latency-p50`` /
+  ``-p99``) as Milliseconds via ``telemetry.quantile_from_buckets`` —
+  the latency-alarm substrate, same estimator as ``/metrics`` and
+  ``log-summary``;
 * the legacy ``log['timer']`` dict (when a task log is passed) exactly
   as before, so existing dashboards keep working.
 
@@ -83,6 +87,15 @@ def snapshot_metric_data(snap: Optional[dict] = None,
     hists = snap.get("hists") or {}
     for name, h in sorted(hists.items()):
         add(f"{name}-total", h["total"], "Seconds")
+    # quantile histograms (serving/latency, PR 9): publish the p50/p99
+    # estimates through the one shared estimator so a CloudWatch latency
+    # alarm reads the same number /metrics and log-summary report —
+    # Milliseconds, the unit CloudWatch latency dashboards expect
+    for name, h in sorted((snap.get("qhists") or {}).items()):
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            value = telemetry.quantile_from_buckets(h, q)
+            if value is not None:
+                add(f"{name}-{label}", value * 1000.0, "Milliseconds")
     totals = {p: hists[p]["total"] for p in STALL_PHASES if p in hists}
     window = sum(totals.values())
     if window > 0:

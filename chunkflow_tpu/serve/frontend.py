@@ -466,6 +466,15 @@ class ServingService(CoordinationService):
                 latency, 0.5)
             stats["latency_p99_s"] = telemetry.quantile_from_buckets(
                 latency, 0.99)
+        # the SLO view of the same traffic (core/slo.py): firing alert
+        # names ride the /serving payload so a serving dashboard shows
+        # "out of spec" next to the raw counters; full burn-rate /
+        # budget detail lives on the sibling /alerts route
+        from chunkflow_tpu.core import slo
+
+        evaluator = slo.current()
+        if evaluator is not None:
+            stats["slo_firing"] = evaluator.firing()
         return stats
 
     # -- the request path ----------------------------------------------

@@ -161,6 +161,19 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py storage_throughput --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "storage throughput gate"
 
+# --- slo overhead gate --------------------------------------------------------
+# Time-series sampler + burn-rate evaluator on-vs-off over the e2e
+# scheduled workload (docs/observability.md "SLO view"): the SLO plane
+# must cost < 2% wall-clock on top of plain telemetry (reported as
+# gate_pass); the process only fails past 10% (sampling work landed on
+# the per-task hot path), so shared-box noise cannot redden CI. The on
+# leg also asserts the plane actually sampled and that a healthy
+# workload fires no alert.
+echo "== slo overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py slo_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "slo overhead gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

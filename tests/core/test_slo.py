@@ -1,0 +1,406 @@
+"""SLO plane units (ISSUE 12): burn-rate math on synthetic traffic,
+edge-triggered alerting, config loading/overrides, page-alert profiler
+capture through the cooldown, kill switches, and the time-series tick
+wiring."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from chunkflow_tpu.core import slo, telemetry
+
+
+@pytest.fixture
+def clean(monkeypatch):
+    for var in ("CHUNKFLOW_TELEMETRY", "CHUNKFLOW_SLO",
+                "CHUNKFLOW_TS_INTERVAL", "CHUNKFLOW_TS_POINTS"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    yield monkeypatch
+    telemetry.reset()
+
+
+class SyntheticTraffic:
+    """A fake clock + registry source: tests drive time and counters by
+    hand, so burn-rate math is asserted on exact numbers."""
+
+    def __init__(self):
+        self.t = 1000.0
+        self.counters = {}
+        self.qhists = {}
+
+    def clock(self):
+        return self.t
+
+    def source(self):
+        return {"counters": dict(self.counters),
+                "qhists": {k: dict(v) for k, v in self.qhists.items()}}
+
+    def advance(self, dt, **deltas):
+        self.t += dt
+        for name, n in deltas.items():
+            key = name.replace("__", "/")
+            self.counters[key] = self.counters.get(key, 0) + n
+
+
+def make_evaluator(traffic, target=0.9, short_s=2.0, long_s=10.0,
+                   burn=2.0, severity="page", period_s=120.0):
+    obj = slo.Objective("availability", target=target,
+                        total=("serving/requests",),
+                        bad=("serving/errors",))
+    rule = slo.BurnRule("fast", short_s=short_s, long_s=long_s,
+                        burn=burn, severity=severity)
+    return slo.SLOEvaluator(objectives=[obj], rules=[rule],
+                            period_s=period_s, clock=traffic.clock,
+                            source=traffic.source)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math + alert edges
+# ---------------------------------------------------------------------------
+def test_healthy_traffic_never_fires(clean):
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic)
+    alerts = []
+    for _ in range(30):
+        traffic.advance(1.0, serving__requests=10)
+        alerts += ev.tick()
+    assert alerts == []
+    assert ev.firing() == []
+    status = ev.status()["objectives"][0]
+    assert status["burn_rate"] == 0.0
+    assert status["budget_remaining"] == 1.0
+
+
+def test_regression_fires_exactly_once_with_attributes(clean):
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic, target=0.9, burn=2.0)
+    for _ in range(15):
+        traffic.advance(1.0, serving__requests=10)
+        ev.tick()
+    alerts = []
+    # 50% errors: bad_frac 0.5 / budget 0.1 = burn 5 >= 2 on both
+    # windows once the long window accumulates enough bad share
+    for _ in range(10):
+        traffic.advance(1.0, serving__requests=10, serving__errors=5)
+        alerts += ev.tick()
+    assert len(alerts) == 1  # edge-triggered: one event, not one/tick
+    alert = alerts[0]
+    assert alert["alert"] == "availability:fast"
+    assert alert["severity"] == "page"
+    assert alert["burn_short"] >= 2.0
+    assert alert["burn_long"] >= 2.0
+    assert alert["budget_remaining"] < 1.0
+    assert ev.firing() == ["availability:fast"]
+    # counters + the firing gauge reached the registry
+    snap = telemetry.snapshot()
+    assert snap["counters"]["slo/alerts"] == 1
+    assert snap["gauges"]["slo/availability/firing"] == 1.0
+
+
+def test_alert_resolves_and_rearms(clean):
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic, target=0.9, short_s=2.0, long_s=6.0,
+                        burn=2.0)
+    for _ in range(10):
+        traffic.advance(1.0, serving__requests=10)
+        ev.tick()
+    fired = []
+    for _ in range(6):
+        traffic.advance(1.0, serving__requests=10, serving__errors=5)
+        fired += ev.tick()
+    assert len(fired) == 1
+    # clean traffic drains the short window first, then the long one
+    for _ in range(10):
+        traffic.advance(1.0, serving__requests=10)
+        ev.tick()
+    assert ev.firing() == []
+    snap = telemetry.snapshot()
+    assert snap["counters"]["slo/alerts_resolved"] == 1
+    assert snap["gauges"]["slo/availability/firing"] == 0.0
+    # a NEW regression re-fires (the pair re-armed at resolve)
+    again = []
+    for _ in range(6):
+        traffic.advance(1.0, serving__requests=10, serving__errors=5)
+        again += ev.tick()
+    assert len(again) == 1
+
+
+def test_short_window_gates_stale_regressions(clean):
+    """Multi-window contract: a burst that ended longer than short_s
+    ago must NOT page, even while the long window still remembers it."""
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic, target=0.9, short_s=2.0, long_s=30.0,
+                        burn=2.0)
+    traffic.advance(1.0, serving__requests=10)
+    ev.tick()
+    # a 2-second error burst...
+    fired = []
+    for _ in range(2):
+        traffic.advance(1.0, serving__requests=10, serving__errors=8)
+        fired += ev.tick()
+    assert fired  # burning NOW: pages
+    # ...then 10 clean seconds: long window still sees the burst, the
+    # short window does not -> resolved, and it stays resolved
+    for _ in range(10):
+        traffic.advance(1.0, serving__requests=10)
+        ev.tick()
+    assert ev.firing() == []
+    status = ev.status()["objectives"][0]
+    assert status["rules"][0]["burn_long"] > 0  # memory is still there
+
+
+def test_no_traffic_burns_nothing(clean):
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic)
+    for _ in range(20):
+        traffic.advance(1.0)  # no requests at all
+        assert ev.tick() == []
+    assert ev.status()["objectives"][0]["budget_remaining"] == 1.0
+
+
+def test_latency_objective_counts_buckets_above_threshold(clean):
+    traffic = SyntheticTraffic()
+    obj = slo.Objective("latency", target=0.9, kind="latency",
+                        qhist="serving/latency", threshold_s=0.05)
+    rule = slo.BurnRule("fast", short_s=2.0, long_s=6.0, burn=2.0)
+    ev = slo.SLOEvaluator(objectives=[obj], rules=[rule], period_s=120.0,
+                          clock=traffic.clock, source=traffic.source)
+
+    def observe(n_fast, n_slow):
+        h = traffic.qhists.setdefault("serving/latency", {
+            "count": 0,
+            "buckets": [0] * (len(telemetry.QUANTILE_BOUNDS) + 1),
+        })
+        h["count"] += n_fast + n_slow
+        buckets = list(h["buckets"])
+        buckets[3] += n_fast   # 0.01 s <= 0.05 threshold: good
+        buckets[8] += n_slow   # 0.5 s  >  0.05 threshold: bad
+        h["buckets"] = buckets
+
+    for _ in range(5):
+        traffic.advance(1.0)
+        observe(10, 0)
+        assert ev.tick() == []
+    fired = []
+    for _ in range(6):
+        traffic.advance(1.0)
+        observe(5, 5)  # half the requests blow the latency threshold
+        fired += ev.tick()
+    assert len(fired) == 1
+    assert fired[0]["objective"] == "latency"
+
+
+def test_page_alert_triggers_one_capture_cooldown_blocks_second(
+    clean, tmp_path, monkeypatch
+):
+    """ISSUE 12 acceptance (capture half): the first page-severity
+    alert triggers exactly one bounded profiler capture through the
+    PR 8 cooldown machinery; a second alert inside the cooldown
+    triggers none."""
+    from chunkflow_tpu.core import profiling
+
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_ON_ANOMALY", "1")
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_SECONDS", "0.1")
+    monkeypatch.setenv("CHUNKFLOW_PROFILE_COOLDOWN", "600")
+    telemetry.configure(str(tmp_path))
+    traffic = SyntheticTraffic()
+    obj_a = slo.Objective("availability", target=0.9,
+                          total=("serving/requests",),
+                          bad=("serving/errors",))
+    obj_b = slo.Objective("deadline", target=0.9,
+                          total=("serving/requests",),
+                          bad=("serving/deadline_missed",))
+    rule = slo.BurnRule("fast", short_s=2.0, long_s=6.0, burn=2.0,
+                        severity="page")
+    ev = slo.SLOEvaluator(objectives=[obj_a, obj_b], rules=[rule],
+                          period_s=120.0, clock=traffic.clock,
+                          source=traffic.source)
+    traffic.advance(1.0, serving__requests=10)
+    ev.tick()
+    # first regression: availability pages -> one capture
+    for _ in range(4):
+        traffic.advance(1.0, serving__requests=10, serving__errors=8)
+        ev.tick()
+    profiling.wait_for_captures()
+    captures = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("profile-slo-"))
+    assert len(captures) == 1 and "availability" in captures[0]
+    # second alert (different objective) inside the cooldown: no capture
+    for _ in range(4):
+        traffic.advance(1.0, serving__requests=10,
+                        serving__deadline_missed=8)
+        ev.tick()
+    profiling.wait_for_captures()
+    assert "deadline:fast" in ev.firing()
+    captures = [p.name for p in tmp_path.iterdir()
+                if p.name.startswith("profile-slo-")]
+    assert len(captures) == 1
+    assert telemetry.snapshot()["counters"].get("profile/captures") == 1
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+SLO_TOML = """
+period_s = 240
+scale = 0.5
+[objective.availability]
+target = 0.95
+[objective.storage_hit]
+enabled = false
+[objective.custom]
+total = ["serving/requests"]
+bad = ["serving/oom"]
+target = 0.99
+[rule.fast]
+short_s = 4
+long_s = 16
+burn = 3.0
+severity = "page"
+[rule.slow]
+enabled = false
+"""
+
+
+def test_minimal_toml_parser_shapes():
+    parsed = slo._parse_toml_minimal(
+        'a = 1\nb = 2.5\nc = "x"  # comment\nd = true\n'
+        '[s.t]\ne = ["p", "q"]\n')
+    assert parsed["a"] == 1 and parsed["b"] == 2.5 and parsed["c"] == "x"
+    assert parsed["d"] is True
+    assert parsed["s"]["t"]["e"] == ["p", "q"]
+    with pytest.raises(ValueError):
+        slo._parse_toml_minimal("not a key value line\n")
+
+
+def test_config_file_overrides_defaults(clean, tmp_path):
+    path = tmp_path / "slo.toml"
+    path.write_text(SLO_TOML)
+    config = slo.load_slo_config(str(path), pyproject="/nonexistent")
+    ev = slo.evaluator_from_config(config)
+    names = [o.name for o in ev.objectives]
+    assert "storage_hit" not in names          # disabled
+    assert "custom" in names                   # config-only objective
+    avail = next(o for o in ev.objectives if o.name == "availability")
+    assert avail.target == 0.95                # overridden
+    latency = next(o for o in ev.objectives if o.name == "latency")
+    assert latency.target == 0.99              # untouched default
+    assert [r.name for r in ev.rules] == ["fast"]  # slow disabled
+    fast = ev.rules[0]
+    # scale=0.5 compresses windows AND the period
+    assert fast.short_s == pytest.approx(2.0)
+    assert fast.long_s == pytest.approx(8.0)
+    assert ev.period_s == pytest.approx(120.0)
+
+
+def test_pyproject_section_applies_and_file_wins(clean, tmp_path):
+    pyproject = tmp_path / "pyproject.toml"
+    pyproject.write_text(
+        "[tool.chunkflow.slo]\nperiod_s = 100\n"
+        "[tool.chunkflow.slo.objective.availability]\ntarget = 0.5\n")
+    config = slo.load_slo_config(None, pyproject=str(pyproject))
+    assert config["period_s"] == 100
+    assert config["objective"]["availability"]["target"] == 0.5
+    override = tmp_path / "slo.toml"
+    override.write_text("[objective.availability]\ntarget = 0.75\n")
+    config = slo.load_slo_config(str(override), pyproject=str(pyproject))
+    assert config["period_s"] == 100              # pyproject survives
+    assert config["objective"]["availability"]["target"] == 0.75
+
+
+def test_malformed_config_raises(clean, tmp_path):
+    bad = tmp_path / "bad.toml"
+    bad.write_text("this is not toml at all\n")
+    with pytest.raises(ValueError):
+        slo.load_slo_config(str(bad), pyproject="/nonexistent")
+
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        slo.Objective("x", target=1.5)
+    with pytest.raises(ValueError):
+        slo.Objective("x", target=0.9, kind="latency")  # no qhist
+    with pytest.raises(ValueError):
+        slo.BurnRule("x", short_s=10.0, long_s=5.0, burn=1.0)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + kill switches
+# ---------------------------------------------------------------------------
+def test_start_slo_rides_the_timeseries_tick(clean, tmp_path, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TS_INTERVAL", "0.05")
+    telemetry.configure(str(tmp_path))
+    ev = slo.start_slo(pyproject="/nonexistent")
+    assert ev is not None and slo.current() is ev
+    assert slo.start_slo() is ev  # idempotent
+    assert telemetry.timeseries_running()
+    for _ in range(8):
+        telemetry.inc("serving/requests")
+        time.sleep(0.05)
+    assert len(ev._samples) >= 2  # the sampler thread ticked it
+    telemetry.reset()  # reset hook tears the evaluator down
+    assert slo.current() is None
+    assert not telemetry.timeseries_running()
+
+
+def test_kill_switches_create_nothing(clean, monkeypatch, tmp_path):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    assert not slo.slo_enabled()
+    assert slo.start_slo() is None
+    assert telemetry.start_timeseries() is None
+    assert not any(t.name == "chunkflow-timeseries"
+                   for t in threading.enumerate())
+    assert telemetry.timeseries() == {}
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY")
+    monkeypatch.setenv("CHUNKFLOW_SLO", "0")
+    assert slo.start_slo() is None  # evaluator off, telemetry may run
+
+
+def test_alert_events_reach_the_jsonl_stream(clean, tmp_path):
+    telemetry.configure(str(tmp_path))
+    traffic = SyntheticTraffic()
+    ev = make_evaluator(traffic, target=0.9, short_s=2.0, long_s=6.0)
+    traffic.advance(1.0, serving__requests=10)
+    ev.tick()
+    for _ in range(6):
+        traffic.advance(1.0, serving__requests=10, serving__errors=8)
+        ev.tick()
+    for _ in range(10):
+        traffic.advance(1.0, serving__requests=10)
+        ev.tick()
+    telemetry.flush()
+    path = telemetry.configured_path()
+    events = [json.loads(line) for line in open(path)]
+    alerts = [e for e in events if e.get("kind") == "alert"]
+    states = [e.get("state") for e in alerts]
+    assert states == ["firing", "resolved"]
+    assert alerts[0]["alert"] == "availability:fast"
+    assert alerts[0]["burn_short"] >= 2.0
+    assert "worker" in alerts[0]  # fleet-stamped like every event
+
+
+def test_slo_plane_is_graftlint_clean():
+    """ISSUE 12 satellite: GL001-GL014 clean over core/slo.py and the
+    reworked telemetry/profiling modules, pinned in-suite so a future
+    baseline regeneration cannot quietly grandfather a finding here."""
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        [
+            "chunkflow_tpu/core/slo.py",
+            "chunkflow_tpu/core/telemetry.py",
+            "chunkflow_tpu/core/profiling.py",
+        ],
+        config, repo_root=repo_root,
+    )
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]

@@ -318,3 +318,196 @@ def test_quantile_histogram_respects_kill_switch(monkeypatch):
     monkeypatch.delenv("CHUNKFLOW_TELEMETRY")
     telemetry.reset()
     assert "qhists" not in telemetry.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# quantile_from_buckets edge cases (ISSUE 12: it feeds alerting now)
+# ---------------------------------------------------------------------------
+def test_quantile_from_buckets_empty_and_none_shapes():
+    qfb = telemetry.quantile_from_buckets
+    assert qfb({}, 0.5) is None                      # empty dict
+    assert qfb({"count": 0, "buckets": []}, 0.5) is None
+    assert qfb({"count": 5, "buckets": None}, 0.5) is None  # None buckets
+    # count claims samples but the bucket list is empty: no estimate,
+    # not an IndexError — a torn snapshot must not crash alerting
+    assert qfb({"count": 5, "buckets": []}, 0.99) is None
+
+
+def test_quantile_from_buckets_q0_and_q1():
+    buckets = [0] * (len(telemetry.QUANTILE_BOUNDS) + 1)
+    buckets[3] = 10  # all samples in (0.005, 0.01]
+    h = {"count": 10, "buckets": buckets}
+    q0 = telemetry.quantile_from_buckets(h, 0.0)
+    q1 = telemetry.quantile_from_buckets(h, 1.0)
+    # both land inside the one occupied bucket, ordered
+    assert 0.005 <= q0 <= 0.01
+    assert 0.005 <= q1 <= 0.01
+    assert q0 <= q1
+    assert q1 == pytest.approx(0.01)  # q=1 is the bucket's upper bound
+
+
+def test_quantile_from_buckets_single_bucket_and_overflow_only():
+    bounds = telemetry.QUANTILE_BOUNDS
+    single = [0] * (len(bounds) + 1)
+    single[0] = 7  # everything under the first bound
+    h = {"count": 7, "buckets": single}
+    for q in (0.01, 0.5, 0.99):
+        est = telemetry.quantile_from_buckets(h, q)
+        assert 0.0 <= est <= bounds[0]
+    overflow = [0] * (len(bounds) + 1)
+    overflow[-1] = 3  # only samples past the largest tracked bound
+    h = {"count": 3, "buckets": overflow}
+    # the estimate saturates at the largest bound instead of inventing
+    # a number past the tracked range
+    assert telemetry.quantile_from_buckets(h, 0.5) == bounds[-1]
+
+
+def test_quantile_from_buckets_short_bucket_list():
+    # a stream from an older schema may carry fewer buckets than
+    # bounds: the reader pads conceptually, never IndexErrors
+    h = {"count": 4, "buckets": [4]}
+    est = telemetry.quantile_from_buckets(h, 0.5)
+    assert 0.0 <= est <= telemetry.QUANTILE_BOUNDS[0]
+
+
+# ---------------------------------------------------------------------------
+# rotation generations (ISSUE 12: CHUNKFLOW_TELEMETRY_KEEP)
+# ---------------------------------------------------------------------------
+def _spam_spans(n):
+    for _ in range(n):
+        with telemetry.span("op/rotate"):
+            pass
+
+
+def test_rotation_keeps_configured_generations(tmp_path, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_MAX_MB", "0.001")
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_KEEP", "3")
+    path = telemetry.configure(str(tmp_path))
+    _spam_spans(800)
+    telemetry.flush()
+    base = os.path.basename(path)
+    files = sorted(os.listdir(tmp_path))
+    assert files == [base, f"{base}.1", f"{base}.2"]
+    for name in files:  # every generation is valid JSONL
+        for line in open(tmp_path / name):
+            json.loads(line)
+
+
+def test_rotation_sweeps_stale_generations_when_keep_drops(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_MAX_MB", "0.001")
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_KEEP", "4")
+    path = telemetry.configure(str(tmp_path))
+    _spam_spans(1200)
+    base = os.path.basename(path)
+    assert f"{base}.3" in os.listdir(tmp_path)
+    # KEEP lowered on a live worker: the next rotation sweeps the tail
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_KEEP", "2")
+    _spam_spans(400)
+    files = sorted(os.listdir(tmp_path))
+    assert files == [base, f"{base}.1"]
+
+
+def test_load_telemetry_dir_reads_all_generations_in_order(
+    tmp_path, monkeypatch
+):
+    from chunkflow_tpu.flow.log_summary import load_telemetry_dir
+
+    monkeypatch.setenv("CHUNKFLOW_WORKER_ID", "w-rot")
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_MAX_MB", "0.001")
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY_KEEP", "3")
+    telemetry.configure(str(tmp_path))
+    for i in range(900):
+        telemetry.event("probe", "order/check", seq=i)
+    telemetry.flush()
+    assert len([n for n in os.listdir(tmp_path)
+                if ".jsonl" in n]) == 3  # live + .1 + .2
+    events = load_telemetry_dir(str(tmp_path))
+    seqs = [e["seq"] for e in events if e.get("name") == "order/check"]
+    # every surviving generation was read, oldest first: the tail of
+    # the sequence is contiguous and spans more than the live file
+    assert seqs == list(range(seqs[0], 900))
+    assert len(seqs) > 12  # more events than one capped file holds
+
+
+# ---------------------------------------------------------------------------
+# time-series ring sampler (ISSUE 12)
+# ---------------------------------------------------------------------------
+def test_timeseries_sampler_rates_gauges_quantiles(tmp_path):
+    path = telemetry.configure(str(tmp_path))
+    sampler = telemetry.start_timeseries(interval=60.0)  # manual ticks
+    assert telemetry.start_timeseries() is sampler  # idempotent
+    telemetry.inc("serving/requests", 10)
+    telemetry.gauge("serving/inflight", 3)
+    telemetry.observe_quantile("serving/latency", 0.01)
+    sampler.sample(now=1000.0)
+    telemetry.inc("serving/requests", 20)
+    sampler.sample(now=1002.0)
+    series = telemetry.timeseries()
+    # counter rate against the previous tick: 20 events / 2 s
+    assert series["rate:serving/requests"][-1] == (1002.0, 10.0)
+    assert series["gauge:serving/inflight"][-1][1] == 3.0
+    assert 0.005 <= series["p50:serving/latency"][-1][1] <= 0.01
+    telemetry.flush()
+    events = [json.loads(line) for line in open(path)]
+    ts = [e for e in events if e["kind"] == "timeseries"]
+    assert len(ts) >= 2
+    # the event carries raw cumulative buckets (fleet-summable)
+    assert ts[-1]["qhists"]["serving/latency"]["count"] == 1
+    assert ts[-1]["values"]["gauge:serving/inflight"] == 3.0
+
+
+def test_timeseries_ring_is_bounded():
+    sampler = telemetry.start_timeseries(interval=60.0, points=5)
+    telemetry.inc("x/count")
+    for i in range(20):
+        sampler.sample(now=1000.0 + i)
+    series = telemetry.timeseries()
+    assert len(series["rate:x/count"]) == 5  # ring, not a log
+    assert series["rate:x/count"][-1][0] == 1019.0
+
+
+def test_timeseries_knobs_and_kill_switch(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TS_INTERVAL", "0")
+    assert telemetry.start_timeseries() is None  # interval 0: disabled
+    monkeypatch.setenv("CHUNKFLOW_TS_INTERVAL", "2.5")
+    monkeypatch.setenv("CHUNKFLOW_TS_POINTS", "77")
+    assert telemetry.ts_interval() == 2.5
+    assert telemetry.ts_points() == 77
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    assert telemetry.start_timeseries() is None
+    assert not any(t.name == "chunkflow-timeseries"
+                   for t in threading.enumerate())
+
+
+def test_timeseries_tick_hooks_run_and_clear_on_reset():
+    ticks = []
+    sampler = telemetry.start_timeseries(interval=60.0)
+    telemetry.add_tick_hook(ticks.append)
+    telemetry.add_tick_hook(ticks.append)  # idempotent by identity
+    telemetry.inc("x/count")
+    sampler.sample(now=1000.0)
+    assert ticks == [1000.0]
+
+    def explode(now):
+        raise RuntimeError("hook down")
+
+    telemetry.add_tick_hook(explode)  # a raising hook never kills a tick
+    sampler.sample(now=1001.0)
+    assert ticks == [1000.0, 1001.0]
+    telemetry.reset()
+    assert not telemetry.timeseries_running()
+    sampler2 = telemetry.start_timeseries(interval=60.0)
+    telemetry.inc("x/count")
+    sampler2.sample(now=2000.0)
+    assert ticks == [1000.0, 1001.0]  # reset cleared the hooks
+
+
+def test_flush_takes_a_final_sample(tmp_path):
+    path = telemetry.configure(str(tmp_path))
+    telemetry.start_timeseries(interval=3600.0)  # would never self-tick
+    telemetry.inc("serving/requests", 4)
+    telemetry.flush()
+    events = [json.loads(line) for line in open(path)]
+    assert any(e["kind"] == "timeseries" for e in events)

@@ -131,7 +131,7 @@ def test_cli_fleet_requires_metrics_dir(tmp_path):
         main, ["log-summary", "--log-dir", str(tmp_path), "--fleet"]
     )
     assert result.exit_code != 0
-    assert "--fleet/--trace-id needs --metrics-dir" in result.output
+    assert "--fleet/--trace-id/--slo needs --metrics-dir" in result.output
 
 
 # ---------------------------------------------------------------------------
@@ -330,3 +330,64 @@ def test_cloud_watch_batches_over_twenty():
     cloud_watch.execute(client=client)
     assert len(client.calls) >= 2
     assert sum(len(batch) for _, batch in client.calls) >= 25
+
+
+def test_cloud_watch_publishes_quantile_histograms_as_milliseconds():
+    """ISSUE 12 satellite: the PR 9 quantile histograms (serving
+    p50/p99) go out to CloudWatch as Milliseconds with the worker
+    dimension, through the one shared bucket estimator."""
+    from chunkflow_tpu.plugins.aws import cloud_watch
+
+    for v in [0.004] * 50 + [0.02] * 40 + [0.8] * 10:
+        telemetry.observe_quantile("serving/latency", v)
+    client = FakeCloudWatch()
+    cloud_watch.execute(client=client)
+    data = [d for _, batch in client.calls for d in batch]
+    by_name = {d["MetricName"]: d for d in data}
+    p50 = by_name["serving/latency-p50"]
+    p99 = by_name["serving/latency-p99"]
+    assert p50["Unit"] == "Milliseconds"
+    assert p99["Unit"] == "Milliseconds"
+    # same estimator as /metrics and log-summary, in milliseconds
+    assert p50["Value"] == pytest.approx(
+        telemetry.quantile("serving/latency", 0.5) * 1000.0)
+    assert 2.5 <= p50["Value"] <= 5.0      # (0.0025, 0.005] bucket
+    assert 500.0 <= p99["Value"] <= 1000.0  # (0.5, 1.0] bucket
+    for name in ("serving/latency-p50", "serving/latency-p99"):
+        assert by_name[name]["Dimensions"] == [
+            {"Name": "worker", "Value": telemetry.worker_id()}
+        ]
+
+
+def test_cloud_watch_skips_empty_quantile_histograms(monkeypatch):
+    from chunkflow_tpu.plugins.aws import cloud_watch
+
+    data = cloud_watch.snapshot_metric_data(
+        snap={"counters": {}, "gauges": {}, "hists": {},
+              "qhists": {"serving/latency": {"count": 0, "total": 0.0,
+                                             "buckets": []}}})
+    assert data == []
+
+
+def test_fleet_status_prints_slo_firing(tmp_path, monkeypatch):
+    """ISSUE 12: out-of-spec workers lead with their firing SLO
+    objectives in fleet-status (scraped from chunkflow_slo_*_firing)."""
+    from chunkflow_tpu.flow.cli import main
+    from chunkflow_tpu.parallel import restapi
+    from chunkflow_tpu.parallel.queues import open_queue
+
+    def fake_scrape(endpoint, timeout=1.0):
+        return {"endpoint": f"http://{endpoint}",
+                "healthz": {"worker": "w1", "inflight_leases": 0},
+                "metrics": {}, "dominant_stall": None, "serving": None,
+                "slo_firing": ["availability", "latency"], "error": None}
+
+    monkeypatch.setattr(restapi, "scrape_worker", fake_scrape)
+    qdir = str(tmp_path / "q")
+    open_queue(qdir).send_messages(["0-4_0-4_0-4"])
+    result = CliRunner().invoke(
+        main, ["fleet-status", "-q", qdir, "-w", "127.0.0.1:9"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "SLO-FIRING: availability,latency" in result.output

@@ -176,3 +176,135 @@ def test_log_summary_sweeps_profile_captures(tmp_path, capsys):
     assert "profile-retrace-x-1" in out
     assert "fusion 80%" in out
     assert "profile-empty-2: no trace files" in out
+
+
+# ---------------------------------------------------------------------------
+# SLO view: sparklines + fleet-merged timeseries + the SLO block (ISSUE 12)
+# ---------------------------------------------------------------------------
+def test_sparkline_shapes():
+    assert log_summary.sparkline([]) == ""
+    flat = log_summary.sparkline([(0, 5.0), (1, 5.0), (2, 5.0)])
+    assert len(flat) == 3 and len(set(flat)) == 1  # constant: one glyph
+    ramp = log_summary.sparkline([(i, float(i)) for i in range(8)])
+    assert len(ramp) == 8
+    assert ramp[0] == log_summary._SPARK_BLOCKS[0]
+    assert ramp[-1] == log_summary._SPARK_BLOCKS[-1]
+    wide = log_summary.sparkline([(i, float(i)) for i in range(500)],
+                                 width=40)
+    assert len(wide) == 40  # resampled, not truncated
+
+
+def _ts_event(worker, t, values=None, qhists=None, interval=1.0):
+    return {"kind": "timeseries", "worker": worker, "t": t,
+            "interval_s": interval, "values": values or {},
+            "qhists": qhists or {}}
+
+
+def test_summarize_timeseries_sums_rates_across_workers():
+    events = [
+        _ts_event("w1", 10.2, {"rate:serving/requests": 5.0}),
+        _ts_event("w2", 10.4, {"rate:serving/requests": 7.0}),
+        _ts_event("w1", 11.2, {"rate:serving/requests": 6.0}),
+        _ts_event("w2", 11.4, {"rate:serving/requests": 8.0}),
+    ]
+    merged = log_summary.summarize_timeseries(events)
+    series = merged["series"]["rate:serving/requests"]
+    # fleet rate = sum across workers, per time bin
+    assert [v for _, v in series] == [12.0, 14.0]
+
+
+def test_summarize_timeseries_fleet_p99_from_bucket_deltas():
+    from chunkflow_tpu.core import telemetry
+
+    n = len(telemetry.QUANTILE_BOUNDS) + 1
+
+    def buckets(**at):
+        b = [0] * n
+        for idx, count in at.items():
+            b[int(idx[1:])] = count
+        return b
+
+    # worker 1 serves fast (bucket 3 ~ 10 ms), worker 2 slow (bucket 9
+    # ~ 1 s); cumulative counts grow between ticks
+    events = [
+        _ts_event("w1", 10.0, qhists={"serving/latency": {
+            "count": 10, "buckets": buckets(i3=10)}}),
+        _ts_event("w2", 10.1, qhists={"serving/latency": {
+            "count": 10, "buckets": buckets(i9=10)}}),
+        _ts_event("w1", 11.0, qhists={"serving/latency": {
+            "count": 30, "buckets": buckets(i3=30)}}),
+        _ts_event("w2", 11.1, qhists={"serving/latency": {
+            "count": 30, "buckets": buckets(i9=30)}}),
+    ]
+    merged = log_summary.summarize_timeseries(events)
+    p99 = dict(merged["series"]["fleet_p99:serving/latency"])
+    p50 = dict(merged["series"]["fleet_p50:serving/latency"])
+    # second bin: 20 fast + 20 slow deltas -> p50 mid-range, p99 in the
+    # slow worker's (0.5, 1.0] bucket — only bucket SUMS can say this
+    (bin_t,) = p99.keys()
+    assert 0.5 <= p99[bin_t] <= 1.0
+    assert p50[bin_t] <= 0.5
+
+
+def test_print_slo_block_renders_alerts_state_and_timelines(capsys):
+    events = [
+        {"kind": "alert", "state": "firing", "worker": "w1", "t": 5.0,
+         "alert": "availability:fast", "objective": "availability",
+         "rule": "fast", "severity": "page", "burn_short": 5.0,
+         "burn_long": 3.0, "budget_remaining": 0.4},
+        {"kind": "gauge", "worker": "w1", "t": 6.0,
+         "name": "slo/availability/firing", "value": 1.0},
+        {"kind": "gauge", "worker": "w1", "t": 6.0,
+         "name": "slo/availability/budget_remaining", "value": 0.4},
+        {"kind": "gauge", "worker": "w2", "t": 6.0,
+         "name": "slo/availability/budget_remaining", "value": 0.9},
+        _ts_event("w1", 5.5, {"rate:serving/requests": 5.0}),
+        _ts_event("w1", 6.5, {"rate:serving/requests": 9.0}),
+    ]
+    assert log_summary.print_slo_block(events) is True
+    out = capsys.readouterr().out
+    assert "alerts fired: 1 (0 resolved)" in out
+    assert "availability:fast page" in out
+    assert "burn_short=5" in out and "budget_remaining=0.4" in out
+    # worst (minimum) budget across workers + who is firing
+    assert "objective availability:" in out
+    assert "budget remaining 40.0%" in out
+    assert "FIRING (w1)" in out
+    assert "rate:serving/requests" in out  # a sparkline timeline
+
+
+def test_print_slo_block_quiet_without_slo_plane(capsys):
+    events = [{"kind": "span", "name": "op/x", "t": 1.0, "dur_s": 0.5,
+               "worker": "w1"}]
+    assert log_summary.print_slo_block(events) is False
+    assert capsys.readouterr().out == ""
+
+
+def test_cli_log_summary_slo(tmp_path, capsys):
+    """`log-summary --slo` over a real recorded stream — and the stream
+    survives the recording process: only JSONL is read."""
+    from click.testing import CliRunner
+
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.flow.cli import main
+
+    d = tmp_path / "metrics"
+    telemetry.reset()
+    telemetry.configure(str(d))
+    sampler = telemetry.start_timeseries(interval=3600.0)
+    telemetry.inc("serving/requests", 10)
+    sampler.sample(now=100.0)
+    telemetry.inc("serving/requests", 30)
+    sampler.sample(now=101.0)
+    telemetry.event("alert", "slo/availability", state="firing",
+                    alert="availability:fast", objective="availability",
+                    rule="fast", severity="page", burn_short=9.0,
+                    burn_long=4.0, budget_remaining=0.2)
+    telemetry.flush()
+    telemetry.reset()
+    result = CliRunner().invoke(
+        main, ["log-summary", "--metrics-dir", str(d), "--slo"])
+    assert result.exit_code == 0, result.output
+    assert "alerts fired: 1" in result.output
+    assert "availability:fast page" in result.output
+    assert "rate:serving/requests" in result.output

@@ -959,3 +959,58 @@ def test_fleet_static_mode_bit_identical_run(tmp_path, monkeypatch):
     for name in reference:
         assert np.array_equal(outputs[name], reference[name]), name
     assert open_queue(qdir).stats()["pending"] == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO annotation on scale/hold decisions (ISSUE 12 — annotation only)
+# ---------------------------------------------------------------------------
+def _slo_firing_scrape(firing, phase=None, share=0.0):
+    metrics = {f"chunkflow_slo_{name}_firing": 1.0 for name in firing}
+    metrics["chunkflow_slo_availability_burn_rate"] = 20.0
+
+    def scrape(endpoint):
+        return {"endpoint": endpoint, "healthz": {"inflight_leases": 1},
+                "metrics": metrics,
+                "dominant_stall": ({"phase": phase, "share": share}
+                                   if phase else None),
+                "slo_firing": sorted(firing), "error": None}
+    return scrape
+
+
+def test_hold_events_annotated_with_firing_alerts(tmp_path):
+    """A scale-up HOLD while SLO alerts fire carries the firing
+    objective names — annotation only (no policy change in this PR),
+    but the ops timeline shows what was out of spec at the decision."""
+    sup = make_supervisor(
+        tmp_path, [IDLE, DEEP],
+        scrape=_slo_firing_scrape({"availability", "latency"},
+                                  phase="scheduler/write", share=0.8))
+    for _ in range(3):
+        sup.step()
+    assert sup.target == 1  # storage-bound: held
+    holds = [e for e in _fleet_events(sup) if e["name"] == "fleet/hold"]
+    assert holds
+    assert holds[-1]["slo_firing"] == ["availability", "latency"]
+
+
+def test_scale_events_annotated_with_firing_alerts(tmp_path):
+    sup = make_supervisor(
+        tmp_path, [IDLE, DEEP],
+        scrape=_slo_firing_scrape({"latency"}, phase="pipeline/compute",
+                                  share=0.9))
+    sup.step()  # spawn the min worker
+    sup.step()  # compute-bound + deep queue -> scale up
+    assert sup.target == 2
+    scales = [e for e in _fleet_events(sup)
+              if e["name"] == "fleet/scale"]
+    assert scales and scales[-1]["direction"] == "up"
+    assert scales[-1]["slo_firing"] == ["latency"]
+
+
+def test_decisions_without_firing_alerts_stay_unannotated(tmp_path):
+    sup = make_supervisor(tmp_path, [DEEP])
+    sup.step()
+    scales = [e for e in _fleet_events(sup)
+              if e["name"] == "fleet/scale"]
+    assert scales
+    assert "slo_firing" not in scales[-1]  # no noise on clean fleets

@@ -317,3 +317,79 @@ def test_shutdown_server_joins_listener_thread(clean_telemetry):
     shutdown_server(server)
     assert not thread.is_alive()
     shutdown_server(None)  # telemetry-off exporter returns None: no-op
+
+
+# ---------------------------------------------------------------------------
+# /alerts route + SLO gauges (ISSUE 12)
+# ---------------------------------------------------------------------------
+def test_alerts_route_without_evaluator(clean_telemetry):
+    svc = CoordinationService()
+    status, payload = svc.handle("GET", "/alerts")
+    assert status == 200
+    assert payload["enabled"] is False
+    assert payload["firing"] == [] and payload["objectives"] == []
+
+
+def test_alerts_route_reports_burn_and_firing(clean_telemetry):
+    from chunkflow_tpu.core import slo
+
+    class Clock:
+        t = 1000.0
+
+    traffic = {"serving/requests": 0.0, "serving/errors": 0.0}
+    ev = slo.SLOEvaluator(
+        objectives=[slo.Objective("availability", target=0.9,
+                                  total=("serving/requests",),
+                                  bad=("serving/errors",))],
+        rules=[slo.BurnRule("fast", short_s=2.0, long_s=6.0, burn=2.0,
+                            severity="page")],
+        period_s=120.0, clock=lambda: Clock.t,
+        source=lambda: {"counters": dict(traffic), "qhists": {}},
+    )
+    slo._EVALUATOR = ev
+    try:
+        svc = CoordinationService()
+        for _ in range(8):
+            Clock.t += 1.0
+            traffic["serving/requests"] += 10
+            traffic["serving/errors"] += 8
+            ev.tick()
+        status, payload = svc.handle("GET", "/alerts")
+        assert status == 200 and payload["enabled"] is True
+        assert payload["firing"] == ["availability:fast"]
+        obj = payload["objectives"][0]
+        assert obj["name"] == "availability"
+        assert obj["burn_rate"] >= 2.0
+        assert obj["budget_remaining"] < 1.0
+        assert obj["rules"][0]["firing"] is True
+        # the same state renders as chunkflow_slo_* gauges on /metrics
+        from chunkflow_tpu.parallel.restapi import firing_alerts
+
+        metrics = parse_prometheus(render_prometheus())
+        assert metrics["chunkflow_slo_availability_firing"] == 1.0
+        assert metrics["chunkflow_slo_availability_burn_rate"] >= 2.0
+        assert firing_alerts(metrics) == ["availability"]
+    finally:
+        slo._EVALUATOR = None
+
+
+def test_alerts_route_gone_under_kill_switch(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    svc = CoordinationService()
+    status, payload = svc.handle("GET", "/alerts")
+    assert status == 404
+
+
+def test_firing_alerts_parses_only_firing_gauges():
+    from chunkflow_tpu.parallel.restapi import firing_alerts
+
+    metrics = {
+        "chunkflow_slo_availability_firing": 1.0,
+        "chunkflow_slo_latency_firing": 0.0,
+        "chunkflow_slo_deadline_firing": 1.0,
+        "chunkflow_slo_latency_burn_rate": 99.0,  # not a firing gauge
+        "chunkflow_other_total": 1.0,
+    }
+    assert firing_alerts(metrics) == ["availability", "deadline"]
+    assert firing_alerts({}) == []
+    assert firing_alerts(None) == []

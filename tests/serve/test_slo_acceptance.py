@@ -1,0 +1,343 @@
+"""ISSUE 12 end-to-end SLO acceptance: a served workload with an
+injected regression drives the fast-burn window over threshold —
+exactly one alert event with correct burn/budget attributes, reported
+by /alerts live AND by log-summary --slo from merged JSONL alone after
+the worker is SIGKILLed; one profiler capture fires and a second alert
+inside the cooldown triggers none; a healthy run of the same workload
+fires nothing; CHUNKFLOW_TELEMETRY=0 creates no sampler thread, no
+events, and no /alerts route."""
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core import slo, telemetry
+from chunkflow_tpu.inference import Inferencer
+from chunkflow_tpu.serve.frontend import LocalBackend, ServingService
+from chunkflow_tpu.testing import chaos
+
+
+@pytest.fixture
+def clean(monkeypatch):
+    for var in ("CHUNKFLOW_TELEMETRY", "CHUNKFLOW_SLO", "CHUNKFLOW_SERVE",
+                "CHUNKFLOW_TS_INTERVAL", "CHUNKFLOW_CHAOS",
+                "CHUNKFLOW_SCHED_MEM_GB"):
+        monkeypatch.delenv(var, raising=False)
+    telemetry.reset()
+    chaos.reset()
+    yield monkeypatch
+    chaos.reset()
+    telemetry.reset()
+
+
+def make_inferencer():
+    return Inferencer(
+        input_patch_size=(4, 16, 16),
+        output_patch_overlap=(2, 8, 8),
+        num_output_channels=3,
+        framework="identity",
+        batch_size=4,
+        crop_output_margin=False,
+    )
+
+
+def infer_body(arr, deadline_s=20.0):
+    return json.dumps({
+        "shape": list(arr.shape),
+        "dtype": arr.dtype.name,
+        "data_b64": base64.b64encode(
+            np.ascontiguousarray(arr).tobytes()).decode(),
+        "deadline_s": deadline_s,
+    }).encode()
+
+
+#: fast-burn-only test config: tiny windows so days compress to a
+#: second, only the serving objectives armed (the dead_letter objective
+#: would double-fire on the injected failures — this test asserts
+#: EXACTLY one alert per regression)
+SLO_TOML = """
+period_s = 600
+[objective.availability]
+target = 0.9
+[objective.deadline]
+target = 0.9
+[objective.latency]
+enabled = false
+[objective.dead_letter]
+enabled = false
+[objective.storage_hit]
+enabled = false
+[rule.fast]
+short_s = 0.4
+long_s = 1.6
+burn = 2.0
+severity = "page"
+[rule.slow]
+enabled = false
+"""
+
+
+def write_config(tmp_path):
+    path = tmp_path / "slo.toml"
+    path.write_text(SLO_TOML)
+    return str(path)
+
+
+def drive_requests(service, body, n, pause=0.04):
+    statuses = []
+    for _ in range(n):
+        status, _payload = service.handle("POST", "/infer", body)
+        statuses.append(status)
+        time.sleep(pause)
+    return statuses
+
+
+def test_regression_fires_one_alert_one_capture_cooldown_blocks_second(
+    clean, tmp_path
+):
+    """The core acceptance run: chaos-injected compute failures burn
+    the availability budget -> exactly one page alert with burn/budget
+    attributes, /alerts reports it, one bounded profiler capture lands;
+    a second regression (deadline misses) pages inside the cooldown and
+    captures nothing more."""
+    from chunkflow_tpu.core import profiling
+
+    clean.setenv("CHUNKFLOW_TS_INTERVAL", "0.05")
+    clean.setenv("CHUNKFLOW_PROFILE_ON_ANOMALY", "1")
+    clean.setenv("CHUNKFLOW_PROFILE_SECONDS", "0.1")
+    clean.setenv("CHUNKFLOW_PROFILE_COOLDOWN", "600")
+    metrics_dir = tmp_path / "metrics"
+    telemetry.configure(str(metrics_dir))
+    evaluator = slo.start_slo(write_config(tmp_path),
+                              pyproject="/nonexistent")
+    assert evaluator is not None
+
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1, max_retries=0,
+                           backoff_base=0.01)
+    service = ServingService(backend, default_deadline_s=10.0,
+                             max_body_mb=16)
+    rng = np.random.default_rng(0)
+    arr = (rng.random((4, 16, 16)) * 255).astype(np.uint8)
+    try:
+        # --- phase 1: every compute fails (a poisoned model push) ----
+        chaos.configure("seed=1:rate=1.0:points=serving/compute")
+        deadline = time.time() + 20
+        while time.time() < deadline and not evaluator.firing():
+            status, _ = service.handle("POST", "/infer", infer_body(arr))
+            assert status in (500, 504)
+            time.sleep(0.04)
+        assert evaluator.firing() == ["availability:fast"]
+        status, payload = service.handle("GET", "/alerts")
+        assert status == 200
+        assert payload["firing"] == ["availability:fast"]
+        avail = next(o for o in payload["objectives"]
+                     if o["name"] == "availability")
+        assert avail["burn_rate"] >= 2.0
+        assert avail["budget_remaining"] < 1.0
+        # /serving carries the firing list too
+        assert service.serving_stats()["slo_firing"] == \
+            ["availability:fast"]
+        profiling.wait_for_captures()
+        # exactly one capture, for the paging objective (the capture
+        # sequence number is process-global: other tests bump it)
+        captures = [p.name for p in metrics_dir.iterdir()
+                    if p.name.startswith("profile-slo-")]
+        assert len(captures) == 1, captures
+        assert captures[0].startswith("profile-slo-availability-")
+
+        # --- phase 2: compute healthy again, but deadlines impossible -
+        chaos.reset()
+        deadline = time.time() + 20
+        while time.time() < deadline and \
+                "deadline:fast" not in evaluator.firing():
+            status, _ = service.handle(
+                "POST", "/infer", infer_body(arr, deadline_s=0.001))
+            assert status == 504
+            time.sleep(0.04)
+        assert "deadline:fast" in evaluator.firing()
+        profiling.wait_for_captures()
+        captures = [p.name for p in metrics_dir.iterdir()
+                    if p.name.startswith("profile-slo-")]
+        assert len(captures) == 1, captures  # cooldown blocked #2
+        assert captures[0].startswith("profile-slo-availability-")
+        assert telemetry.snapshot()["counters"]["profile/captures"] == 1
+    finally:
+        chaos.reset()
+        backend.close()
+
+    # exactly one firing alert event per regression, attributes intact
+    telemetry.flush()
+    path = telemetry.configured_path()
+    events = [json.loads(line) for line in open(path)]
+    fired = [e for e in events if e.get("kind") == "alert"
+             and e.get("state") == "firing"]
+    by_alert = {}
+    for e in fired:
+        by_alert.setdefault(e["alert"], []).append(e)
+    assert sorted(by_alert) == ["availability:fast", "deadline:fast"]
+    assert all(len(v) == 1 for v in by_alert.values())
+    first = by_alert["availability:fast"][0]
+    assert first["severity"] == "page"
+    assert first["burn_short"] >= 2.0 and first["burn_long"] >= 2.0
+    assert first["budget_remaining"] < 1.0
+    assert first["target"] == 0.9
+
+
+def test_healthy_run_of_same_workload_fires_nothing(clean, tmp_path):
+    clean.setenv("CHUNKFLOW_TS_INTERVAL", "0.05")
+    metrics_dir = tmp_path / "metrics"
+    telemetry.configure(str(metrics_dir))
+    evaluator = slo.start_slo(write_config(tmp_path),
+                              pyproject="/nonexistent")
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1)
+    service = ServingService(backend, default_deadline_s=30.0,
+                             max_body_mb=16)
+    rng = np.random.default_rng(0)
+    arr = (rng.random((4, 16, 16)) * 255).astype(np.uint8)
+    try:
+        for _ in range(8):
+            status, _ = service.handle("POST", "/infer", infer_body(arr))
+            assert status == 200
+        time.sleep(0.8)  # several evaluation ticks past both windows
+        assert evaluator.firing() == []
+        status, payload = service.handle("GET", "/alerts")
+        assert status == 200 and payload["firing"] == []
+    finally:
+        backend.close()
+    telemetry.flush()
+    events = [json.loads(line)
+              for line in open(telemetry.configured_path())]
+    assert not [e for e in events if e.get("kind") == "alert"]
+    assert [e for e in events if e.get("kind") == "timeseries"]
+
+
+_VICTIM_SCRIPT = r"""
+import base64, json, os, sys, time
+import numpy as np
+from chunkflow_tpu.core import slo, telemetry
+from chunkflow_tpu.inference import Inferencer
+from chunkflow_tpu.serve.frontend import LocalBackend, ServingService
+from chunkflow_tpu.testing import chaos
+
+metrics_dir, cfg = sys.argv[1], sys.argv[2]
+telemetry.configure(metrics_dir)
+evaluator = slo.start_slo(cfg, pyproject="/nonexistent")
+inferencer = Inferencer(
+    input_patch_size=(4, 16, 16), output_patch_overlap=(2, 8, 8),
+    num_output_channels=3, framework="identity", batch_size=4,
+    crop_output_margin=False)
+backend = LocalBackend(inferencer, workers=1, max_retries=0,
+                       backoff_base=0.01)
+service = ServingService(backend, default_deadline_s=10.0)
+chaos.configure("seed=1:rate=1.0:points=serving/compute")
+rng = np.random.default_rng(0)
+arr = (rng.random((4, 16, 16)) * 255).astype(np.uint8)
+body = json.dumps({
+    "shape": list(arr.shape), "dtype": "uint8",
+    "data_b64": base64.b64encode(arr.tobytes()).decode(),
+    "deadline_s": 10.0,
+}).encode()
+deadline = time.time() + 30
+while time.time() < deadline and not evaluator.firing():
+    service.handle("POST", "/infer", body)
+    time.sleep(0.04)
+print("ALERTED" if evaluator.firing() else "NOALERT", flush=True)
+time.sleep(600)  # hold claims + sink open until the SIGKILL lands
+"""
+
+
+def test_alert_survives_worker_sigkill_via_log_summary(clean, tmp_path):
+    """The crash half of the acceptance: the worker process is
+    SIGKILLed (no flush, no atexit) right after alerting — the
+    line-buffered JSONL still carries the alert + timeseries history,
+    and `log-summary --slo` reconstructs the report from the dir
+    alone."""
+    from click.testing import CliRunner
+
+    from chunkflow_tpu.flow.cli import main
+
+    metrics_dir = tmp_path / "metrics"
+    metrics_dir.mkdir()
+    script = tmp_path / "victim.py"
+    script.write_text(_VICTIM_SCRIPT)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "CHUNKFLOW_WORKER_ID": "slo-victim",
+        "CHUNKFLOW_TS_INTERVAL": "0.05",
+        "CHUNKFLOW_PROFILE_ON_ANOMALY": "0",
+        "PYTHONPATH": repo_root + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else ""),
+    })
+    env.pop("XLA_FLAGS", None)  # the 8-device mesh slows child startup
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(metrics_dir),
+         write_config(tmp_path)],
+        env=env, stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        line = ""
+        timer = threading.Timer(120.0, proc.kill)
+        timer.start()
+        try:
+            line = proc.stdout.readline().strip()
+        finally:
+            timer.cancel()
+        assert line == "ALERTED", f"victim said {line!r}"
+        # SIGKILL: nothing unwinds, nothing flushes
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    result = CliRunner().invoke(
+        main, ["log-summary", "--metrics-dir", str(metrics_dir), "--slo"])
+    assert result.exit_code == 0, result.output
+    assert "alerts fired: 1" in result.output
+    assert "availability:fast page" in result.output
+    assert "FIRING (slo-victim)" in result.output
+    assert "rate:serving/requests" in result.output  # sparkline history
+
+
+def test_kill_switch_no_sampler_no_events_no_alerts_route(
+    clean, tmp_path
+):
+    clean.setenv("CHUNKFLOW_TELEMETRY", "0")
+    clean.setenv("CHUNKFLOW_TS_INTERVAL", "0.05")
+    metrics_dir = tmp_path / "off"
+    assert telemetry.configure(str(metrics_dir)) is None
+    assert telemetry.start_timeseries() is None
+    assert slo.start_slo(write_config(tmp_path),
+                         pyproject="/nonexistent") is None
+    assert not any(t.name == "chunkflow-timeseries"
+                   for t in threading.enumerate())
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1)
+    service = ServingService(backend, max_body_mb=16)
+    try:
+        status, _ = service.handle("GET", "/alerts")
+        assert status == 404  # the route does not exist when off
+        rng = np.random.default_rng(0)
+        arr = (rng.random((4, 16, 16)) * 255).astype(np.uint8)
+        status, _ = service.handle("POST", "/infer", infer_body(arr))
+        assert status == 200  # serving itself still works
+    finally:
+        backend.close()
+    assert not metrics_dir.exists()  # an off run leaves no trace

@@ -242,6 +242,44 @@ def test_export_overhead_microbench(tmp_path):
     ), best.get("telemetry_jsonl")
 
 
+def test_slo_overhead_microbench(tmp_path):
+    """The SLO plane (time-series sampler + burn-rate evaluator,
+    ISSUE 12) must be ~free over the e2e_overlap-style workload even at
+    a 0.1 s sampling interval (100x the production default):
+    run_slo_overhead itself raises when the plane fails to run, takes
+    no samples, or fires an alert on the healthy workload; the process
+    hard-fails past 10% overhead. The <2% target rides the JSON line as
+    gate_pass — asserted loosely here (< half the hard gate) because a
+    1-core shared CI box can inflate a sub-millisecond-per-task delta.
+
+    Fresh-subprocess pattern from the other microbench gates: conftest's
+    8-device virtual mesh contaminates in-suite measurement."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "slo_overhead"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] < best["value"]:
+            best = stats
+        if best["gate_pass"]:
+            break
+    assert best["metric"] == "slo_overhead"
+    assert best["value"] < 5.0, best  # half the 10% hard gate
+    assert best["gate_pct"] == 2.0
+    assert best["on_s"] > 0 and best["off_s"] > 0, best
+
+
 def test_cfg_names_unique():
     names = [bench._cfg_name(c) for c in bench.CONFIGS]
     assert len(names) == len(set(names)), names
