@@ -1529,6 +1529,165 @@ def run_serving_throughput(
     }
 
 
+def run_multichip_overlap(
+    n_chunks: int = 3,
+    n_dev: int = 8,
+    rounds: int = 3,
+    step_s: float = 0.03,
+) -> dict:
+    """Unified sharded engine vs the single-device reference path on 8
+    simulated host devices (ISSUE 13, CI gate).
+
+    The engine is a matmul plus a calibrated per-forward-batch "chip
+    step" (a pure_callback that sleeps ``step_s`` — the fixed per-batch
+    step time of a compute-bound chip). On the 1-core CI box the 8
+    virtual CPU devices still execute their shard programs CONCURRENTLY
+    (one runtime thread per device — measured: an 8-way shard_map of
+    0.2 s callbacks completes in ~0.2 s), so the sharded leg's
+    wall-clock honestly reflects the slice's concurrency while total
+    compute stays identical — the same calibrated-latency convention as
+    pipeline_overlap's simulated IO. The single leg runs every forward
+    batch serially; ``CHUNKFLOW_MESH=data=8`` shards them 8 ways, so
+    ideal speedup approaches 8x; the gate is >= 1.3x (reported as
+    ``gate_pass``), hard floor 1.1x.
+
+    Bit-identity is asserted between the legs on every round (the
+    engine contract: forward sharded, reference accumulation replayed),
+    and the sharded program must land in the PR 8 roofline ledger
+    (programs.json) — both reported in the JSON line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.inference import Inferencer, engines
+
+    if len(jax.devices()) < n_dev:
+        raise RuntimeError(
+            f"multichip_overlap needs {n_dev} devices "
+            f"(XLA_FLAGS=--xla_force_host_platform_device_count={n_dev})"
+        )
+
+    telemetry.configure(_bench_metrics_dir())
+
+    pin = (4, 16, 16)
+    features = int(np.prod(pin))
+    rng = np.random.default_rng(0)
+    weights = jnp.asarray(
+        rng.standard_normal((features, features)).astype(np.float32)
+        / np.sqrt(features)
+    )
+
+    def chip_step(x):
+        # the calibrated per-batch device step: identity on the values
+        # (bitwise-deterministic), fixed wall cost
+        time.sleep(step_s)
+        return x
+
+    def apply(params, batch):
+        x = batch.reshape(batch.shape[0], -1)
+        x = jnp.tanh(x @ params)
+        x = jax.pure_callback(
+            chip_step, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+        return x.reshape((batch.shape[0], 1) + pin)
+
+    inferencer = Inferencer(
+        input_patch_size=pin,
+        num_output_channels=1,
+        framework="prebuilt",
+        engine=engines.Engine(
+            params=weights, apply=apply,
+            num_input_channels=1, num_output_channels=1,
+        ),
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    # 32 patches along x, zero overlap -> 16 forward batches of 2 per
+    # chunk: the single leg pays 16 chip steps serially, the 8-way mesh
+    # 2 per chip
+    chunks = [
+        Chunk(rng.random((4, 16, 16 * 32), dtype=np.float32),
+              voxel_offset=(4 * i, 0, 0))
+        for i in range(n_chunks)
+    ]
+
+    mesh_spec = f"data={n_dev}"
+    prev_mesh = os.environ.get("CHUNKFLOW_MESH")
+
+    def leg(spec: str):
+        os.environ["CHUNKFLOW_MESH"] = spec
+        return [np.asarray(inferencer(c).array) for c in chunks]
+
+    try:
+        refs = leg("1")        # warm the single-device program
+        sharded = leg(mesh_spec)  # warm the sharded program
+        for a, b in zip(refs, sharded):
+            if not np.array_equal(a, b):
+                raise RuntimeError(
+                    "multichip bench: sharded output NOT bit-identical "
+                    "to the single-device reference")
+        single_s = sharded_s = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            outs = leg("1")
+            dt = time.perf_counter() - t0
+            single_s = dt if single_s is None else min(single_s, dt)
+            for a, b in zip(refs, outs):
+                if not np.array_equal(a, b):
+                    raise RuntimeError("multichip bench: single-device "
+                                       "round diverged from reference")
+            t0 = time.perf_counter()
+            outs = leg(mesh_spec)
+            dt = time.perf_counter() - t0
+            sharded_s = dt if sharded_s is None else min(sharded_s, dt)
+            for a, b in zip(refs, outs):
+                if not np.array_equal(a, b):
+                    raise RuntimeError(
+                        "multichip bench: sharded round NOT bit-identical "
+                        "to the single-device reference")
+    finally:
+        if prev_mesh is None:
+            os.environ.pop("CHUNKFLOW_MESH", None)
+        else:
+            os.environ["CHUNKFLOW_MESH"] = prev_mesh
+
+    # the sharded program must be in the roofline ledger (PR 8)
+    from chunkflow_tpu.core import profiling
+
+    in_ledger = any(
+        entry.get("family") == "shard" or "shard" in str(entry.get("key"))
+        for entry in profiling.catalog()
+    )
+    telemetry.flush()
+    telemetry.configure(None)
+    if not in_ledger:
+        raise RuntimeError(
+            "multichip bench: sharded program missing from the roofline "
+            "ledger (programs.json)")
+
+    speedup = single_s / sharded_s if sharded_s else 0.0
+    return {
+        "metric": "multichip_overlap",
+        "value": round(speedup, 2),
+        "unit": "x_sharded_vs_single",
+        "single_s": round(single_s, 3),
+        "sharded_s": round(sharded_s, 3),
+        "mesh": mesh_spec,
+        "n_devices": n_dev,
+        "chunks": n_chunks * rounds,
+        "forward_batches_per_chunk": 16,
+        "chip_step_s": step_s,
+        "cache_builds": inferencer._programs.builds,
+        "cache_hits": inferencer._programs.hits,
+        "in_roofline_ledger": in_ledger,
+        "gate_x": 1.3,
+        "gate_pass": speedup >= 1.3,
+        "bit_identical": True,
+    }
+
+
 def run_storage_throughput(
     volume_shape=(64, 256, 256),
     block=(16, 64, 64),
@@ -2119,7 +2278,7 @@ def main() -> int:
         "pipeline_overlap", "telemetry_overhead", "e2e_overlap",
         "resilience_overhead", "export_overhead", "fleet_smoke",
         "serving_throughput", "locksmith_overhead", "storage_throughput",
-        "slo_overhead",
+        "slo_overhead", "multichip_overlap",
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -2128,6 +2287,27 @@ def main() -> int:
         # wedge them).
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        if sys.argv[1] == "multichip_overlap":
+            # the unified sharded engine needs the 8-device virtual CPU
+            # mesh; force it before jax first loads in this process
+            import re as _re
+
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""),
+            ).strip()
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+            result = run_multichip_overlap()
+            _emit(result)
+            # soft gate at the 1.3x target (reported as gate_pass,
+            # asserted slow-marked in tests/test_bench.py); hard floor
+            # at 1.1x — below that the sharded engine lost to the
+            # single-device path outright (bit-identity and the
+            # roofline-ledger presence are asserted inside, raising on
+            # any violation)
+            return 0 if result["value"] >= 1.1 else 4
         if sys.argv[1] == "pipeline_overlap":
             return _emit(run_pipeline_overlap())
         if sys.argv[1] == "e2e_overlap":

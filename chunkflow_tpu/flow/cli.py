@@ -115,6 +115,17 @@ def main(mip, dry_run, verbose, profile_dir, profile_tasks, metrics_dir,
                                 (default 4)
 
     \b
+    Multi-chip mesh (docs/multichip.md):
+      CHUNKFLOW_MESH            unified sharded engine spec for every
+                                inference/serving dispatch: 1 (kill
+                                switch, single-device reference path —
+                                default), auto, data=N (patch-parallel),
+                                y=A or y=A,x=B (chunk sharded in slabs);
+                                every mesh shape is bit-identical to the
+                                single-device path. `inference --mesh`
+                                overrides per command.
+
+    \b
     Fault tolerance (docs/fault_tolerance.md):
       fetch-task-from-queue --max-retries/--lease-renew/--ledger runs
       the worker supervised (contained retries, dead-letter, resume);
@@ -2377,9 +2388,19 @@ def copy_var_cmd(op_name, from_name, to_name):
     "--sharding",
     type=click.Choice(["none", "patch", "spatial", "spatial2d"]),
     default="none",
-    help="multi-chip execution over all local devices: patch-parallel "
-         "(psum merge), spatially-sharded chunk along y (ring halo "
-         "exchange), or a 2D (y, x) device mesh with two-phase halos",
+    help="legacy multi-chip layout names over all local devices; now "
+         "aliases for the unified mesh engine (patch -> data=N, "
+         "spatial -> y=N, spatial2d -> near-square y,x). Prefer --mesh "
+         "/ CHUNKFLOW_MESH (docs/multichip.md)",
+)
+@click.option(
+    "--mesh", "mesh_spec", type=str, default=None,
+    help="unified multi-chip mesh spec (docs/multichip.md): 1 (single "
+         "device), auto, data=N (patch-parallel over N chips), y=A or "
+         "y=A,x=B (chunk sharded in slabs with halo exchange). Every "
+         "shape produces output bit-identical to the single-device "
+         "path. Overrides CHUNKFLOW_MESH; does not compose with the "
+         "legacy --sharding names",
 )
 @cartesian_option(
     "--shape-bucket", default=None,
@@ -2422,9 +2443,9 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
                   num_output_channels, num_input_channels, framework,
                   model_path, weight_path, batch_size, bump, augment,
                   crop_output_margin, mask_myelin_threshold, dtype,
-                  output_dtype, model_variant, sharding, shape_bucket,
-                  blend, async_depth, prefetch_depth, input_chunk_name,
-                  output_chunk_name):
+                  output_dtype, model_variant, sharding, mesh_spec,
+                  shape_bucket, blend, async_depth, prefetch_depth,
+                  input_chunk_name, output_chunk_name):
     """Patch-wise convnet inference with bump-weighted overlap blending."""
     from chunkflow_tpu.inference import Inferencer
 
@@ -2463,6 +2484,7 @@ def inference_cmd(op_name, input_patch_size, output_patch_size,
         output_dtype=output_dtype,
         model_variant=model_variant,
         sharding=sharding,
+        mesh=mesh_spec,
         shape_bucket=shape_bucket,
         blend=blend,
         dry_run=state.dry_run,

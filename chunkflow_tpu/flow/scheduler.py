@@ -325,6 +325,27 @@ class DepthController:
 _END = object()
 
 
+def _note_mesh(inferencer) -> None:
+    """One scheduler/mesh event when the stream's inferencer runs the
+    unified multi-chip engine (parallel/engine.py) — the whole pipeline
+    (H2D staging, device compute, D2H drain) then overlaps across every
+    chip of the slice, and the log-summary reader can attribute the
+    stream's throughput to its mesh (docs/multichip.md)."""
+    getter = getattr(inferencer, "shard_engine", None)
+    if getter is None:
+        return
+    try:
+        engine = getter()
+    except Exception:
+        return  # a malformed CHUNKFLOW_MESH fails at dispatch, loudly
+    if engine is not None:
+        telemetry.event(
+            "scheduler", "mesh",
+            mesh=engine.spec.describe(),
+            devices=engine.spec.n_devices,
+        )
+
+
 def _is_end(item) -> bool:
     return isinstance(item, tuple) and len(item) == 2 and item[0] is _END
 
@@ -521,6 +542,7 @@ def schedule_chunks(
         "prefetch": prefetch_depth, "ring": ring, "inflight": ring,
         "post": post_depth,
     })
+    _note_mesh(inferencer)
     q, thread = _start_pump(chunks, ctl.depths["prefetch"])
     in_flight: deque = deque()
     pool = ThreadPoolExecutor(max_workers=ctl.limits["post"])
@@ -598,6 +620,7 @@ def scheduled_inference_stage(
         ctl = ctl_arg or DepthController(depths={
             "prefetch": prefetch_depth, "ring": ring, "inflight": depth,
         })
+        _note_mesh(inferencer)
         q, thread = _start_pump(stream, ctl.depths["prefetch"])
         staged: deque = deque()     # (task, slot, owned, t0)
         pending: deque = deque()    # (task, device_out, t0)

@@ -67,6 +67,7 @@ class Inferencer:
         model_variant: str = "parity",
         engine=None,
         sharding: str = "none",
+        mesh: Optional[str] = None,
         shape_bucket=None,
         blend: str = "auto",
         dry_run: bool = False,
@@ -108,6 +109,20 @@ class Inferencer:
         if sharding not in ("none", "patch", "spatial", "spatial2d"):
             raise ValueError(f"unknown sharding mode {sharding!r}")
         self.sharding = sharding
+        # Multi-chip mesh spec (docs/multichip.md): an explicit ``mesh``
+        # argument ("data=8" / "y=4,x=2" / "auto") wins over the
+        # CHUNKFLOW_MESH env var, which is re-read per chunk so the
+        # ``CHUNKFLOW_MESH=1`` kill switch restores the single-device
+        # path bit-identically at any moment. The legacy ``sharding``
+        # names map onto the same unified engine (parallel/engine.py).
+        if mesh is not None and sharding != "none":
+            raise ValueError(
+                f"mesh={mesh!r} does not compose with the legacy "
+                f"sharding={sharding!r}; pick one"
+            )
+        self.mesh_spec = mesh
+        self._shard_engines: dict = {}
+        self._fold_mesh_noted = False
         # Optional shape bucketing (SURVEY §7 hard parts): pad every chunk
         # up to multiples of this zyx quantum so ragged edge chunks reuse
         # the same compiled program instead of recompiling per shape.
@@ -145,8 +160,9 @@ class Inferencer:
                 f"sharding='none'"
             )
         self.blend_mode = blend
+        # optional explicit device set for the mesh engine (tests /
+        # multihost bring-up inject a mesh here; its devices are used)
         self._mesh = None
-        self._mesh2d = None
         # one keyed cache for every program family this inferencer builds
         # (scatter/fold/patch/spatial/spatial2d); keys derive from the
         # BUCKETED run shape, so ragged edge chunks that pad into the
@@ -413,165 +429,68 @@ class Inferencer:
         return result[:, : zyx[0], : zyx[1], : zyx[2]]
 
     # ------------------------------------------------------------------
-    def _mesh_or_build(self):
-        if self._mesh is None:
-            from chunkflow_tpu.parallel.distributed import make_mesh
+    def _resolve_shard_spec(self):
+        """The effective mesh spec for this call: legacy ``sharding``
+        names map to fixed layouts over the local devices; otherwise the
+        explicit ``mesh`` argument wins over ``CHUNKFLOW_MESH`` (env is
+        re-read per chunk — the kill switch works mid-stream)."""
+        from chunkflow_tpu.parallel.engine import MeshSpec, parse_mesh_spec
 
-            self._mesh = make_mesh()
-        return self._mesh
-
-    def _run_sharded(self, arr, grid):
-        """Multi-chip execution over all local devices.
-
-        'patch': chunk replicated, patch batches sharded, psum merge
-        (parallel/distributed.py). 'spatial': chunk sharded along y with
-        ring halo/spill exchange (parallel/spatial.py). Programs are built
-        once (jit re-specializes per input shape and caches).
-        """
-        import jax.numpy as jnp
-
-        from chunkflow_tpu.inference.patching import pad_to_batch
-
-        mesh = self._mesh_or_build()
-        n_dev = mesh.devices.size
-
-        if self.sharding == "patch":
-            from chunkflow_tpu.parallel.distributed import (
-                build_sharded_program,
-            )
-
-            sharded_program = self._programs.get(
-                ("patch",),
-                lambda: build_sharded_program(
-                    self._forward,
-                    self.num_input_channels,
-                    self.num_output_channels,
-                    tuple(self.input_patch_size),
-                    tuple(self.output_patch_size),
-                    self.batch_size,
-                    mesh,
-                    bump_map(tuple(self.output_patch_size)),
-                    out_dtype=self.output_dtype,
-                ),
-            )
-            in_starts, out_starts, valid = pad_to_batch(
-                grid, self.batch_size * n_dev
-            )
+        if self.sharding != "none":
             import jax
 
-            if jax.process_count() > 1:
-                # mesh spans hosts: route through the one shared
-                # cross-host recipe (global arrays, cached global params,
-                # checksum consistency guard that fails loudly if two
-                # workers pulled different tasks into one collective)
-                from chunkflow_tpu.parallel.multihost import run_global
+            n = (self._mesh.devices.size if self._mesh is not None
+                 else len(jax.local_devices()))
+            if self.sharding == "patch":
+                return (MeshSpec("data", (n,)) if n > 1
+                        else MeshSpec("single", (1,)))
+            if self.sharding == "spatial":
+                return (MeshSpec("spatial", (n, 1)) if n > 1
+                        else MeshSpec("single", (1,)))
+            # spatial2d: near-square (y, x) factorization, y outer
+            from chunkflow_tpu.parallel.spatial2d import near_square_shape
 
-                out = run_global(
-                    sharded_program, np.asarray(arr), in_starts,
-                    out_starts, valid, self.engine.params, mesh,
-                )
-                return jnp.asarray(out)
-            return sharded_program(
-                arr,
-                jnp.asarray(in_starts),
-                jnp.asarray(out_starts),
-                jnp.asarray(valid),
-                self._device_params,
-            )
+            return (MeshSpec("spatial", near_square_shape(n)) if n > 1
+                    else MeshSpec("single", (1,)))
+        if self.mesh_spec is not None:
+            return parse_mesh_spec(self.mesh_spec)
+        import os as _os
 
-        if self.sharding == "spatial2d":
-            from chunkflow_tpu.parallel.spatial2d import (
-                build_spatial2d_program,
-                make_mesh_2d,
-                pad_chunk_yx,
-                partition_patches_2d,
-                spatial2d_geometry,
-            )
+        return parse_mesh_spec(_os.environ.get("CHUNKFLOW_MESH", "1"))
 
-            if self._mesh2d is None:
-                self._mesh2d = make_mesh_2d(devices=mesh.devices.reshape(-1))
-            mesh2d = self._mesh2d
-            pin2 = tuple(self.input_patch_size)
-            pout2 = tuple(self.output_patch_size)
-            y, x = arr.shape[-2], arr.shape[-1]
-            geometry = spatial2d_geometry(y, x, mesh2d, pin2, pout2)
-            (yslab, hl_y, _, _, padded_y), (xslab, hl_x, _, _, padded_x) = (
-                geometry
-            )
-            # routed through self._forward so TTA applies like every
-            # other sharding mode; cached per slab geometry so
-            # same-shaped chunks reuse one compiled program
-            program = self._programs.get(
-                ("spatial2d", yslab, xslab),
-                lambda: build_spatial2d_program(
-                    self._forward,
-                    self.num_input_channels,
-                    self.num_output_channels,
-                    pin2,
-                    pout2,
-                    self.batch_size,
-                    mesh2d,
-                    bump_map(pout2),
-                    geometry,
-                    out_dtype=self.output_dtype,
-                ),
-            )
-            dev_in, dev_out, dev_valid = partition_patches_2d(
-                grid, mesh2d, yslab, xslab, self.batch_size, hl_y, hl_x
-            )
-            padded = pad_chunk_yx(arr, padded_y, padded_x)
-            result = program(
-                padded,
-                jnp.asarray(dev_in),
-                jnp.asarray(dev_out),
-                jnp.asarray(dev_valid),
-                self._device_params,
-            )
-            return result[:, :, :y, :x]
+    def shard_engine(self):
+        """The unified sharded engine for the resolved mesh spec, or
+        None for the single-device path (the ``CHUNKFLOW_MESH=1`` kill
+        switch). Engines are cached per spec; their programs live in the
+        shared :class:`ProgramCache`, so they get donation, shape-bucket
+        keying and the roofline ledger like every other family."""
+        from chunkflow_tpu.parallel.engine import ShardedEngine
 
-        # spatial sharding: static geometry depends on the slab height
-        from chunkflow_tpu.parallel.spatial import (
-            build_spatial_program,
-            pad_chunk_y,
-            partition_patches,
-            spatial_geometry,
-        )
+        spec = self._resolve_shard_spec()
+        if spec.kind == "single":
+            return None
+        engine = self._shard_engines.get(spec)
+        if engine is None:
+            devices = (
+                self._mesh.devices.reshape(-1)
+                if self._mesh is not None else None
+            )
+            engine = ShardedEngine.for_inferencer(
+                self, spec, devices=devices
+            )
+            self._shard_engines[spec] = engine
+        return engine
 
-        pin, pout = tuple(self.input_patch_size), tuple(self.output_patch_size)
-        y = arr.shape[-2]
-        slab, halo_left, halo_right, spill, padded_y = spatial_geometry(
-            y, n_dev, pin, pout
-        )
-        program = self._programs.get(
-            ("spatial", slab),
-            lambda: build_spatial_program(
-                self._forward,
-                self.num_input_channels,
-                self.num_output_channels,
-                pin,
-                pout,
-                self.batch_size,
-                mesh,
-                bump_map(tuple(self.output_patch_size)),
-                slab,
-                halo_left,
-                halo_right,
-                spill,
-                out_dtype=self.output_dtype,
-            ),
-        )
-        dev_in, dev_out, dev_valid = partition_patches(
-            grid, n_dev, slab, self.batch_size, halo_left
-        )
-        arr = pad_chunk_y(arr, padded_y)
-        result = program(
-            arr,
-            jnp.asarray(dev_in),
-            jnp.asarray(dev_out),
-            jnp.asarray(dev_valid),
-            self._device_params,
-        )
-        return result[:, :, :y, :]
+    def _run_sharded(self, arr, grid, shard_engine=None):
+        """Multi-chip execution through the unified engine
+        (parallel/engine.py): every mesh kind — patch-parallel 'data',
+        1D y slabs, 2D (y, x) — produces output bitwise identical to the
+        single-device program (forward sharded, reference accumulation
+        replayed; see the engine docstring for the argument)."""
+        engine = shard_engine if shard_engine is not None \
+            else self.shard_engine()
+        return engine.run(arr, grid, self._device_params,
+                          host_params=self.engine.params)
 
     # ------------------------------------------------------------------
     def __call__(self, chunk: Chunk) -> Chunk:
@@ -753,6 +672,19 @@ class Inferencer:
                 f"scatter fallback",
                 file=sys.stderr,
             )
+        shard_engine = None
+        if use_fold:
+            if not self._fold_mesh_noted:
+                self._fold_mesh_noted = True
+                if self._resolve_shard_spec().kind != "single":
+                    print(
+                        "fold blend is a single-device program; the "
+                        "configured mesh spec is ignored for fold "
+                        "traffic (use blend='scatter' to shard)",
+                        file=sys.stderr,
+                    )
+        else:
+            shard_engine = self.shard_engine()
         grid = None
         if not use_fold:
             # the scatter grid; fold derives its own (and supports chunks
@@ -806,7 +738,7 @@ class Inferencer:
 
         if use_fold:
             result = self._run_fold(arr)
-        elif self.sharding == "none":
+        elif shard_engine is None:
             in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
             program = self._programs.get(("scatter",), self._build_program)
             result = program(
@@ -817,7 +749,7 @@ class Inferencer:
                 self._device_params,
             )
         else:
-            result = self._run_sharded(arr, grid)
+            result = self._run_sharded(arr, grid, shard_engine)
         if block:
             result.block_until_ready()
         return self._postprocess_result(result, chunk, orig_zyx, run_zyx)

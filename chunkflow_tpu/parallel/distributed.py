@@ -1,16 +1,18 @@
-"""Multi-chip patch-parallel inference via shard_map over a device mesh.
+"""Patch-parallel psum program — the CROSS-HOST leg of the mesh engine.
 
-SURVEY §2.10 mapping: the reference's only intra-worker parallelism is the
-patch batch (single GPU, DataParallel commented out). Here patch batches
-shard across TPU chips on a ('data',) mesh axis: every chip gathers and
-forwards its own subset of patches from the (replicated) input chunk,
-blends locally, and one psum over ICI merges the weighted partial outputs
-before reciprocal normalization. No host round trips, no NCCL-style
-point-to-point — just XLA collectives.
+The single-process patch-parallel path was subsumed by
+:mod:`chunkflow_tpu.parallel.engine` (mesh spec ``data=N``), whose
+forward-sharded + replayed-accumulation design is bitwise identical to
+the single-device program. What remains here is the psum-merge variant
+that the *multi-host* recipe still runs (``multihost.run_global``): when
+one program spans processes, gathering every chip's weighted stack to
+every host costs DCN bandwidth for data no host needs — the psum of
+partial blend buffers is the right collective there, at ulp-level (not
+bitwise) parity, which is exactly what the cross-host tests assert.
 
 Cross-host: workers keep pulling independent chunk tasks from the queue
-(communication-free task parallelism, deliberately preserved); this module
-scales the single-task hot loop across the chips of one slice.
+(communication-free task parallelism, deliberately preserved); this
+module scales the single-task hot loop across the chips of a slice.
 """
 from __future__ import annotations
 
@@ -18,6 +20,8 @@ from functools import partial
 from typing import Optional
 
 import numpy as np
+
+from chunkflow_tpu.core.compile_cache import ProgramCache
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "data"):
@@ -94,10 +98,13 @@ def build_sharded_program(
 # compiled-program reuse across chunk tasks with identical geometry: a
 # worker loop must pay the (multi-minute on a pod) XLA compile once, not
 # per chunk. Keyed on engine identity + every shape that feeds tracing.
-# Bounded FIFO: each entry's closure pins its engine (and params) alive,
-# so an unbounded cache would grow without limit across edge-chunk shapes.
-_PROGRAM_CACHE: dict = {}
-_PROGRAM_CACHE_MAX = 16
+# A real ProgramCache (not the bare dict this module used to carry), so
+# the cross-host programs get the same instrumentation — compile-time
+# ledger, roofline accounting in programs.json — as every other family.
+# Engines are pinned alive alongside their entry via _ENGINE_PINS so the
+# id(engine) in the key cannot be recycled while the entry lives.
+_PROGRAMS = ProgramCache(maxsize=16, label="distributed")
+_ENGINE_PINS: dict = {}
 
 
 def prepare_sharded(
@@ -109,9 +116,9 @@ def prepare_sharded(
     batch_size: int,
     mesh,
 ):
-    """Shared plumbing for the single-host and multi-host wrappers:
-    patch grid + padded coordinate arrays + the (cached) compiled
-    program. Returns (program, in_starts, out_starts, valid)."""
+    """Shared plumbing for the multi-host wrapper: patch grid + padded
+    coordinate arrays + the (cached) compiled psum program. Returns
+    (program, in_starts, out_starts, valid)."""
     from chunkflow_tpu.inference.bump import bump_map
     from chunkflow_tpu.inference.patching import enumerate_patches, pad_to_batch
 
@@ -128,11 +135,9 @@ def prepare_sharded(
         batch_size, tuple(mesh.axis_names),
         tuple(d.id for d in mesh.devices.flat),
     )
-    entry = _PROGRAM_CACHE.get(key)
-    # the strong engine reference in the entry guarantees id(engine) in
-    # the key cannot be recycled while the entry lives
-    if entry is None or entry[0] is not engine:
-        program = build_sharded_program(
+    program = _PROGRAMS.get(
+        key,
+        lambda: build_sharded_program(
             engine.apply,
             engine.num_input_channels,
             engine.num_output_channels,
@@ -141,12 +146,11 @@ def prepare_sharded(
             batch_size,
             mesh,
             bump_map(tuple(grid.output_patch_size)),
-        )
-        _PROGRAM_CACHE[key] = (engine, program)
-        while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
-            _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
-    else:
-        program = entry[1]
+        ),
+    )
+    _ENGINE_PINS[key] = engine
+    while len(_ENGINE_PINS) > 2 * _PROGRAMS.maxsize:
+        _ENGINE_PINS.pop(next(iter(_ENGINE_PINS)))
     return program, in_starts, out_starts, valid
 
 
@@ -159,26 +163,19 @@ def sharded_inference(
     batch_size: int = 1,
     mesh=None,
 ):
-    """Convenience wrapper: run multi-chip fused inference on an array."""
-    import jax.numpy as jnp
+    """Single-process multi-chip inference — delegates to the unified
+    engine (``data=N`` spec, bitwise identical to single-device)."""
+    import jax
 
-    if mesh is None:
-        mesh = make_mesh()
-    program, in_starts, out_starts, valid = prepare_sharded(
-        chunk_array.shape, engine, input_patch_size, output_patch_size,
-        output_patch_overlap, batch_size, mesh,
+    from chunkflow_tpu.parallel.engine import (
+        MeshSpec,
+        sharded_inference as unified,
     )
-    arr = jnp.asarray(chunk_array, dtype=jnp.float32)
-    if arr.ndim == 3:
-        arr = arr[None]
-    if arr is chunk_array:
-        # the program donates its chunk argument; never hand it the
-        # caller's own (already float32, already device) buffer
-        arr = arr.copy()
-    return program(
-        arr,
-        jnp.asarray(in_starts),
-        jnp.asarray(out_starts),
-        jnp.asarray(valid),
-        engine.params,
+
+    n_dev = (mesh.devices.size if mesh is not None
+             else len(jax.local_devices()))
+    return unified(
+        chunk_array, engine, input_patch_size, output_patch_size,
+        output_patch_overlap, batch_size=batch_size,
+        spec=MeshSpec("data", (max(n_dev, 1),)),
     )

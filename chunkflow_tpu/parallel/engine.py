@@ -1,0 +1,908 @@
+"""Unified multi-chip sharded inference engine: ONE shard_map program
+family for streaming AND serving across a pod slice.
+
+This module subsumes the four divergent parallel variants that grew up
+around the fused inference program — ``distributed.py`` (patch-parallel
+psum), ``spatial.py`` (1D y-slab ring), ``spatial2d.py`` (2D mesh with
+two-phase halo/spill), and the ``_shard_map.py`` shim's call sites — into
+a single :class:`ShardedEngine` driven by a mesh spec:
+
+    CHUNKFLOW_MESH=1          kill switch: the single-device reference
+                              path, bit-identically (no engine is built)
+    CHUNKFLOW_MESH=auto       one 'data' axis over every local device
+    CHUNKFLOW_MESH=data=8     patch-parallel over 8 chips
+    CHUNKFLOW_MESH=y=4        chunk sharded in y slabs over 4 chips
+    CHUNKFLOW_MESH=y=4,x=2    chunk sharded over a (4, 2) (y, x) mesh
+
+**Bit-identity contract.** Every mesh shape produces bitwise-identical
+output to the single-device fused program. The legacy variants merged
+*partial blend buffers* across chips (psum / spill ``ppermute``), which
+regroups the float accumulation and drifts by ulps; this engine instead
+shards the roofline-dominant stage — the convnet forward — and replays
+the *reference accumulation verbatim*:
+
+1. each chip gathers and forwards its share of patch batches at the SAME
+   per-batch shape ``[B, ci, *pin]`` the single-device program scans
+   (per-patch forward math is row-independent, so results are bitwise
+   equal no matter which rows share a batch — the same property the
+   serving packer's parity contract rests on, serve/packer.py);
+2. the weighted prediction stacks ``all_gather`` over the mesh (pure
+   data movement, exact);
+3. every chip replays the single-device scan-over-batches scatter
+   accumulation — same :func:`ops.blend.make_accumulate` step, same
+   batch grouping, same order — and the same ``normalize_blend``.
+
+For the spatial kinds the *input chunk itself* is sharded (each chip
+holds one slab plus ``ppermute``-exchanged halos — the HBM-scaling win of
+the old spatial variants, kept), patches are bucketed to the slab that
+owns their output start, and a host-precomputed ``take`` index restores
+global patch order before the replay. No output spill exchange exists
+anymore: the replay runs replicated, so slab boundaries cannot regroup
+the accumulation.
+
+Programs build through the PR 2 :class:`~chunkflow_tpu.core.
+compile_cache.ProgramCache`, so sharded programs get chunk-buffer
+donation (GL005), compile-cache shape bucketing, and the PR 8 roofline
+ledger (``programs.json``) exactly like the single-device family — none
+of the four legacy variants did.
+
+Telemetry (host-side only, GL007): ``shard/mesh_devices`` /
+``shard/mesh_y`` / ``shard/mesh_x`` / ``shard/per_chip_voxels`` gauges,
+``shard/chunks`` counter, and a ``shard/dispatch`` span labelled with the
+mesh around every sharded dispatch (the collective span — under async
+dispatch it measures enqueue, not device wall; docs/multichip.md).
+
+Multi-process runtimes: the ``data`` kind keeps the cross-host global-
+array recipe (``multihost.run_global``: psum program + consistency
+guard) on backends whose collectives span processes; on backends that
+cannot run multiprocess computations (the CPU backend — podsim/tier-1)
+the engine verifies input consistency through the coordination-service
+digest exchange and computes over the process-local mesh instead
+(``multihost.ensure_consistent``; docs/multichip.md "Simulation vs a
+real slice").
+"""
+from __future__ import annotations
+
+import os
+import re
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core.compile_cache import ProgramCache
+from chunkflow_tpu.inference.patching import (
+    PatchGrid,
+    enumerate_patches,
+    pad_to_batch,
+)
+
+__all__ = [
+    "MeshSpec", "parse_mesh_spec", "mesh_env_spec", "ShardedEngine",
+    "sharded_inference",
+]
+
+Triple = Tuple[int, int, int]
+
+_OFF_VALUES = ("", "1", "none", "off", "single", "0")
+
+
+class MeshSpec(NamedTuple):
+    """A parsed mesh request: ``kind`` is ``single`` (no engine),
+    ``data`` (patch-parallel, chunk replicated) or ``spatial`` (chunk
+    sharded over a ``(ny, nx)`` mesh; ``nx == 1`` is the 1D y-slab
+    layout)."""
+
+    kind: str           # "single" | "data" | "spatial"
+    shape: Tuple[int, ...]  # ("data": (n,); "spatial": (ny, nx))
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def describe(self) -> str:
+        if self.kind == "single":
+            return "1"
+        if self.kind == "data":
+            return f"data={self.shape[0]}"
+        ny, nx = self.shape
+        return f"y={ny},x={nx}" if nx > 1 else f"y={ny}"
+
+
+def parse_mesh_spec(value: Optional[str],
+                    n_devices: Optional[int] = None) -> MeshSpec:
+    """Parse a mesh spec string (the ``CHUNKFLOW_MESH`` grammar).
+
+    ``n_devices`` bounds ``auto`` and validates explicit sizes; ``None``
+    defers the device-count check to mesh construction (spec parsing must
+    not force a jax import)."""
+    raw = (value or "").strip().lower()
+    if raw in _OFF_VALUES:
+        return MeshSpec("single", (1,))
+    if raw == "auto":
+        n = n_devices if n_devices is not None else 0
+        if n <= 1:
+            return MeshSpec("single", (1,))
+        return MeshSpec("data", (n,))
+    if re.fullmatch(r"\d+", raw):
+        n = int(raw)
+        spec = MeshSpec("single", (1,)) if n <= 1 else MeshSpec("data", (n,))
+        _check_devices(spec, n_devices, value)
+        return spec
+    axes = {}
+    for part in raw.split(","):
+        m = re.fullmatch(r"\s*(data|y|x)\s*=\s*(\d+)\s*", part)
+        if not m:
+            raise ValueError(
+                f"bad mesh spec {value!r}: expected '1', 'auto', 'N', "
+                f"'data=N', 'y=A' or 'y=A,x=B' (docs/multichip.md)"
+            )
+        axis, n = m.group(1), int(m.group(2))
+        if axis in axes:
+            raise ValueError(f"bad mesh spec {value!r}: duplicate '{axis}='")
+        if n < 1:
+            raise ValueError(f"bad mesh spec {value!r}: {axis}={n}")
+        axes[axis] = n
+    if "data" in axes:
+        if len(axes) > 1:
+            raise ValueError(
+                f"bad mesh spec {value!r}: 'data' does not compose with "
+                f"spatial axes"
+            )
+        n = axes["data"]
+        spec = MeshSpec("single", (1,)) if n <= 1 else MeshSpec("data", (n,))
+    else:
+        ny = axes.get("y", 1)
+        nx = axes.get("x", 1)
+        if ny * nx <= 1:
+            spec = MeshSpec("single", (1,))
+        else:
+            spec = MeshSpec("spatial", (ny, nx))
+    _check_devices(spec, n_devices, value)
+    return spec
+
+
+def _check_devices(spec: MeshSpec, n_devices: Optional[int], value) -> None:
+    if n_devices is not None and spec.n_devices > n_devices:
+        raise ValueError(
+            f"mesh spec {value!r} needs {spec.n_devices} devices, only "
+            f"{n_devices} available"
+        )
+
+
+def mesh_env_spec(n_devices: Optional[int] = None) -> MeshSpec:
+    """The ``CHUNKFLOW_MESH`` environment spec (default: the single-
+    device kill switch). Re-read per call so tests and long-lived
+    workers can flip it."""
+    return parse_mesh_spec(os.environ.get("CHUNKFLOW_MESH", "1"), n_devices)
+
+
+# ---------------------------------------------------------------------------
+# geometry
+# ---------------------------------------------------------------------------
+
+def axis_geometry(extent: int, n_dev: int, pin: int, pout: int):
+    """(slab, halo_left, halo_right, padded) for sharding one spatial
+    axis over ``n_dev`` chips. ``n_dev == 1`` means no exchange: the
+    whole extent is one slab with zero halos. For ``n_dev > 1`` this is
+    the proven 1D slab math (parallel/spatial.spatial_geometry) minus
+    the output-spill floor, which the replay design no longer needs —
+    but the slab keeps the spill floor so legacy callers share one
+    geometry."""
+    if n_dev <= 1:
+        return extent, 0, 0, extent
+    margin = (pin - pout) // 2
+    halo_left = margin
+    halo_right = pin - margin
+    slab = max(-(-extent // n_dev), halo_left, halo_right, pout)
+    return slab, halo_left, halo_right, slab * n_dev
+
+
+def _pad_chunk(arr, padded_y: int, padded_x: int):
+    """Zero-pad [C, Z, y, x] on the high side of y/x (device-side for jax
+    arrays)."""
+    pad = [(0, 0)] * arr.ndim
+    pad[-2] = (0, padded_y - arr.shape[-2])
+    pad[-1] = (0, padded_x - arr.shape[-1])
+    if not any(p != (0, 0) for p in pad):
+        return arr
+    if isinstance(arr, np.ndarray):
+        return np.pad(arr, pad)
+    import jax.numpy as jnp
+
+    return jnp.pad(arr, pad)
+
+
+class _Partition(NamedTuple):
+    """Host-side patch partition for one (grid, mesh) pair."""
+
+    dev_in: np.ndarray      # [ny, nx, P, 3] int32, slab-localized gathers
+    dev_valid: np.ndarray   # [ny, nx, P] float32
+    src_index: np.ndarray   # [n_ref] int32: global padded row -> gathered row
+    out_starts: np.ndarray  # [n_ref, 3] int32, GLOBAL replay coords
+    valid: np.ndarray       # [n_ref] float32, the reference validity
+    per_dev: int            # P
+
+
+def partition_for_mesh(
+    grid: PatchGrid,
+    shape: Tuple[int, int],
+    batch_size: int,
+    yslab: int,
+    xslab: int,
+    halo_left_y: int,
+    halo_left_x: int,
+) -> _Partition:
+    """Bucket the REFERENCE padded patch list (``pad_to_batch(grid, B)``,
+    global padding rows included) by output-start slab and localize the
+    gather coordinates to each device's extended-slab frame.
+
+    Keeping the global padding rows inside the buckets matters for the
+    bit-identity contract: their forwarded values (``preds * bump * 0``,
+    a signed-zero pattern) flow through the replay exactly as the
+    single-device program computes them, instead of being approximated
+    by fresh ``+0.0`` rows."""
+    ny, nx = shape
+    in_starts, out_starts, valid = pad_to_batch(grid, batch_size)
+    n_ref = len(valid)
+    by = np.clip(out_starts[:, 1] // yslab, 0, ny - 1)
+    bx = np.clip(out_starts[:, 2] // xslab, 0, nx - 1)
+    flat = by * nx + bx
+    max_count = max(int((flat == d).sum()) for d in range(ny * nx))
+    per_dev = max(-(-max_count // batch_size) * batch_size, batch_size)
+
+    dev_in = np.zeros((ny, nx, per_dev, 3), dtype=np.int32)
+    dev_valid = np.zeros((ny, nx, per_dev), dtype=np.float32)
+    src_index = np.zeros(n_ref, dtype=np.int32)
+    for dy in range(ny):
+        for dx in range(nx):
+            idx = np.nonzero(flat == dy * nx + dx)[0]
+            k = idx.size
+            local = in_starts[idx].copy()
+            # both extended slabs start at global (dy*yslab - hl_y,
+            # dx*xslab - hl_x); z is never sharded
+            local[:, 1] -= dy * yslab - halo_left_y
+            local[:, 2] -= dx * xslab - halo_left_x
+            dev_in[dy, dx, :k] = local
+            dev_valid[dy, dx, :k] = valid[idx]
+            src_index[idx] = (dy * nx + dx) * per_dev + np.arange(
+                k, dtype=np.int32
+            )
+    return _Partition(dev_in, dev_valid, src_index, out_starts, valid,
+                      per_dev)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class ShardedEngine:
+    """One mesh-aware sharded inference engine for every mesh kind.
+
+    Construct via :meth:`for_inferencer` (the production seam: shares the
+    Inferencer's :class:`ProgramCache`, forward — including TTA — and
+    result dtype) or directly from a raw ``engines.Engine`` for
+    standalone use (bench, legacy wrappers)."""
+
+    def __init__(
+        self,
+        forward,
+        num_input_channels: int,
+        num_output_channels: int,
+        input_patch_size: Triple,
+        output_patch_size: Triple,
+        batch_size: int,
+        spec: MeshSpec,
+        programs: Optional[ProgramCache] = None,
+        out_dtype: str = "float32",
+        devices=None,
+    ):
+        if spec.kind == "single":
+            raise ValueError("single spec needs no ShardedEngine "
+                             "(the kill switch path)")
+        self.forward = forward
+        self.num_input_channels = num_input_channels
+        self.num_output_channels = num_output_channels
+        self.input_patch_size = tuple(input_patch_size)
+        self.output_patch_size = tuple(output_patch_size)
+        self.batch_size = int(batch_size)
+        self.spec = spec
+        self.out_dtype = out_dtype
+        self.programs = programs if programs is not None else ProgramCache(
+            label="sharded"
+        )
+        self._devices = devices
+        self._mesh = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_inferencer(cls, inferencer, spec: MeshSpec,
+                       devices=None) -> "ShardedEngine":
+        return cls(
+            inferencer._forward,
+            inferencer.num_input_channels,
+            inferencer.num_output_channels,
+            tuple(inferencer.input_patch_size),
+            tuple(inferencer.output_patch_size),
+            inferencer.batch_size,
+            spec,
+            programs=inferencer._programs,
+            out_dtype=inferencer.output_dtype,
+            devices=devices,
+        )
+
+    # ------------------------------------------------------------------
+    def mesh(self):
+        """The jax Mesh for this spec over the (local) devices. The data
+        kind uses one ``('data',)`` axis; spatial kinds a ``('y', 'x')``
+        grid (``nx == 1`` keeps the axis — exchange phases skip it
+        statically)."""
+        if self._mesh is not None:
+            return self._mesh
+        import jax
+        from jax.sharding import Mesh
+
+        devices = self._devices
+        if devices is None:
+            devices = jax.local_devices()
+        devices = np.asarray(devices).reshape(-1)
+        need = self.spec.n_devices
+        if devices.size < need:
+            raise ValueError(
+                f"mesh spec {self.spec.describe()!r} needs {need} devices, "
+                f"only {devices.size} available"
+            )
+        devices = devices[:need]
+        if self.spec.kind == "data":
+            self._mesh = Mesh(devices, ("data",))
+        else:
+            ny, nx = self.spec.shape
+            # axis-order: devices laid out row-major (y outer, x inner)
+            self._mesh = Mesh(devices.reshape(ny, nx), ("y", "x"))
+        return self._mesh
+
+    # ------------------------------------------------------------------
+    def _make_blend_parts(self):
+        """The pieces shared with the single-device program: bump map,
+        the per-batch accumulation step (same kernel selection / dnums /
+        grouping — ops.blend.make_accumulate) and normalize."""
+        import jax.numpy as jnp
+
+        from chunkflow_tpu.inference.bump import bump_map
+        from chunkflow_tpu.ops.blend import make_accumulate, normalize_blend
+
+        pout = self.output_patch_size
+        bump = jnp.asarray(bump_map(pout))
+        accumulate, pad_y, pad_x = make_accumulate(pout)
+        return bump, accumulate, pad_y, pad_x, normalize_blend
+
+    def _forward_scan(self, bump):
+        """Per-device gather+forward over local patch batches. Returns
+        ``scan_stack(chunk_like, in_starts, valid, params) -> [P, co,
+        *pout]`` computing ``forward * bump * valid`` in batches of B —
+        the identical per-row math (and per-batch shape) of the
+        single-device program's ``forward_batch``."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        B = self.batch_size
+        ci = self.num_input_channels
+        co = self.num_output_channels
+        pin = self.input_patch_size
+        pout = self.output_patch_size
+        forward = self.forward
+
+        def scan_stack(chunk_like, in_starts, valid, params):
+            n_local = in_starts.shape[0]
+
+            def fwd_batch(b):
+                i0 = b * B
+                s_in = lax.dynamic_slice(in_starts, (i0, 0), (B, 3))
+                v = lax.dynamic_slice(valid, (i0,), (B,))
+                patches = jax.vmap(
+                    lambda s: lax.dynamic_slice(
+                        chunk_like, (0, s[0], s[1], s[2]), (ci,) + pin
+                    )
+                )(s_in)
+                preds = forward(params, patches)
+                return (preds * bump[None, None]
+                        * v[:, None, None, None, None])
+
+            _, stack = lax.scan(
+                lambda c, b: (c, fwd_batch(b)), None,
+                jnp.arange(n_local // B),
+            )
+            # [n_batches, B, co, *pout(zyx)] -> [n_local, co, *pout(zyx)]:
+            # flattens the scan axis into the batch axis, patch order
+            # preserved; spatial axes untouched
+            return stack.reshape((n_local, co) + pout)
+
+        return scan_stack
+
+    def _replay(self, accumulate, bump, zyx, pad_y, pad_x, n_ref,
+                normalize_blend):
+        """The reference accumulation, replayed verbatim: scan batches of
+        B over the global-order weighted stack and scatter-add with the
+        shared accumulate step, then normalize. Runs replicated on every
+        chip (outputs are identical by construction)."""
+        import jax.numpy as jnp
+        from jax import lax
+
+        B = self.batch_size
+        co = self.num_output_channels
+        pout = self.output_patch_size
+        zyx_buf = (zyx[0], zyx[1] + pad_y, zyx[2] + pad_x)
+        num_batches = n_ref // B
+        out_dtype = self.out_dtype
+
+        def replay(weighted, valid, out_starts):
+            wpatch_all = bump[None] * valid[:, None, None, None]
+            out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
+            w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
+
+            def step(carry, b):
+                out, weight = carry
+                i0 = b * B
+                w = lax.dynamic_slice(
+                    weighted, (i0, 0, 0, 0, 0), (B, co) + pout)
+                wp = lax.dynamic_slice(
+                    wpatch_all, (i0, 0, 0, 0), (B,) + pout)
+                s_out = lax.dynamic_slice(out_starts, (i0, 0), (B, 3))
+                out, weight = accumulate(out, weight, w, wp, s_out)
+                return (out, weight), None
+
+            (out, weight), _ = lax.scan(
+                step, (out0, w0), jnp.arange(num_batches)
+            )
+            if pad_y or pad_x:
+                out = out[:, :, : zyx[1], : zyx[2]]
+                weight = weight[:, : zyx[1], : zyx[2]]
+            return normalize_blend(out, weight, out_dtype)
+
+        return replay
+
+    # ------------------------------------------------------------------
+    def _build_data_program(self, chunk_shape, n_pad_g, n_ref):
+        """Patch-parallel program: chunk replicated, the padded global
+        patch list contiguously sharded over 'data', forward stacks
+        all_gathered back into global order (contiguous shards ⇒ no
+        permutation), reference replay over the first n_ref rows."""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from chunkflow_tpu.parallel._shard_map import shard_map
+
+        mesh = self.mesh()
+        n_dev = mesh.devices.size
+        bump, accumulate, pad_y, pad_x, normalize = self._make_blend_parts()
+        scan_stack = self._forward_scan(bump)
+        replay = self._replay(accumulate, bump, chunk_shape[1:], pad_y,
+                              pad_x, n_ref, normalize)
+        assert n_pad_g % n_dev == 0
+
+        n_local = n_pad_g // n_dev
+
+        def device_fn(chunk, in_starts, out_starts, valid, params):
+            # in_starts arrives as this chip's contiguous shard
+            # [n_local, 3]; chunk/out_starts/valid replicated — the
+            # replay needs the GLOBAL validity, so each chip slices its
+            # own contiguous rows by mesh position instead
+            idx = lax.axis_index("data")
+            local_valid = lax.dynamic_slice(
+                valid, (idx * n_local,), (n_local,)
+            )
+            stack = scan_stack(chunk, in_starts, local_valid, params)
+            # exact data movement: tiled all_gather reassembles the
+            # stacks in mesh-axis order == global patch order
+            gathered = lax.all_gather(stack, "data", axis=0, tiled=True)
+            return replay(gathered[:n_ref], valid[:n_ref],
+                          out_starts[:n_ref])
+
+        sharded = shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P(), P(), P()),
+            out_specs=P(),
+            check_rep=False,
+        )
+
+        # chunk is donated (GL005): dead after the call, may be aliased
+        # into the blend buffers — callers hand over a buffer they own
+        @partial(jax.jit, donate_argnums=(0,))
+        def program(chunk, in_starts, out_starts, valid, params):
+            return sharded(chunk, in_starts, out_starts, valid, params)
+
+        return program
+
+    def _build_spatial_program(self, chunk_shape, geometry, per_dev,
+                               n_ref):
+        """Spatially-sharded program: the chunk lives sharded over the
+        (y, x) mesh, input halos ride ppermute (y phase then x phase, so
+        corner strips arrive without diagonal sends), each chip forwards
+        the patches whose output start falls in its slab, stacks
+        all_gather + take back into global order, reference replay."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from chunkflow_tpu.parallel._shard_map import shard_map
+
+        mesh = self.mesh()
+        ny, nx = self.spec.shape
+        (yslab, hl_y, hr_y, _), (xslab, hl_x, hr_x, _) = geometry
+        bump, accumulate, pad_y, pad_x, normalize = self._make_blend_parts()
+        scan_stack = self._forward_scan(bump)
+        replay = self._replay(accumulate, bump, chunk_shape[1:], pad_y,
+                              pad_x, n_ref, normalize)
+        fwd_y = [(i, i + 1) for i in range(ny - 1)]
+        bwd_y = [(i + 1, i) for i in range(ny - 1)]
+        fwd_x = [(i, i + 1) for i in range(nx - 1)]
+        bwd_x = [(i + 1, i) for i in range(nx - 1)]
+
+        def device_fn(chunk_slab, dev_in, dev_valid, src_index,
+                      out_starts, valid, params):
+            # chunk_slab: [C, Z, yslab, xslab]; dev_in/dev_valid carry
+            # two leading sharded axes of size 1 each
+            in_starts = dev_in[0, 0]
+            local_valid = dev_valid[0, 0]
+
+            # ---- 1a. y halo exchange (skipped statically at ny=1) ----
+            ext = chunk_slab
+            if ny > 1:
+                pieces = []
+                if hl_y:
+                    pieces.append(lax.ppermute(
+                        ext[:, :, yslab - hl_y:, :], "y", fwd_y))
+                pieces.append(ext)
+                if hr_y:
+                    pieces.append(lax.ppermute(
+                        ext[:, :, :hr_y, :], "y", bwd_y))
+                ext = lax.concatenate(pieces, dimension=2)
+            # ---- 1b. x halo exchange of the y-extended block ----
+            if nx > 1:
+                pieces = []
+                if hl_x:
+                    pieces.append(lax.ppermute(
+                        ext[:, :, :, xslab - hl_x:], "x", fwd_x))
+                pieces.append(ext)
+                if hr_x:
+                    pieces.append(lax.ppermute(
+                        ext[:, :, :, :hr_x], "x", bwd_x))
+                ext = lax.concatenate(pieces, dimension=3)
+
+            # ---- 2. local gather + forward over the extended slab ----
+            stack = scan_stack(ext, in_starts, local_valid, params)
+
+            # ---- 3. global reassembly: x-major then y-major gather
+            # matches the row-major device layout; take() restores
+            # global patch order (exact data movement) ----
+            gathered = stack
+            if nx > 1:
+                gathered = lax.all_gather(gathered, "x", axis=0,
+                                          tiled=True)
+            if ny > 1:
+                gathered = lax.all_gather(gathered, "y", axis=0,
+                                          tiled=True)
+            weighted = jnp.take(gathered, src_index, axis=0)
+            return replay(weighted, valid, out_starts)
+
+        sharded = shard_map(
+            device_fn,
+            mesh=mesh,
+            in_specs=(
+                P(None, None, "y", "x"),
+                P("y", "x"),
+                P("y", "x"),
+                P(),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=P(),
+            check_rep=False,
+        )
+
+        # chunk is donated (GL005): dead after the call, may be aliased
+        # into the blend buffers — callers hand over a buffer they own
+        @partial(jax.jit, donate_argnums=(0,))
+        def program(chunk, dev_in, dev_valid, src_index, out_starts,
+                    valid, params):
+            return sharded(chunk, dev_in, dev_valid, src_index,
+                           out_starts, valid, params)
+
+        return program
+
+    # ------------------------------------------------------------------
+    def serve_forward_program(self):
+        """The serving packer's forward program, sharded over the chips
+        of this mesh: a packed ``[B * n_chips, ci, *pin]`` batch splits
+        into per-chip ``[B, ...]`` rows (the same per-batch shape as the
+        fused program — per-row bitwise equality holds as everywhere
+        else), each chip computes ``forward * bump * valid`` for its
+        rows, and the row-sharded output assembles host-side. Always a
+        1D ('data',) layout regardless of the streaming mesh kind — the
+        packed batch has no spatial structure to shard."""
+        import jax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from chunkflow_tpu.parallel._shard_map import shard_map
+
+        n_chips = self.spec.n_devices
+        forward = self.forward
+
+        def build():
+            import jax.numpy as jnp
+
+            from chunkflow_tpu.inference.bump import bump_map
+
+            devices = self._devices
+            if devices is None:
+                devices = jax.local_devices()
+            devices = np.asarray(devices).reshape(-1)[:n_chips]
+            mesh = Mesh(devices, ("data",))
+            bump = jnp.asarray(bump_map(self.output_patch_size))
+
+            def device_fn(patches, valid, params):
+                # the same weighting expression, in the same order, as
+                # the fused program's forward_batch (ops/blend.py)
+                preds = forward(params, patches)
+                return (preds * bump[None, None]
+                        * valid[:, None, None, None, None])
+
+            sharded = shard_map(
+                device_fn,
+                mesh=mesh,
+                in_specs=(P("data"), P("data"), P()),
+                out_specs=P("data"),
+                check_rep=False,
+            )
+
+            # the packed batch buffer is packer-owned and dead after the
+            # call (GL005): donate it into the program
+            return jax.jit(sharded, donate_argnums=(0,))
+
+        return self.programs.get(("serve_forward", n_chips), build)
+
+    # ------------------------------------------------------------------
+    def _spatial_geometry(self, y: int, x: int):
+        ny, nx = self.spec.shape
+        pin = self.input_patch_size
+        pout = self.output_patch_size
+        gy = axis_geometry(y, ny, pin[1], pout[1])
+        gx = axis_geometry(x, nx, pin[2], pout[2])
+        return gy, gx
+
+    def _gauges(self, arr_shape, per_chip_voxels: int) -> None:
+        spec = self.spec
+        telemetry.gauge("shard/mesh_devices", float(spec.n_devices))
+        if spec.kind == "data":
+            telemetry.gauge("shard/mesh_y", 1.0)
+            telemetry.gauge("shard/mesh_x", 1.0)
+        else:
+            telemetry.gauge("shard/mesh_y", float(spec.shape[0]))
+            telemetry.gauge("shard/mesh_x", float(spec.shape[1]))
+        telemetry.gauge("shard/per_chip_voxels", float(per_chip_voxels))
+        telemetry.inc("shard/chunks")
+
+    # ------------------------------------------------------------------
+    def run(self, arr, grid: PatchGrid, params, host_params=None):
+        """Dispatch the sharded program for one device-resident float32
+        chunk ``[C, Z, y, x]`` (ownership transfers: the program donates
+        the buffer). Returns the normalized output array — dispatch is
+        async; callers block when they materialize. ``host_params`` is
+        the host-side parameter tree used for the cross-process
+        consistency digest (defaults to ``params``)."""
+        import jax
+
+        if jax.process_count() > 1:
+            return self._run_multiprocess(
+                arr, grid, params,
+                params if host_params is None else host_params,
+            )
+        return self._run_local(arr, grid, params)
+
+    def _run_local(self, arr, grid: PatchGrid, params):
+        import jax.numpy as jnp
+
+        B = self.batch_size
+        chunk_shape = tuple(arr.shape)
+        if self.spec.kind == "data":
+            n_dev = self.spec.n_devices
+            in_starts, out_starts, valid = pad_to_batch(grid, B * n_dev)
+            n_pad_g = len(valid)
+            n_ref = grid.num_patches + (-grid.num_patches % B)
+            program = self.programs.get(
+                ("shard", "data", n_dev, chunk_shape, n_pad_g),
+                lambda: self._build_data_program(chunk_shape, n_pad_g,
+                                                 n_ref),
+            )
+            self._gauges(chunk_shape, int(np.prod(chunk_shape[1:])))
+            with telemetry.span("shard/dispatch",
+                                mesh=self.spec.describe()):
+                return program(
+                    arr,
+                    jnp.asarray(in_starts),
+                    jnp.asarray(out_starts),
+                    jnp.asarray(valid),
+                    params,
+                )
+
+        # spatial kinds: shard the chunk itself
+        ny, nx = self.spec.shape
+        c, z, y, x = chunk_shape
+        geometry = self._spatial_geometry(y, x)
+        (yslab, hl_y, _, padded_y), (xslab, hl_x, _, padded_x) = geometry
+        part = partition_for_mesh(
+            grid, (ny, nx), B, yslab, xslab, hl_y, hl_x
+        )
+        arr = _pad_chunk(arr, padded_y, padded_x)
+        padded_shape = tuple(arr.shape)
+        program = self.programs.get(
+            ("shard", "spatial", (ny, nx), padded_shape, part.per_dev,
+             len(part.valid)),
+            lambda: self._build_spatial_program(
+                padded_shape, geometry, part.per_dev, len(part.valid)
+            ),
+        )
+        self._gauges(chunk_shape, int(c * z * yslab * xslab))
+        with telemetry.span("shard/dispatch", mesh=self.spec.describe()):
+            result = program(
+                arr,
+                jnp.asarray(part.dev_in),
+                jnp.asarray(part.dev_valid),
+                jnp.asarray(part.src_index),
+                jnp.asarray(part.out_starts),
+                jnp.asarray(part.valid),
+                params,
+            )
+        return result[:, :, :y, :x]
+
+    # ------------------------------------------------------------------
+    def _run_multiprocess(self, arr, grid: PatchGrid, params, host_params):
+        """A jax runtime spanning processes. Collective-capable backends
+        run the proven cross-host recipe for the data kind (global psum
+        program + run_global's guard, ulp-level parity); backends that
+        cannot run multiprocess computations (CPU — podsim) verify input
+        consistency host-side and compute over the process-local mesh
+        (bitwise-deterministic, so every process holds the same copy)."""
+        from chunkflow_tpu.parallel import multihost
+
+        if multihost.backend_supports_collectives() \
+                and self.spec.kind == "data":
+            import jax.numpy as jnp
+
+            from chunkflow_tpu.parallel.distributed import (
+                build_sharded_program,
+            )
+
+            mesh = multihost.global_mesh()
+            B = self.batch_size
+            in_starts, out_starts, valid = pad_to_batch(
+                grid, B * mesh.devices.size
+            )
+            program = self.programs.get(
+                ("shard", "global", tuple(d.id for d in mesh.devices.flat),
+                 tuple(arr.shape), len(valid)),
+                lambda: build_sharded_program(
+                    self.forward,
+                    self.num_input_channels,
+                    self.num_output_channels,
+                    self.input_patch_size,
+                    self.output_patch_size,
+                    B,
+                    mesh,
+                    _bump_array(self.output_patch_size),
+                    out_dtype=self.out_dtype,
+                ),
+            )
+            self._gauges(tuple(arr.shape),
+                         int(np.prod(tuple(arr.shape)[1:])))
+            with telemetry.span("shard/dispatch", mesh="global"):
+                out = multihost.run_global(
+                    program, np.asarray(arr), in_starts, out_starts,
+                    valid, host_params, mesh,
+                )
+            return jnp.asarray(out)
+
+        # no multiprocess collectives: guard, then compute locally
+        multihost.ensure_consistent(np.asarray(arr), host_params)
+        local = ShardedEngine(
+            self.forward,
+            self.num_input_channels,
+            self.num_output_channels,
+            self.input_patch_size,
+            self.output_patch_size,
+            self.batch_size,
+            self._local_spec(),
+            programs=self.programs,
+            out_dtype=self.out_dtype,
+        )
+        return local._run_local(arr, grid, params)
+
+    def _local_spec(self) -> MeshSpec:
+        """This spec clamped to the process-local device count (the
+        no-collectives fallback)."""
+        import jax
+
+        n_local = len(jax.local_devices())
+        if self.spec.kind == "data":
+            n = min(self.spec.shape[0], n_local)
+            return (MeshSpec("data", (n,)) if n > 1
+                    else MeshSpec("data", (max(n_local, 1),)))
+        ny, nx = self.spec.shape
+        if ny * nx <= n_local:
+            return self.spec
+        # shrink y first (the outer axis) until the mesh fits
+        while ny * nx > n_local and ny > 1:
+            ny -= 1
+        while ny * nx > n_local and nx > 1:
+            nx -= 1
+        return MeshSpec("spatial", (max(ny, 1), max(nx, 1))) \
+            if ny * nx > 1 else MeshSpec("data", (max(n_local, 1),))
+
+
+def _bump_array(pout: Triple) -> np.ndarray:
+    from chunkflow_tpu.inference.bump import bump_map
+
+    return bump_map(tuple(pout))
+
+
+# ---------------------------------------------------------------------------
+# standalone wrapper (bench / legacy module shims)
+# ---------------------------------------------------------------------------
+
+def sharded_inference(
+    chunk_array,
+    engine,
+    input_patch_size: Triple,
+    output_patch_size: Optional[Triple] = None,
+    output_patch_overlap: Triple = (0, 0, 0),
+    batch_size: int = 1,
+    spec: Optional[MeshSpec] = None,
+    mesh_spec: Optional[str] = None,
+    out_dtype: str = "float32",
+    programs: Optional[ProgramCache] = None,
+):
+    """Run unified sharded inference on a raw array with a raw
+    ``engines.Engine`` — the standalone entry the legacy
+    ``distributed.sharded_inference`` / ``spatial*_sharded_inference``
+    wrappers now delegate to. Returns the (async) device result."""
+    import jax.numpy as jnp
+
+    if spec is None:
+        import jax
+
+        n_local = len(jax.local_devices())
+        spec = (parse_mesh_spec(mesh_spec, n_local) if mesh_spec
+                else MeshSpec("data", (n_local,)))
+    pin = tuple(input_patch_size)
+    pout = tuple(output_patch_size) if output_patch_size else pin
+    arr = jnp.asarray(chunk_array, dtype=jnp.float32)
+    if arr.ndim == 3:
+        arr = arr[None]
+    if arr is chunk_array:
+        # the program donates its chunk argument; never hand it the
+        # caller's own (already float32, already device) buffer
+        arr = arr.copy()
+    grid = enumerate_patches(
+        tuple(arr.shape), pin, pout, tuple(output_patch_overlap)
+    )
+    sharded = ShardedEngine(
+        engine.apply,
+        engine.num_input_channels,
+        engine.num_output_channels,
+        pin,
+        tuple(grid.output_patch_size),
+        batch_size,
+        spec,
+        programs=programs,
+        out_dtype=out_dtype,
+    )
+    return sharded.run(arr, grid, engine.params)

@@ -1,31 +1,15 @@
-"""Spatially-sharded fused inference: the chunk itself lives sharded.
+"""Legacy 1D y-slab sharding — now a shim over the unified engine.
 
-``parallel.distributed`` scales the patch *batch* (chunk replicated on every
-chip). This module scales the chunk *extent*: the chunk is sharded along y
-over the mesh — the spatial analog of sequence/context parallelism — so a
-single task can exceed one chip's HBM. Reference analog: SURVEY §5.7 calls
-chunkflow's overlap-blend decomposition "structurally the same trick as
-blockwise/ring attention"; here the cross-chip halo exchange that trick
-implies is explicit, as two ring hops on ICI:
-
-1. input halos: each chip ``ppermute``s its y-edge strips to the neighbor
-   chips so every chip can cut all input patches whose *output* start falls
-   in its own slab;
-2. local fused blend (gather -> forward -> bump multiply -> scatter-add),
-   identical to the single-chip program, over the extended slab;
-3. output spill: bump-weighted contributions that extend past the slab's
-   right edge ride one more ``ppermute`` hop and are added into the right
-   neighbor's left edge (and the weight buffer likewise), after which the
-   reciprocal normalization is exact everywhere — the identity oracle holds
-   across chip boundaries.
-
-Non-periodic boundaries come for free: ``ppermute`` delivers zeros where no
-link exists. All shapes are static; the per-chip patch lists are padded to
-a common length with zero-validity entries.
+The ring halo/spill program that lived here was subsumed by
+:mod:`chunkflow_tpu.parallel.engine` (mesh spec ``y=N``): the chunk still
+lives sharded in y slabs with ``ppermute`` halo exchange, but the blend
+accumulation is replayed in reference order instead of spill-merged, so
+the output is **bitwise identical** to the single-device program rather
+than ulp-close (see the engine docstring for the argument). The geometry
+helpers remain here for callers that sized slabs with them.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import numpy as np
@@ -36,13 +20,12 @@ Triple = Tuple[int, int, int]
 def spatial_geometry(y: int, n_devices: int, pin: Triple, pout: Triple):
     """(slab, halo_left, halo_right, spill, padded_y) for y-sharding.
 
-    Single source of the halo math for both Inferencer(--sharding spatial)
-    and spatial_sharded_inference. Arbitrary chunk heights are supported
-    (parity: the reference decomposes arbitrary sizes everywhere,
-    lib/cartesian_coordinate.py:316-347): the slab is rounded up to both
-    an even device split and the halo/spill minimum, and callers zero-pad
-    y to ``padded_y = slab * n_devices`` then crop back — padded rows get
-    zero blend weight, so normalization is exact on the real extent."""
+    The slab is rounded up to an even device split and the halo/spill
+    minimum; callers zero-pad y to ``padded_y = slab * n_devices`` and
+    crop back (padded rows carry zero blend weight, so normalization is
+    exact on the real extent). The unified engine derives the same
+    numbers through :func:`chunkflow_tpu.parallel.engine.axis_geometry`.
+    """
     margin_y = (pin[1] - pout[1]) // 2
     halo_left = margin_y
     halo_right = pin[1] - margin_y
@@ -68,149 +51,6 @@ def pad_chunk_y(arr, padded_y: int):
     return jnp.pad(arr, pad)
 
 
-def partition_patches(
-    grid,
-    n_devices: int,
-    slab: int,
-    batch_size: int,
-    halo_left: int,
-):
-    """Bucket the global patch grid by output-start y-slab and localize.
-
-    Returns per-device (in_starts, out_starts, valid) arrays of identical
-    shape [n_devices, ceil(max_per_dev/batch)*batch, 3] / [..., ] where y
-    coordinates are relative to each device's extended input slab
-    (in_starts) or extended output slab (out_starts).
-    """
-    in_starts = np.asarray(grid.input_starts)
-    out_starts = np.asarray(grid.output_starts)
-
-    buckets = np.clip(out_starts[:, 1] // slab, 0, n_devices - 1)
-    max_count = max(
-        int((buckets == d).sum()) for d in range(n_devices)
-    )
-    padded = -(-max_count // batch_size) * batch_size
-
-    dev_in = np.zeros((n_devices, padded, 3), dtype=np.int32)
-    dev_out = np.zeros((n_devices, padded, 3), dtype=np.int32)
-    dev_valid = np.zeros((n_devices, padded), dtype=np.float32)
-    for d in range(n_devices):
-        idx = np.nonzero(buckets == d)[0]
-        k = idx.size
-        local_in = in_starts[idx].copy()
-        local_out = out_starts[idx].copy()
-        # both extended slabs start at global y = d*slab - halo_left
-        local_in[:, 1] -= d * slab - halo_left
-        local_out[:, 1] -= d * slab - halo_left
-        dev_in[d, :k] = local_in
-        dev_out[d, :k] = local_out
-        dev_valid[d, :k] = 1.0
-    return dev_in, dev_out, dev_valid
-
-
-def build_spatial_program(
-    engine_apply,
-    num_input_channels: int,
-    num_output_channels: int,
-    input_patch_size: Triple,
-    output_patch_size: Triple,
-    batch_size: int,
-    mesh,
-    bump_array: np.ndarray,
-    slab: int,
-    halo_left: int,
-    halo_right: int,
-    spill: int,
-    out_dtype="float32",
-):
-    """jit-compiled y-sharded fused inference over ``mesh`` axis 'data'.
-
-    chunk: [C, Z, n_dev*slab, X] sharded on y. Returns the normalized
-    output [Co, Z, n_dev*slab, X], same sharding.
-    """
-    import jax
-    from jax import lax
-    from chunkflow_tpu.parallel._shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    from chunkflow_tpu.ops.blend import build_local_blend, normalize_blend
-
-    axis = mesh.axis_names[0]
-    n_dev = mesh.devices.size
-    local_blend = build_local_blend(
-        engine_apply,
-        num_input_channels,
-        num_output_channels,
-        input_patch_size,
-        output_patch_size,
-        batch_size,
-        bump_array,
-    )
-    right = [(i, (i + 1) % n_dev) for i in range(n_dev - 1)]
-    left = [(i + 1, i) for i in range(n_dev - 1)]
-
-    def device_fn(chunk_slab, in_starts, out_starts, valid, params):
-        # chunk_slab: [C, Z, slab, X]; patch lists carry a leading sharded
-        # axis of size 1
-        in_starts = in_starts[0]
-        out_starts = out_starts[0]
-        valid = valid[0]
-
-        # ---- 1. input halo exchange (one ring hop each way) ----
-        # my right edge -> right neighbor's left halo
-        left_halo = lax.ppermute(
-            chunk_slab[:, :, slab - halo_left:slab, :], axis, right
-        )
-        # my left edge -> left neighbor's right halo
-        right_halo = lax.ppermute(
-            chunk_slab[:, :, :halo_right, :], axis, left
-        )
-        extended = lax.concatenate(
-            [left_halo, chunk_slab, right_halo], dimension=2
-        )
-
-        # ---- 2. local fused blend over the extended slab ----
-        # local_blend allocates out/weight buffers of the extended slab
-        # shape; patch coords were localized to the extended frame, whose
-        # y range is [d*slab - halo_left, (d+1)*slab + halo_right).
-        out, weight = local_blend(
-            extended, in_starts, out_starts, valid, params
-        )
-
-        # ---- 3. output spill exchange: bump contributions past my right
-        # slab edge are added into the right neighbor's left slab edge ----
-        lo = halo_left + slab
-        spill_out = lax.ppermute(out[:, :, lo:lo + spill, :], axis, right)
-        spill_w = lax.ppermute(weight[:, lo:lo + spill, :], axis, right)
-        out = out[:, :, halo_left:lo, :].at[:, :, :spill, :].add(spill_out)
-        weight = weight[:, halo_left:lo, :].at[:, :spill, :].add(spill_w)
-
-        return out, weight
-
-    sharded = shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(
-            P(None, None, axis, None),
-            P(axis),
-            P(axis),
-            P(axis),
-            P(),
-        ),
-        out_specs=(P(None, None, axis, None), P(None, axis, None)),
-        check_rep=False,
-    )
-
-    # chunk is donated (GL005): dead after the call, may be aliased
-    # into the output slab buffers — callers hand over a buffer they own
-    @partial(jax.jit, donate_argnums=(0,))
-    def program(chunk, dev_in, dev_out, dev_valid, params):
-        out, weight = sharded(chunk, dev_in, dev_out, dev_valid, params)
-        return normalize_blend(out, weight, out_dtype)
-
-    return program
-
-
 def spatial_sharded_inference(
     chunk_array: np.ndarray,
     engine,
@@ -220,55 +60,19 @@ def spatial_sharded_inference(
     batch_size: int = 1,
     mesh=None,
 ):
-    """Run fused inference with the chunk sharded along y over the mesh."""
-    import jax.numpy as jnp
+    """Run fused inference with the chunk sharded along y over the local
+    devices — delegates to the unified engine (``y=N`` spec)."""
+    import jax
 
-    from chunkflow_tpu.inference.bump import bump_map
-    from chunkflow_tpu.inference.patching import enumerate_patches
-    from chunkflow_tpu.parallel.distributed import make_mesh
+    from chunkflow_tpu.parallel.engine import MeshSpec, sharded_inference
 
-    if mesh is None:
-        mesh = make_mesh()
-    n_dev = mesh.devices.size
-
-    arr = np.asarray(chunk_array, dtype=np.float32)
-    if arr.ndim == 3:
-        arr = arr[None]
-    c, z, y, x = arr.shape
-    pin = tuple(input_patch_size)
-    pout = tuple(output_patch_size)
-    slab, halo_left, halo_right, spill, padded_y = spatial_geometry(
-        y, n_dev, pin, pout
+    n_dev = (mesh.devices.size if mesh is not None
+             else len(jax.local_devices()))
+    # one device degenerates to the trivial 'data' mesh (the engine's
+    # program family is identical; a 1-slab spatial mesh is pointless)
+    spec = (MeshSpec("spatial", (n_dev, 1)) if n_dev > 1
+            else MeshSpec("data", (1,)))
+    return sharded_inference(
+        chunk_array, engine, input_patch_size, output_patch_size,
+        output_patch_overlap, batch_size=batch_size, spec=spec,
     )
-
-    # patch grid covers the REAL extent; padded rows stay weight-zero
-    grid = enumerate_patches(
-        arr.shape, input_patch_size, output_patch_size, output_patch_overlap
-    )
-    arr = pad_chunk_y(arr, padded_y)
-    dev_in, dev_out, dev_valid = partition_patches(
-        grid, n_dev, slab, batch_size, halo_left
-    )
-
-    program = build_spatial_program(
-        engine.apply,
-        engine.num_input_channels,
-        engine.num_output_channels,
-        input_patch_size,
-        grid.output_patch_size,
-        batch_size,
-        mesh,
-        bump_map(tuple(grid.output_patch_size)),
-        slab,
-        halo_left,
-        halo_right,
-        spill,
-    )
-    result = program(
-        jnp.asarray(arr),
-        jnp.asarray(dev_in),
-        jnp.asarray(dev_out),
-        jnp.asarray(dev_valid),
-        engine.params,
-    )
-    return result[:, :, :y, :]

@@ -1,218 +1,31 @@
-"""2D spatially-sharded fused inference: the chunk sharded over (y, x).
+"""Legacy 2D (y, x) sharding — now a shim over the unified engine.
 
-Extends :mod:`parallel.spatial` (y-only ring) to a 2D device mesh
-``('dy', 'dx')`` so a single task's spatial extent can exceed what a 1D
-slab split supports (e.g. 2048x2048 xy planes over a pod slice). The
-halo/spill pattern is the classic two-phase 2D exchange, expressed as XLA
-``ppermute`` collectives on ICI:
-
-1. input halos, phase y then phase x — the x phase moves the already
-   y-extended strips, so corner data arrives with no diagonal sends;
-2. the unchanged local fused blend over the doubly-extended block;
-3. output spill in the REVERSE order (x then y): bump contributions past
-   a slab's +x edge hop right along 'dx' (all extended-y rows ride
-   along), then after the x crop the +y spill hops along 'dy' — a corner
-   contribution reaches its diagonal owner in the two hops.
-
-Output patches only ever spill toward +y/+x: patches are bucketed by
-their output START slab, so outputs extend at most ``pout`` past the
-slab's far edge and never before its near edge (same invariant as the 1D
-module). The identity oracle across both chip-boundary directions is the
-test (tests/parallel/test_spatial2d.py).
+The two-phase halo + reverse-spill program that lived here was subsumed
+by :mod:`chunkflow_tpu.parallel.engine` (mesh spec ``y=A,x=B``): the
+chunk still lives sharded over a (y, x) device grid with two-phase
+``ppermute`` halo exchange (corner strips ride the x phase of the
+y-extended block, no diagonal sends), but the blend accumulation is
+replayed in reference order instead of spill-merged, so the output is
+**bitwise identical** to the single-device program rather than
+ulp-close. Only the mesh-shape helper remains.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import numpy as np
 
-from chunkflow_tpu.parallel.spatial import spatial_geometry
-
 Triple = Tuple[int, int, int]
 
 
-def make_mesh_2d(shape: Tuple[int, int] = None, devices=None):
-    """A ('dy', 'dx') mesh over the local devices (default: near-square)."""
-    import jax
-    from jax.sharding import Mesh
-
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    n = devices.size
-    if shape is None:
-        ny = int(np.floor(np.sqrt(n)))
-        while n % ny:
-            ny -= 1
-        shape = (ny, n // ny)
-    if shape[0] * shape[1] != n:
-        raise ValueError(f"mesh shape {shape} != {n} devices")
-    return Mesh(devices.reshape(shape), ("dy", "dx"))
-
-
-def spatial2d_geometry(y: int, x: int, mesh, pin: Triple, pout: Triple):
-    """Per-axis slab geometry: ((yslab, hl_y, hr_y, spill_y, padded_y),
-    (xslab, hl_x, hr_x, spill_x, padded_x))."""
-    ny, nx = mesh.devices.shape
-    gy = spatial_geometry(y, ny, pin, pout)
-    # reuse the same math for x by presenting x as the "y" axis
-    pin_x = (pin[0], pin[2], pin[1])
-    pout_x = (pout[0], pout[2], pout[1])
-    gx = spatial_geometry(x, nx, pin_x, pout_x)
-    return gy, gx
-
-
-def pad_chunk_yx(arr, padded_y: int, padded_x: int):
-    """Zero-pad [C, Z, y, x] up to (padded_y, padded_x) on the high side."""
-    pad = [(0, 0)] * arr.ndim
-    pad[-2] = (0, padded_y - arr.shape[-2])
-    pad[-1] = (0, padded_x - arr.shape[-1])
-    if not any(p != (0, 0) for p in pad):
-        return arr
-    if isinstance(arr, np.ndarray):
-        return np.pad(arr, pad)
-    import jax.numpy as jnp
-
-    return jnp.pad(arr, pad)
-
-
-def partition_patches_2d(
-    grid, mesh, yslab: int, xslab: int, batch_size: int,
-    halo_left_y: int, halo_left_x: int,
-):
-    """Bucket the global patch grid by (y, x) output-start slab.
-
-    Returns per-device arrays [ny, nx, P, 3] / [ny, nx, P] with y/x patch
-    coordinates localized to each device's doubly-extended block frame.
-    """
-    ny, nx = mesh.devices.shape
-    in_starts = np.asarray(grid.input_starts)
-    out_starts = np.asarray(grid.output_starts)
-    by = np.clip(out_starts[:, 1] // yslab, 0, ny - 1)
-    bx = np.clip(out_starts[:, 2] // xslab, 0, nx - 1)
-
-    max_count = max(
-        int(((by == dy) & (bx == dx)).sum())
-        for dy in range(ny) for dx in range(nx)
-    )
-    padded = max(-(-max_count // batch_size) * batch_size, batch_size)
-
-    dev_in = np.zeros((ny, nx, padded, 3), dtype=np.int32)
-    dev_out = np.zeros((ny, nx, padded, 3), dtype=np.int32)
-    dev_valid = np.zeros((ny, nx, padded), dtype=np.float32)
-    for dy in range(ny):
-        for dx in range(nx):
-            idx = np.nonzero((by == dy) & (bx == dx))[0]
-            k = idx.size
-            li = in_starts[idx].copy()
-            lo = out_starts[idx].copy()
-            for arr_ in (li, lo):
-                arr_[:, 1] -= dy * yslab - halo_left_y
-                arr_[:, 2] -= dx * xslab - halo_left_x
-            dev_in[dy, dx, :k] = li
-            dev_out[dy, dx, :k] = lo
-            dev_valid[dy, dx, :k] = 1.0
-    return dev_in, dev_out, dev_valid
-
-
-def build_spatial2d_program(
-    engine_apply,
-    num_input_channels: int,
-    num_output_channels: int,
-    input_patch_size: Triple,
-    output_patch_size: Triple,
-    batch_size: int,
-    mesh,
-    bump_array: np.ndarray,
-    geometry,
-    out_dtype="float32",
-):
-    """jit-compiled (y, x)-sharded fused inference over mesh ('dy', 'dx')."""
-    import jax
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
-
-    from chunkflow_tpu.ops.blend import build_local_blend, normalize_blend
-    from chunkflow_tpu.parallel._shard_map import shard_map
-
-    (yslab, hl_y, hr_y, spill_y, _), (xslab, hl_x, hr_x, spill_x, _) = geometry
-    ny, nx = mesh.devices.shape
-    local_blend = build_local_blend(
-        engine_apply,
-        num_input_channels,
-        num_output_channels,
-        input_patch_size,
-        output_patch_size,
-        batch_size,
-        bump_array,
-    )
-    fwd_y = [(i, i + 1) for i in range(ny - 1)]
-    bwd_y = [(i + 1, i) for i in range(ny - 1)]
-    fwd_x = [(i, i + 1) for i in range(nx - 1)]
-    bwd_x = [(i + 1, i) for i in range(nx - 1)]
-
-    def device_fn(chunk_slab, in_starts, out_starts, valid, params):
-        # chunk_slab: [C, Z, yslab, xslab]; patch lists carry two leading
-        # sharded axes of size 1 each
-        in_starts = in_starts[0, 0]
-        out_starts = out_starts[0, 0]
-        valid = valid[0, 0]
-
-        # ---- 1a. y halo exchange ----
-        top = lax.ppermute(
-            chunk_slab[:, :, yslab - hl_y:, :], "dy", fwd_y
-        )
-        bottom = lax.ppermute(chunk_slab[:, :, :hr_y, :], "dy", bwd_y)
-        ext_y = lax.concatenate([top, chunk_slab, bottom], dimension=2)
-        # ---- 1b. x halo exchange of the y-extended block (corners ride) --
-        left = lax.ppermute(ext_y[:, :, :, xslab - hl_x:], "dx", fwd_x)
-        right = lax.ppermute(ext_y[:, :, :, :hr_x], "dx", bwd_x)
-        extended = lax.concatenate([left, ext_y, right], dimension=3)
-
-        # ---- 2. local fused blend over the doubly-extended block ----
-        out, weight = local_blend(
-            extended, in_starts, out_starts, valid, params
-        )
-
-        # ---- 3a. x spill (reverse of 1b): all extended-y rows ride ----
-        xe = hl_x + xslab
-        spill_o = lax.ppermute(out[:, :, :, xe:xe + spill_x], "dx", fwd_x)
-        spill_w = lax.ppermute(weight[:, :, xe:xe + spill_x], "dx", fwd_x)
-        out = out[:, :, :, hl_x:xe].at[:, :, :, :spill_x].add(spill_o)
-        weight = weight[:, :, hl_x:xe].at[:, :, :spill_x].add(spill_w)
-        # ---- 3b. y spill (reverse of 1a): corner spills complete here ----
-        ye = hl_y + yslab
-        spill_o = lax.ppermute(out[:, :, ye:ye + spill_y, :], "dy", fwd_y)
-        spill_w = lax.ppermute(weight[:, ye:ye + spill_y, :], "dy", fwd_y)
-        out = out[:, :, hl_y:ye, :].at[:, :, :spill_y, :].add(spill_o)
-        weight = weight[:, hl_y:ye, :].at[:, :spill_y, :].add(spill_w)
-
-        return out, weight
-
-    sharded = shard_map(
-        device_fn,
-        mesh=mesh,
-        in_specs=(
-            P(None, None, "dy", "dx"),
-            P("dy", "dx"),
-            P("dy", "dx"),
-            P("dy", "dx"),
-            P(),
-        ),
-        out_specs=(
-            P(None, None, "dy", "dx"),
-            P(None, "dy", "dx"),
-        ),
-        check_rep=False,
-    )
-
-    # chunk is donated (GL005): dead after the call, may be aliased
-    # into the output slab buffers — callers hand over a buffer they own
-    @partial(jax.jit, donate_argnums=(0,))
-    def program(chunk, dev_in, dev_out, dev_valid, params):
-        out, weight = sharded(chunk, dev_in, dev_out, dev_valid, params)
-        return normalize_blend(out, weight, out_dtype)
-
-    return program
+def near_square_shape(n: int) -> Tuple[int, int]:
+    """The default (ny, nx) factorization of ``n`` devices: the most
+    square split with ny <= sqrt(n) (the legacy ``make_mesh_2d``
+    layout, kept as the ``sharding='spatial2d'`` alias's shape)."""
+    ny = int(np.floor(np.sqrt(n)))
+    while n % ny:
+        ny -= 1
+    return ny, n // ny
 
 
 def spatial2d_sharded_inference(
@@ -223,50 +36,22 @@ def spatial2d_sharded_inference(
     output_patch_overlap: Triple,
     batch_size: int = 1,
     mesh=None,
+    shape: Tuple[int, int] = None,
 ):
-    """Fused inference with the chunk sharded over a ('dy', 'dx') mesh."""
-    import jax.numpy as jnp
+    """Fused inference with the chunk sharded over a (y, x) grid —
+    delegates to the unified engine (``y=A,x=B`` spec)."""
+    import jax
 
-    from chunkflow_tpu.inference.bump import bump_map
-    from chunkflow_tpu.inference.patching import enumerate_patches
+    from chunkflow_tpu.parallel.engine import MeshSpec, sharded_inference
 
-    if mesh is None:
-        mesh = make_mesh_2d()
-
-    arr = np.asarray(chunk_array, dtype=np.float32)
-    if arr.ndim == 3:
-        arr = arr[None]
-    _, _, y, x = arr.shape
-    geometry = spatial2d_geometry(
-        y, x, mesh, tuple(input_patch_size), tuple(output_patch_size)
+    if shape is None:
+        n = (mesh.devices.size if mesh is not None
+             else len(jax.local_devices()))
+        shape = near_square_shape(n)
+    ny, nx = shape
+    spec = (MeshSpec("spatial", (ny, nx)) if ny * nx > 1
+            else MeshSpec("data", (1,)))
+    return sharded_inference(
+        chunk_array, engine, input_patch_size, output_patch_size,
+        output_patch_overlap, batch_size=batch_size, spec=spec,
     )
-    (yslab, hl_y, _, _, padded_y), (xslab, hl_x, _, _, padded_x) = geometry
-
-    # patch grid covers the REAL extent; padded rows/cols stay weight-zero
-    grid = enumerate_patches(
-        arr.shape, input_patch_size, output_patch_size, output_patch_overlap
-    )
-    arr = pad_chunk_yx(arr, padded_y, padded_x)
-    dev_in, dev_out, dev_valid = partition_patches_2d(
-        grid, mesh, yslab, xslab, batch_size, hl_y, hl_x
-    )
-
-    program = build_spatial2d_program(
-        engine.apply,
-        engine.num_input_channels,
-        engine.num_output_channels,
-        input_patch_size,
-        grid.output_patch_size,
-        batch_size,
-        mesh,
-        bump_map(tuple(grid.output_patch_size)),
-        geometry,
-    )
-    result = program(
-        jnp.asarray(arr),
-        jnp.asarray(dev_in),
-        jnp.asarray(dev_out),
-        jnp.asarray(dev_valid),
-        engine.params,
-    )
-    return result[:, :, :y, :x]

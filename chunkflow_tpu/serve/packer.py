@@ -191,9 +191,11 @@ class PatchPacker:
 
     # -- eligibility ----------------------------------------------------
     def _eligible(self) -> bool:
-        """Packed execution covers the single-device scatter path — the
-        serving shape. Everything else (sharded meshes, fold blend, the
-        kill switch) falls back to the per-chunk program."""
+        """Packed execution covers the scatter path — the serving shape.
+        Legacy ``sharding=`` inferencers, fold blend and the kill switch
+        fall back to the per-chunk program. A unified mesh
+        (``CHUNKFLOW_MESH``, parallel/engine.py) stays eligible: the
+        packed forward itself shards across the chips of the slice."""
         inf = self.inferencer
         return (
             serve_enabled()
@@ -201,6 +203,23 @@ class PatchPacker:
             and inf.blend_mode == "scatter"
             and not inf.dry_run
         )
+
+    def _shard_engine(self):
+        """The unified mesh engine behind this inferencer, or None for
+        single-device serving. Re-resolved per batch so the
+        ``CHUNKFLOW_MESH=1`` kill switch drops serving back to one chip
+        mid-stream."""
+        getter = getattr(self.inferencer, "shard_engine", None)
+        return getter() if getter is not None else None
+
+    def _slots(self) -> int:
+        """Patch slots per dispatched device batch: the per-chip batch
+        times the chips of the mesh — a pod-slice serving plane packs
+        ``n_chips`` times more traffic per dispatch at the same per-chip
+        occupancy accounting."""
+        engine = self._shard_engine()
+        chips = engine.spec.n_devices if engine is not None else 1
+        return self.batch_size * chips
 
     # -- submission -----------------------------------------------------
     def submit(self, chunk: Chunk, deadline: Optional[float] = None,
@@ -317,13 +336,13 @@ class PatchPacker:
         with self._cv:
             while True:
                 if self._items:
+                    slots = self._slots()
                     oldest_t = self._items[0][2]
-                    if (len(self._items) >= self.batch_size or self._stop
+                    if (len(self._items) >= slots or self._stop
                             or time.time() - oldest_t >= self.max_wait_s):
                         batch = [
                             self._items.popleft()
-                            for _ in range(min(self.batch_size,
-                                               len(self._items)))
+                            for _ in range(min(slots, len(self._items)))
                         ]
                         telemetry.gauge("serving/patch_queue",
                                         len(self._items))
@@ -452,23 +471,36 @@ class PatchPacker:
             live.append(item)
         if not live:
             return
-        B = self.batch_size
+        engine = self._shard_engine()
+        chips = engine.spec.n_devices if engine is not None else 1
+        slots = self.batch_size * chips
+        if len(live) > slots:
+            # the batch was collected under a wider mesh than the one in
+            # effect now (kill-switch race): widen this dispatch to the
+            # next shardable multiple instead of dropping rows
+            per = self.batch_size * chips
+            slots = -(-len(live) // per) * per
         pin = tuple(inf.input_patch_size)
         ci = inf.num_input_channels
-        batch_np = np.zeros((B, ci) + pin, dtype=np.float32)
-        valid_np = np.zeros((B,), dtype=np.float32)
+        batch_np = np.zeros((slots, ci) + pin, dtype=np.float32)
+        valid_np = np.zeros((slots,), dtype=np.float32)
         for row, (req, idx, _) in enumerate(live):
             batch_np[row] = req.patches[idx]
             valid_np[row] = 1.0
-        occupancy = len(live) / B
+        # per-chip occupancy: live patches over every chip's slots — the
+        # same gauge the single-chip serving plane feeds, now spanning
+        # the slice (docs/multichip.md "The three seams")
+        occupancy = len(live) / slots
         telemetry.gauge("serving/occupancy", occupancy)
+        telemetry.gauge("serving/chips", float(chips))
         telemetry.inc("serving/batches")
         telemetry.inc("serving/packed_patches", len(live))
-        telemetry.inc("serving/filler_slots", B - len(live))
+        telemetry.inc("serving/filler_slots", slots - len(live))
 
         if inf._device_params is None:
             inf._device_params = jax.device_put(inf.engine.params)
-        program = self._forward_program()
+        program = (engine.serve_forward_program() if engine is not None
+                   else self._forward_program())
         with telemetry.span("serving/forward", occupancy=round(occupancy, 3)):
             out = program(
                 jnp.asarray(batch_np), jnp.asarray(valid_np),

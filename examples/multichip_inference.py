@@ -27,20 +27,22 @@ def main():
     rng = np.random.default_rng(0)
     chunk = Chunk(rng.random((16, 64, 64)).astype(np.float32))
 
-    # patch-parallel: chunk replicated, patch batches sharded over the
-    # mesh, one psum merges the partial blend buffers
+    # unified mesh engine (docs/multichip.md): patch-parallel — chunk
+    # replicated, each chip forwards its share of patch batches, the
+    # reference blend accumulation replays verbatim (bitwise identical
+    # to the single-device path; CHUNKFLOW_MESH=auto does the same)
     sharded = Inferencer(
         input_patch_size=(8, 32, 32),
         output_patch_overlap=(2, 8, 8),
         num_output_channels=3,
         framework="identity",
         batch_size=1,
-        sharding="patch",
+        mesh=f"data={mesh.devices.size}" if mesh.devices.size > 1 else "1",
         crop_output_margin=False,
     )
     out = np.asarray(sharded(chunk).array)
 
-    # numeric parity with the single-device path (same weights)
+    # bitwise parity with the single-device path (same weights)
     single = Inferencer(
         input_patch_size=(8, 32, 32),
         output_patch_overlap=(2, 8, 8),
@@ -52,7 +54,7 @@ def main():
     ref = np.asarray(single(chunk).array)
     diff = float(np.abs(out - ref).max())
     print(f"sharded vs single-device max-abs-diff: {diff:.2e}")
-    assert diff < 1e-4
+    assert np.array_equal(out, ref), "mesh output diverged bitwise"
 
 
 if __name__ == "__main__":

@@ -174,6 +174,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py slo_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "slo overhead gate"
 
+# --- multichip overlap gate ---------------------------------------------------
+# Unified sharded engine (CHUNKFLOW_MESH=data=8) vs the single-device
+# reference path on 8 simulated host devices (docs/multichip.md). The
+# run asserts bitwise identity between the legs and that the sharded
+# program landed in the roofline ledger; reports the >=1.3x target as
+# gate_pass (asserted slow-marked in tests/test_bench.py); the process
+# only fails below 1.1x.
+echo "== multichip overlap gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py multichip_overlap --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "multichip overlap gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

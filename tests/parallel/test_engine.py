@@ -1,0 +1,457 @@
+"""Unified multi-chip engine: ONE parametrized parity matrix.
+
+Replaces the per-variant test trios (test_distributed / test_spatial /
+test_spatial2d): every mesh shape the spec grammar can express runs the
+same traffic — plain, ragged, uint8, crop-margin, packed-serve — against
+the single-device reference program and must match **bitwise** (the
+engine's contract: forward sharded, reference accumulation replayed;
+chunkflow_tpu/parallel/engine.py). Runs on the 8-device virtual CPU mesh
+(tests/conftest.py)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.inference import engines
+from chunkflow_tpu.inference.inferencer import Inferencer
+from chunkflow_tpu.parallel.engine import (
+    MeshSpec,
+    parse_mesh_spec,
+    sharded_inference,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="needs 8 virtual devices (see tests/conftest.py)",
+)
+
+PIN = (4, 16, 16)
+OVERLAP = (2, 8, 8)
+
+# the matrix: every engine kind and several shapes of each — mesh
+# shapes 1 (kill switch) / 2 / 4 / 8 on the data axis plus 1D and 2D
+# spatial layouts, per the ISSUE 13 acceptance grid
+MESHES = ["1", "data=2", "data=4", "data=8", "y=2", "y=4", "y=8",
+          "y=2,x=2", "y=4,x=2", "y=2,x=4"]
+
+
+@pytest.fixture(scope="module")
+def conv_engine():
+    """A real conv engine (not identity): bitwise parity must hold for
+    arbitrary float math, not just the identity oracle."""
+    return engines.create_flax_engine(
+        "", None, PIN, num_input_channels=1, num_output_channels=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def id_engine():
+    """The identity engine drives the wide matrix: its programs compile
+    in milliseconds on the virtual CPU mesh, so 10 mesh shapes x 4
+    traffic classes stay inside the tier-1 wall-clock budget; the
+    conv-engine spot checks below pin the arbitrary-float-math case."""
+    return engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+
+
+def make_inferencer(engine, **kw):
+    kw.setdefault("crop_output_margin", False)
+    return Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=engine,
+        **kw,
+    )
+
+
+# one single-device reference inferencer and one mesh inferencer per
+# (mesh, crop) config, shared across the whole matrix — a fresh
+# Inferencer per case would recompile every program 40 times. The crop
+# config uses a central-crop identity engine (pout < pin) so the margin
+# crop is a REAL (1, 4, 4) crop, not a zero-width no-op.
+@pytest.fixture(scope="module")
+def shared(id_engine):
+    crop_engine = engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=(2, 8, 8),
+        num_input_channels=1, num_output_channels=3,
+    )
+    cache: dict = {}
+
+    def get(mesh=None, crop=False):
+        key = (mesh, crop)
+        if key not in cache:
+            if crop:
+                cache[key] = Inferencer(
+                    input_patch_size=PIN,
+                    output_patch_size=(2, 8, 8),
+                    output_patch_overlap=(1, 4, 4),
+                    num_output_channels=3,
+                    framework="prebuilt",
+                    batch_size=2,
+                    engine=crop_engine,
+                    mesh=mesh,
+                    crop_output_margin=True,
+                )
+            else:
+                cache[key] = make_inferencer(id_engine, mesh=mesh)
+        return cache[key]
+
+    return get
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+# ---------------------------------------------------------------------------
+def test_spec_grammar():
+    assert parse_mesh_spec(None).kind == "single"
+    assert parse_mesh_spec("1").kind == "single"
+    assert parse_mesh_spec("off").kind == "single"
+    assert parse_mesh_spec("auto", 8) == MeshSpec("data", (8,))
+    assert parse_mesh_spec("auto", 1).kind == "single"
+    assert parse_mesh_spec("8") == MeshSpec("data", (8,))
+    assert parse_mesh_spec("data=4") == MeshSpec("data", (4,))
+    assert parse_mesh_spec("y=4") == MeshSpec("spatial", (4, 1))
+    assert parse_mesh_spec("x=4") == MeshSpec("spatial", (1, 4))
+    assert parse_mesh_spec("y=4,x=2") == MeshSpec("spatial", (4, 2))
+    assert parse_mesh_spec("y=1,x=1").kind == "single"
+    assert parse_mesh_spec("data=8").describe() == "data=8"
+    assert parse_mesh_spec("y=4,x=2").describe() == "y=4,x=2"
+    with pytest.raises(ValueError, match="bad mesh spec"):
+        parse_mesh_spec("z=4")
+    with pytest.raises(ValueError, match="does not compose"):
+        parse_mesh_spec("data=4,y=2")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_mesh_spec("y=2,y=4")
+    with pytest.raises(ValueError, match="devices"):
+        parse_mesh_spec("data=16", 8)
+
+
+# ---------------------------------------------------------------------------
+# the parity matrix
+# ---------------------------------------------------------------------------
+def _traffic_chunk(traffic: str, seed: int):
+    rng = np.random.default_rng(seed)
+    if traffic == "ragged":
+        # non-divisible extents: edge snapping + uneven slab buckets
+        return Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    if traffic == "uint8":
+        # narrow-input device normalization path
+        return Chunk(rng.integers(0, 256, (8, 40, 48), dtype=np.uint8))
+    return Chunk(rng.random((8, 40, 48)).astype(np.float32))
+
+
+@pytest.mark.parametrize("mesh", [m for m in MESHES if m != "1"])
+@pytest.mark.parametrize(
+    "traffic", ["plain", "ragged", "uint8", "crop_margin"]
+)
+def test_mesh_bitwise_parity_matrix(shared, mesh, traffic):
+    """Every mesh shape x every traffic class == the single-device
+    program, bitwise ('crop_margin' additionally exercises the
+    post-blend margin crop). Identity engine: its programs compile in
+    milliseconds, which is what lets a 36-case matrix live in tier-1;
+    the conv spot checks below cover arbitrary float forward math."""
+    crop = traffic == "crop_margin"
+    chunk = _traffic_chunk(traffic, seed=abs(hash(traffic)) % 2**31)
+    ref = np.asarray(shared(crop=crop)(chunk).array)
+    out = np.asarray(shared(mesh=mesh, crop=crop)(chunk).array)
+    assert out.dtype == ref.dtype
+    assert out.shape == ref.shape
+    assert np.array_equal(out, ref), (
+        f"mesh {mesh} diverged from the single-device reference "
+        f"(max abs diff "
+        f"{np.abs(out.astype(np.float64) - ref.astype(np.float64)).max():.3e})"
+    )
+
+
+def test_kill_switch_spec_is_single(shared):
+    """Mesh '1' (the kill-switch row of the matrix) resolves to NO
+    engine at all — covered in depth by test_env_spec_and_kill_switch."""
+    assert shared(mesh="1").shard_engine() is None
+
+
+@pytest.mark.parametrize("mesh", ["data=8", "y=4,x=2"])
+def test_conv_engine_bitwise_spot_checks(conv_engine, mesh):
+    """The bit-identity contract on REAL conv forward math (per-row
+    independence of batched convs is the property the replay design
+    rests on) — two representative mesh kinds."""
+    rng = np.random.default_rng(11)
+    chunk = Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    ref = np.asarray(make_inferencer(conv_engine)(chunk).array)
+    out = np.asarray(
+        make_inferencer(conv_engine, mesh=mesh)(chunk).array
+    )
+    assert np.array_equal(out, ref)
+
+
+def test_identity_oracle_through_mesh():
+    """The identity oracle (blended overlap-add of identity patches
+    reproduces the input) holds through the sharded path — the same
+    oracle the reference's single-GPU tests pin."""
+    rng = np.random.default_rng(0)
+    chunk = rng.random((8, 32, 48)).astype(np.float32)
+    engine = engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+    for spec in ("data=8", "y=4,x=2"):
+        out = np.asarray(sharded_inference(
+            chunk, engine, PIN, None, OVERLAP, batch_size=1,
+            spec=parse_mesh_spec(spec, 8),
+        ))
+        np.testing.assert_allclose(
+            out, np.broadcast_to(chunk, out.shape), atol=1e-5
+        )
+
+
+def test_uint8_output_dtype_through_mesh(id_engine):
+    """The on-device quantized output path survives sharding bitwise."""
+    rng = np.random.default_rng(3)
+    chunk = Chunk(rng.random((8, 40, 48)).astype(np.float32))
+    ref = np.asarray(
+        make_inferencer(id_engine, output_dtype="uint8")(chunk).array
+    )
+    out = np.asarray(
+        make_inferencer(id_engine, output_dtype="uint8",
+                        mesh="y=2,x=2")(chunk).array
+    )
+    assert out.dtype == np.uint8
+    assert np.array_equal(out, ref)
+
+
+# ---------------------------------------------------------------------------
+# kill switch + env resolution
+# ---------------------------------------------------------------------------
+def test_env_spec_and_kill_switch(id_engine, monkeypatch):
+    """CHUNKFLOW_MESH is re-read per chunk: flipping the kill switch on
+    a live inferencer restores the single-device program (the engine
+    resolves to None and the ('scatter',) family runs), bit-identically."""
+    rng = np.random.default_rng(1)
+    chunk = Chunk(rng.random((8, 40, 48)).astype(np.float32))
+    ref = np.asarray(make_inferencer(id_engine)(chunk).array)
+
+    inf = make_inferencer(id_engine)
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=4")
+    assert inf.shard_engine() is not None
+    out = np.asarray(inf(chunk).array)
+    assert np.array_equal(out, ref)
+    assert any(k[0] == "shard" for k, _ in inf._programs.items())
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "1")
+    assert inf.shard_engine() is None
+    out = np.asarray(inf(chunk).array)
+    assert np.array_equal(out, ref)
+    assert inf._programs.peek(("scatter",)) is not None
+
+
+def test_explicit_mesh_overrides_env(id_engine, monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=8")
+    inf = make_inferencer(id_engine, mesh="y=2")
+    assert inf.shard_engine().spec == MeshSpec("spatial", (2, 1))
+    monkeypatch.setenv("CHUNKFLOW_MESH", "1")
+    # explicit argument still wins — the env kill switch governs only
+    # env-resolved meshes
+    assert inf.shard_engine() is not None
+
+
+def test_mesh_and_legacy_sharding_conflict(id_engine):
+    with pytest.raises(ValueError, match="does not compose"):
+        make_inferencer(id_engine, mesh="data=4", sharding="patch")
+
+
+@pytest.mark.parametrize("legacy,kind,shape", [
+    ("patch", "data", (8,)),
+    ("spatial", "spatial", (8, 1)),
+    ("spatial2d", "spatial", (2, 4)),
+])
+def test_legacy_sharding_aliases(id_engine, legacy, kind, shape):
+    """The legacy sharding names map onto the unified engine layouts."""
+    inf = make_inferencer(id_engine, sharding=legacy)
+    spec = inf.shard_engine().spec
+    assert spec.kind == kind
+    assert spec.shape == shape
+
+
+# ---------------------------------------------------------------------------
+# legacy wrapper delegation (the subsumed modules)
+# ---------------------------------------------------------------------------
+def test_legacy_wrappers_delegate_bitwise(id_engine):
+    from chunkflow_tpu.parallel.distributed import sharded_inference as d
+    from chunkflow_tpu.parallel.spatial import spatial_sharded_inference
+    from chunkflow_tpu.parallel.spatial2d import (
+        spatial2d_sharded_inference,
+    )
+
+    rng = np.random.default_rng(2)
+    chunk = rng.random((8, 40, 48)).astype(np.float32)
+    ref = np.asarray(
+        make_inferencer(id_engine)(Chunk(chunk.copy())).array
+    )
+    for fn in (d, spatial_sharded_inference, spatial2d_sharded_inference):
+        out = np.asarray(fn(
+            chunk, id_engine, PIN, PIN, OVERLAP, batch_size=2,
+        ))
+        assert np.array_equal(out, ref), fn.__name__
+
+
+# ---------------------------------------------------------------------------
+# seams: scheduler stream, serving packer, telemetry/roofline
+# ---------------------------------------------------------------------------
+def test_scheduled_stream_bitwise_through_mesh(id_engine, monkeypatch):
+    """The adaptive scheduler seam: Inferencer.stream over a mesh-active
+    inferencer is bit-identical to the serial single-device loop, and
+    the stream announces its mesh (scheduler/mesh event)."""
+    from chunkflow_tpu.core import telemetry
+
+    rng = np.random.default_rng(4)
+    chunks = [
+        Chunk(rng.random((8, 40, 48)).astype(np.float32),
+              voxel_offset=(8 * i, 0, 0))
+        for i in range(4)
+    ]
+    refs = [
+        np.asarray(make_inferencer(id_engine)(c).array) for c in chunks
+    ]
+    monkeypatch.setenv("CHUNKFLOW_MESH", "y=2,x=2")
+    events = []
+    monkeypatch.setattr(
+        telemetry, "event",
+        lambda kind, name, **attrs: events.append((kind, name, attrs)),
+    )
+    inf = make_inferencer(id_engine)
+    outs = [np.asarray(c.array) for c in inf.stream(iter(chunks))]
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+    assert any(
+        k == "scheduler" and n == "mesh" and a.get("mesh") == "y=2,x=2"
+        for k, n, a in events
+    ), events
+
+
+def test_packed_serving_shards_across_chips(id_engine, monkeypatch):
+    """The serving seam: packed batches span the slice (B * n_chips
+    slots), stay bit-identical to the per-chunk path, and feed the
+    occupancy gauge per chip."""
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    rng = np.random.default_rng(5)
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=(0, 0, 0),
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=id_engine,
+        crop_output_margin=False,
+    )
+    chunks = [
+        Chunk(rng.random((4, 16, 48)).astype(np.float32),
+              voxel_offset=(4 * i, 0, 0))
+        for i in range(8)
+    ]
+    monkeypatch.setenv("CHUNKFLOW_MESH", "1")
+    refs = [np.asarray(inf(c).array) for c in chunks]
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=4")
+    telemetry.reset()
+    packer = PatchPacker(inf, max_wait_ms=25.0)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=120).array) for h in handles]
+    finally:
+        packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+    snap = telemetry.snapshot()
+    assert snap["gauges"].get("serving/chips") == 4.0
+    # 8 requests x 3 patches over 8-slot (2 x 4 chips) dispatches: the
+    # packer must have packed across requests, not one per dispatch
+    batches = snap["counters"]["serving/batches"]
+    assert batches <= 4, snap["counters"]
+    telemetry.reset()
+
+
+def test_shard_telemetry_and_roofline_ledger(id_engine, tmp_path,
+                                             monkeypatch):
+    """Sharded programs ride the ProgramCache, so they land in the PR 8
+    roofline ledger (programs.json) with shard/* gauges alongside."""
+    import json
+
+    from chunkflow_tpu.core import telemetry
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=4")
+    telemetry.reset()
+    telemetry.configure(str(tmp_path))
+    try:
+        inf = make_inferencer(id_engine)
+        rng = np.random.default_rng(6)
+        np.asarray(inf(Chunk(rng.random((8, 40, 48)).astype(
+            np.float32))).array)
+        snap = telemetry.snapshot()
+        assert snap["gauges"].get("shard/mesh_devices") == 4.0
+        assert snap["gauges"].get("shard/per_chip_voxels") == float(
+            8 * 40 * 48)
+        assert snap["counters"].get("shard/chunks") == 1
+        telemetry.flush()
+    finally:
+        telemetry.configure(None)
+        telemetry.reset()
+    catalog = json.loads((tmp_path / "programs.json").read_text())
+    entries = catalog["programs"]
+    shard_entries = [
+        e for e in entries
+        if e.get("family") == "shard" or "shard" in str(e.get("key"))
+    ]
+    assert shard_entries, entries
+    # the ledger carries real cost accounting for the sharded program
+    assert shard_entries[0].get("compile_s") is not None
+
+
+def test_program_reuse_across_same_shape_chunks(id_engine, monkeypatch):
+    """Two same-shape chunks share ONE sharded program build (the
+    compile-cache invariant every other family holds)."""
+    monkeypatch.setenv("CHUNKFLOW_MESH", "y=4")
+    inf = make_inferencer(id_engine)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        np.asarray(inf(Chunk(rng.random((8, 40, 48)).astype(
+            np.float32))).array)
+    shard_builds = [k for k, _ in inf._programs.items()
+                    if k[0] == "shard"]
+    assert len(shard_builds) == 1, shard_builds
+    assert inf._programs.hits >= 2
+
+
+def test_engine_is_graftlint_clean():
+    """ISSUE 13 acceptance: GL001-GL014 clean over parallel/engine.py
+    and the modules it reworked, asserted in-suite (the whole-repo gate
+    in tests/tools/test_graftlint_gate.py covers them too; this pins
+    the specific modules so a future baseline regeneration cannot
+    quietly grandfather a finding here)."""
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        [
+            "chunkflow_tpu/parallel/engine.py",
+            "chunkflow_tpu/parallel/distributed.py",
+            "chunkflow_tpu/parallel/spatial.py",
+            "chunkflow_tpu/parallel/spatial2d.py",
+            "chunkflow_tpu/parallel/multihost.py",
+            "chunkflow_tpu/serve/packer.py",
+        ],
+        config, repo_root=repo_root,
+    )
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]

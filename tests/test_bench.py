@@ -673,3 +673,55 @@ def test_storage_throughput_microbench(tmp_path):
     assert counters.get("storage/hits", 0) > 0, counters
     assert counters.get("storage/misses", 0) > 0, counters
     assert counters.get("storage/bytes_read", 0) > 0, counters
+
+
+@pytest.mark.bench
+@pytest.mark.slow
+def test_multichip_overlap_microbench(tmp_path):
+    """The unified sharded engine on 8 simulated host devices must beat
+    the single-device reference path (ISSUE 13 acceptance: >= 1.3x)
+    and stay bit-identical — run_multichip_overlap itself raises on any
+    divergence between the legs, and on the sharded program missing
+    from the roofline ledger.
+
+    Marked slow/bench like the other load-sensitive ratio gates (the
+    PR 7 deflake convention); run_tests.sh runs the same workload as a
+    standalone gate after the slo gate. Fresh-subprocess + best-of-3
+    pattern shared with them (bench.py forces its own 8-device
+    XLA_FLAGS, so the conftest scrub is harmless here)."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)
+    env.pop("CHUNKFLOW_MESH", None)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "multichip_overlap"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] > best["value"]:
+            best = stats
+        if best["value"] >= 1.3:
+            break
+    assert best["metric"] == "multichip_overlap"
+    assert best["value"] >= 1.3, best
+    assert best["gate_pass"] is True, best
+    assert best["bit_identical"] is True, best
+    assert best["in_roofline_ledger"] is True, best
+    assert best["n_devices"] == 8, best
+    # one sharded program build, reused across every sharded dispatch
+    # (the compile-cache invariant); builds = scatter + shard families
+    assert best["cache_builds"] == 2, best
+    # the sharded program catalog landed in programs.json (PR 8 ledger)
+    programs = os.path.join(tmp_path, "programs.json")
+    assert os.path.exists(programs), os.listdir(tmp_path)
+    with open(programs) as f:
+        entries = json.load(f)["programs"]
+    assert any(e.get("family") == "shard" for e in entries), entries

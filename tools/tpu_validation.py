@@ -784,6 +784,74 @@ def bench_jumbo():
                   output_dtype="uint8", input_dtype="uint8")
 
 
+@step("bench_multichip")
+def bench_multichip():
+    """The unified sharded engine (parallel/engine.py, ISSUE 13) on the
+    real device(s): sharded-vs-single Mvox/s through the production
+    Inferencer with the flagship config, plus a bitwise-identity check
+    between the legs — the row that RETIRES the dry-run-only
+    MULTICHIP_r0* entries. On a single-chip tunnel the row records the
+    skip (an honest "needs a slice"), so the next tunnel window with a
+    slice stamps the first real multi-chip throughput number."""
+    import numpy as np
+
+    import jax
+
+    import bench
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    os.environ["CHUNKFLOW_PALLAS"] = "0"
+    os.environ.pop("CHUNKFLOW_BLEND_STACKED", None)
+    n_dev = jax.local_device_count()
+    if n_dev < 2:
+        return {
+            "skipped": True,
+            "n_devices": n_dev,
+            "note": (
+                "single-chip tunnel: unified-engine speedup needs a "
+                "slice; bitwise parity is covered on the 8-device "
+                "virtual mesh in tier-1 (tests/parallel/test_engine.py "
+                "+ bench.py multichip_overlap)"
+            ),
+        }
+    mesh_spec = f"data={n_dev}"
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random(bench.CHUNK_SIZE, dtype=np.float32))
+
+    def leg(mesh):
+        inferencer = Inferencer(
+            input_patch_size=bench.INPUT_PATCH,
+            output_patch_overlap=bench.OUTPUT_OVERLAP,
+            num_output_channels=bench.NUM_OUT,
+            framework="flax",
+            batch_size=4,
+            dtype="bfloat16",
+            model_variant="tpu",
+            mesh=mesh,
+            crop_output_margin=False,
+        )
+        out = np.asarray(inferencer(chunk).array)  # warm (compile)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = np.asarray(inferencer(chunk).array)
+            times.append(time.perf_counter() - t0)
+        mvox = float(np.prod(bench.CHUNK_SIZE)) / min(times) / 1e6
+        return mvox, out
+
+    single_mvox, ref = leg("1")
+    sharded_mvox, out = leg(mesh_spec)
+    return {
+        "mvox_s": round(sharded_mvox, 3),
+        "single_mvox_s": round(single_mvox, 3),
+        "speedup": round(sharded_mvox / single_mvox, 2),
+        "mesh": mesh_spec,
+        "n_devices": n_dev,
+        "bit_identical": bool(np.array_equal(ref, out)),
+    }
+
+
 @step("entry_compile")
 def entry_compile():
     # pin the blend-kernel selection to auto (platform default) so the
@@ -900,6 +968,9 @@ def main():
              bench_pipeline_seg, bench_pipeline_seg_streamed,
              bench_cli_task_loop, bench_jumbo,
              bench_flagship_pallas,
+             bench_multichip,  # unified-engine slice row (ISSUE 13):
+             # cheap skip on a single-chip tunnel, the first real
+             # multi-chip throughput number when a slice window opens
              entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after
