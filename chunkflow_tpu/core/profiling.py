@@ -64,7 +64,8 @@ from typing import Optional, Tuple
 from chunkflow_tpu.core import telemetry
 
 __all__ = [
-    "instrument_program", "catalog", "write_catalog", "device_peaks",
+    "instrument_program", "stamp_cost", "catalog", "write_catalog",
+    "device_peaks",
     "capture", "maybe_capture", "note_retrace", "note_stall",
     "note_slo_page", "start_task_window", "note_task_done",
     "wait_for_captures", "capture_base_dir",
@@ -211,9 +212,15 @@ class _InstrumentedProgram:
 
     def _first_call(self, args, kwargs):
         rec = self._rec
-        # cost analysis BEFORE dispatch: afterwards a donated input
-        # buffer is dead, and lowering only needs shapes anyway
-        cost = _cost_analysis(self._fn, args, kwargs)
+        # an analytic cost stamp (stamp_cost) wins over XLA's
+        # cost_analysis: programs whose HLO hides traffic behind custom
+        # calls (the fused Pallas kernel) or loop bodies are opaque or
+        # miscounted by the unoptimized-HLO analysis
+        cost = getattr(self._fn, "_chunkflow_cost", None)
+        if not isinstance(cost, dict):
+            # cost analysis BEFORE dispatch: afterwards a donated input
+            # buffer is dead, and lowering only needs shapes anyway
+            cost = _cost_analysis(self._fn, args, kwargs)
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         dt = time.perf_counter() - t0
@@ -255,6 +262,41 @@ class _InstrumentedProgram:
 
     def __getattr__(self, name):
         return getattr(self._fn, name)
+
+
+class _CostStamped:
+    """A jit program carrying an analytic cost model. Transparent:
+    ``__call__`` and attribute access (``lower``, ...) forward to the
+    program; :func:`instrument_program`'s wrapper reads the stamp."""
+
+    __slots__ = ("_fn", "_chunkflow_cost")
+
+    def __init__(self, fn, cost: dict):
+        self._fn = fn
+        self._chunkflow_cost = cost
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def stamp_cost(program, flops: Optional[float] = None,
+               bytes_accessed: Optional[float] = None):
+    """Attach an ANALYTIC cost model to a program before it enters a
+    ProgramCache: the ledger then scores its roofline against these
+    numbers instead of XLA's ``cost_analysis()``. Use for programs the
+    unoptimized-HLO analysis cannot see into (Pallas custom calls) or
+    systematically miscounts (loop-body traffic) — the stamp is the
+    builder's arithmetic, so it must state what the program actually
+    moves/computes, not what would look good."""
+    cost: dict = {}
+    if flops is not None:
+        cost["flops"] = float(flops)
+    if bytes_accessed is not None:
+        cost["bytes accessed"] = float(bytes_accessed)
+    return _CostStamped(program, cost)
 
 
 def _family_of(key, label: str) -> Tuple[str, str]:
@@ -336,6 +378,15 @@ def catalog() -> list:
         entry["roofline_util"] = (
             round(roofline_s / exec_s, 4)
             if roofline_s and exec_s else None
+        )
+        # lost seconds: (dispatch_wall − roofline_s) × calls — the total
+        # wall this program spent ABOVE its cost-model floor, i.e. the
+        # prize for fusing/optimizing it. The "what do I fuse next"
+        # ranking key (log-summary DEVICE PROGRAMS); clamped at zero
+        # because async dispatch can put measured wall under the floor.
+        entry["lost_s"] = (
+            round(max(0.0, exec_s - roofline_s) * calls, 6)
+            if roofline_s is not None and exec_s else None
         )
         entry["achieved_flops_per_s"] = (
             round(flops / exec_s, 2) if flops and exec_s else None

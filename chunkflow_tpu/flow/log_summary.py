@@ -343,7 +343,11 @@ def summarize_programs(events: List[dict]) -> List[dict]:
     ``programs``-kind catalog event per worker wins (it carries the
     roofline derivations); workers that died before a catalog flush
     fall back to their raw per-build ``compile`` events. Entries are
-    stamped with their worker and sorted by compile seconds."""
+    stamped with their worker and ranked by LOST SECONDS —
+    ``(dispatch_wall − roofline_s) × calls``, the total wall a program
+    spent above its cost-model floor — so "what do I fuse next" is one
+    command; entries without a roofline figure (died-early workers'
+    compile events) fall back behind them, by compile seconds."""
     catalogs: dict = {}
     compiles: dict = {}
     for record in events:
@@ -368,7 +372,9 @@ def summarize_programs(events: List[dict]) -> List[dict]:
             row = dict(entry)
             row["worker"] = worker
             entries.append(row)
-    entries.sort(key=lambda e: -(e.get("compile_s") or 0.0))
+    entries.sort(key=lambda e: (
+        -(e.get("lost_s") or 0.0), -(e.get("compile_s") or 0.0)
+    ))
     return entries
 
 
@@ -379,23 +385,26 @@ def _fmt_quantity(value, scale: float, suffix: str) -> str:
 
 
 def print_program_summary(programs: List[dict], top: int = 10) -> None:
-    """The DEVICE PROGRAMS table: top program families by compile time,
+    """The DEVICE PROGRAMS table: top program families by LOST SECONDS
+    ((dispatch_wall − roofline) × calls — the fusion-target ranking),
     with XLA cost analysis and the achieved-vs-roofline figure when the
     catalog carried one (docs/observability.md "Device program view")."""
     if not programs:
         return
-    print("device programs (top by compile time; util is an upper "
-          "bound under async dispatch):")
+    print("device programs (top by lost seconds = (dispatch − roofline) "
+          "× calls; util is an upper bound under async dispatch):")
     print(
-        f"  {'family':<10} {'key':<14} {'compile_s':>9} {'flops':>9} "
-        f"{'bytes':>9} {'exec_ms':>8} {'roofline':>8}"
+        f"  {'family':<14} {'key':<12} {'lost_s':>8} {'compile_s':>9} "
+        f"{'flops':>9} {'bytes':>9} {'exec_ms':>8} {'roofline':>8}"
     )
     for entry in programs[:top]:
         exec_s = entry.get("exec_mean_s")
         util = entry.get("roofline_util")
+        lost = entry.get("lost_s")
         print(
-            f"  {str(entry.get('family', ''))[:10]:<10} "
-            f"{str(entry.get('key', ''))[:14]:<14} "
+            f"  {str(entry.get('family', ''))[:14]:<14} "
+            f"{str(entry.get('key', ''))[:12]:<12} "
+            f"{(f'{lost:.3f}' if lost is not None else '-'):>8} "
             f"{entry.get('compile_s') or 0.0:>9.3f} "
             f"{_fmt_quantity(entry.get('flops'), 1e9, 'G'):>9} "
             f"{_fmt_quantity(entry.get('bytes_accessed'), 2**20, 'M'):>9} "

@@ -43,6 +43,20 @@ def bump_map(patch_size: Tuple[int, int, int]) -> np.ndarray:
     return bump.astype(np.float32)
 
 
+def bump_const(patch_size: Tuple[int, int, int]):
+    """The bump map as a jax constant — the ONE device-side form every
+    blend-program builder closes over (ops/blend.py, serve/packer.py,
+    parallel/engine.py). In the fused Pallas kernel
+    (ops/pallas_blend.py) this array becomes the constant-index VMEM
+    block that rides on-chip memory once for the whole accumulation
+    grid instead of being re-materialized per patch; on the XLA leg it
+    is the broadcast operand of the bump-weight multiply. Same values
+    either way — the weighting is bitwise identical across kernels."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(bump_map(tuple(patch_size)))
+
+
 @functools.lru_cache(maxsize=None)
 @contract(_result=Spec("z", "y", "x", dtype="float32"))
 def normalized_patch_mask(

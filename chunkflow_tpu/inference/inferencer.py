@@ -68,6 +68,7 @@ class Inferencer:
         engine=None,
         sharding: str = "none",
         mesh: Optional[str] = None,
+        precision: Optional[str] = None,
         shape_bucket=None,
         blend: str = "auto",
         dry_run: bool = False,
@@ -201,13 +202,47 @@ class Inferencer:
             dtype=dtype,
             model_variant=model_variant,
         )
+        # Forward precision (inference/precision.py): an explicit
+        # ``precision`` argument is strict; otherwise CHUNKFLOW_PRECISION
+        # resolves once here (a per-chunk re-read would retrace every
+        # program on a flip). float32 keeps engine.apply ITSELF — the
+        # default path stays bitwise untouched; bf16/int8 wrap the
+        # forward only, while blend accumulation stays float32. The
+        # serving packer and the sharded engine both build on
+        # ``_forward``, so every execution path shares one precision.
+        from chunkflow_tpu.inference.precision import (
+            resolve_precision,
+            wrap_apply,
+        )
+
+        self.precision = resolve_precision(precision)
+        self._apply = wrap_apply(self.engine.apply, self.precision)
         self._device_params = None
 
     # ------------------------------------------------------------------
+    def _scatter_key(self) -> tuple:
+        """ProgramCache key for the single-device blend program. The
+        accumulation-kernel selection (XLA scatter vs the fused Pallas
+        kernel, ops/blend.kernel_tag) is part of the key, so flipping
+        ``CHUNKFLOW_PALLAS`` mid-stream builds the right program instead
+        of reusing a stale one — the same re-read-per-chunk convention
+        as ``CHUNKFLOW_MESH``."""
+        from chunkflow_tpu.ops.blend import kernel_tag
+
+        tag = kernel_tag()
+        return ("scatter",) if tag == "scatter" else ("scatter_fused", tag)
+
     @property
     def _program(self):
-        """The compiled single-device scatter program, if built (tests)."""
-        return self._programs.peek(("scatter",))
+        """The compiled single-device blend program, if built (tests) —
+        whichever accumulation kernel it selected."""
+        prog = self._programs.peek(("scatter",))
+        if prog is not None:
+            return prog
+        for key, cached in self._programs.items():
+            if key and key[0] == "scatter_fused":
+                return cached
+        return None
 
     @property
     def _fold_programs(self) -> dict:
@@ -286,7 +321,7 @@ class Inferencer:
         from jax import lax
 
         if not self.augment:
-            return self.engine.apply(params, patches)
+            return self._apply(params, patches)
 
         combos = list(itertools.product((False, True), repeat=3))
         variants = []
@@ -302,7 +337,7 @@ class Inferencer:
         xs = jnp.stack(variants)  # [8, B, ci, *pin]
 
         _, ys = lax.scan(
-            lambda c, x: (c, self.engine.apply(params, x)), None, xs
+            lambda c, x: (c, self._apply(params, x)), None, xs
         )
 
         acc = None
@@ -740,7 +775,8 @@ class Inferencer:
             result = self._run_fold(arr)
         elif shard_engine is None:
             in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
-            program = self._programs.get(("scatter",), self._build_program)
+            program = self._programs.get(self._scatter_key(),
+                                         self._build_program)
             result = program(
                 arr,
                 jnp.asarray(in_starts),

@@ -1,0 +1,172 @@
+"""Low-precision forward variants behind the ``CHUNKFLOW_PRECISION`` spec.
+
+"Improving Diffusion Model Efficiency Through Patching" (PAPERS.md)
+motivates the patch-size/precision trade-off for exactly this patch-wise
+workload: the convnet forward is the FLOPs side of the roofline, and
+narrowing its compute dtype buys MXU throughput and HBM bandwidth at a
+bounded output-error cost. This module is the single seam where that
+trade is made:
+
+- ``float32`` (default): the wrapper returns the engine's apply
+  UNTOUCHED — the same callable object — so the default path stays
+  bitwise identical to the pre-precision code (the measured-winner rule:
+  no unmeasured variant ships as default).
+- ``bfloat16``: the patch batch and every floating-point parameter leaf
+  are rounded to bfloat16 at the engine boundary; engines built with a
+  bfloat16 compute dtype (``Inferencer(dtype="bfloat16")``) then run
+  their matmuls/convs natively narrow, and float32-dtype engines still
+  see bfloat16-rounded values (the quantization-error model the test
+  suite bounds). The result is cast back to float32.
+- ``int8``: symmetric fake quantization (round-to-nearest-even onto a
+  255-level [-127, 127] grid) of the patch batch and every
+  floating-point parameter leaf, computed in float32 — the standard W8A8
+  simulation. Parameters quantize per-tensor; activations quantize
+  PER-ROW (one scale per patch), which keeps quantization independent of
+  batch composition — the property the packed-serve and mesh bitwise
+  parity contracts rest on. Real int8 matmul kernels are an engine-level
+  concern; this wrapper is supported wherever the engine's parameters
+  are ordinary float arrays, which is every in-repo engine.
+
+What precision does NOT touch: the blend. Accumulation and weight
+buffers stay float32 (``ops/blend.py``), ``normalize_blend``'s uint8
+quantization contract is unchanged, and the packed-serve/mesh parity
+contracts survive — the wrapper replaces the forward uniformly at the
+``Inferencer._forward`` seam, which the serving packer and the sharded
+engine both inherit, so packed-vs-per-chunk and mesh-vs-single stay
+bitwise identical AT EVERY PRECISION (same wrapped forward, same
+replayed accumulation).
+
+Selection: explicit ``Inferencer(precision=...)`` wins (strict —
+unknown values raise); otherwise the ``CHUNKFLOW_PRECISION`` env var,
+resolved once at Inferencer construction (a per-chunk re-read would
+retrace every program on a flip). Unrecognized env values warn ONCE on
+stderr and fall back to float32 — a typo must not silently select a
+quantized path, mirroring the ``CHUNKFLOW_PALLAS`` convention.
+
+Gates: the quantization-error suite (tests/inference/test_precision.py)
+bounds bf16/int8 output error against the float32 reference on the
+identity AND conv engines, including ragged and crop-margin traffic.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Optional
+
+__all__ = ["PRECISIONS", "resolve_precision", "wrap_apply"]
+
+PRECISIONS = ("float32", "bfloat16", "int8")
+
+_ALIASES = {"f32": "float32", "fp32": "float32", "bf16": "bfloat16",
+            "i8": "int8"}
+
+_WARNED_VALUES: set = set()
+
+
+def resolve_precision(value: Optional[str] = None) -> str:
+    """The effective forward precision. An explicit ``value`` is strict
+    (unknown -> ``ValueError``); the ``CHUNKFLOW_PRECISION`` env var is
+    lenient (unknown -> one-time stderr warning, float32)."""
+    if value is not None:
+        v = str(value).lower()
+        v = _ALIASES.get(v, v)
+        if v not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS} (got {value!r})"
+            )
+        return v
+    env = os.environ.get("CHUNKFLOW_PRECISION", "").lower()
+    env = _ALIASES.get(env, env)
+    if env in ("", "float32"):
+        return "float32"
+    if env in PRECISIONS:
+        return env
+    if env not in _WARNED_VALUES:
+        _WARNED_VALUES.add(env)
+        print(
+            f"CHUNKFLOW_PRECISION={os.environ.get('CHUNKFLOW_PRECISION')!r}"
+            f" is not a recognized value (expected one of "
+            f"{'/'.join(PRECISIONS)}); running the float32 default — a "
+            f"typo must not silently select a quantized forward",
+            file=sys.stderr,
+        )
+    return "float32"
+
+
+def _cast_float_leaves(tree, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.floating):
+            return jnp.asarray(leaf, dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def _fake_quant_int8(x, per_row: bool = False):
+    """Symmetric int8 fake quantization in float32: round-to-nearest-even
+    onto the [-127, 127] grid at scale absmax/127 — per-tensor for
+    parameters, PER-ROW (``per_row=True``, one scale per leading-axis
+    entry) for activation batches. Per-row matters for more than
+    accuracy: a per-tensor activation scale would depend on which rows
+    share a batch, breaking the row-independence property the serving
+    packer's and the sharded engine's bitwise parity contracts rest on;
+    with one scale per patch, quantization commutes with batch
+    composition. An all-zero tensor (or row — the packer's filler slots)
+    maps to exact zeros (the eps floor keeps the divide defined)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    if per_row and x.ndim > 1:
+        axes = tuple(range(1, x.ndim))
+        amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    else:
+        amax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(amax, jnp.float32(1e-12)) / jnp.float32(127.0)
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0)
+    return q * scale
+
+
+def _quant_float_leaves(tree):
+    import jax
+    import jax.numpy as jnp
+
+    def quant(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(
+                jnp.asarray(leaf).dtype, jnp.floating):
+            return _fake_quant_int8(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(quant, tree)
+
+
+def wrap_apply(apply: Callable, precision: str) -> Callable:
+    """Wrap an engine ``apply(params, batch)`` for the given precision.
+    ``float32`` returns ``apply`` ITSELF (same object — the bitwise
+    guarantee of the default path); the narrow variants quantize the
+    batch and the float parameter leaves at the boundary and return
+    float32 results for the float32 blend accumulation."""
+    if precision == "float32":
+        return apply
+    if precision == "bfloat16":
+        def bf16_apply(params, batch):
+            import jax.numpy as jnp
+
+            p = _cast_float_leaves(params, jnp.bfloat16)
+            out = apply(p, jnp.asarray(batch, jnp.bfloat16))
+            return jnp.asarray(out, jnp.float32)
+
+        return bf16_apply
+    if precision == "int8":
+        def int8_apply(params, batch):
+            import jax.numpy as jnp
+
+            p = _quant_float_leaves(params)
+            out = apply(p, _fake_quant_int8(batch, per_row=True))
+            return jnp.asarray(out, jnp.float32)
+
+        return int8_apply
+    raise ValueError(f"unknown precision {precision!r}")

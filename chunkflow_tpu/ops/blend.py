@@ -2,11 +2,13 @@
 
 This is the pure function version of the hot loop (reference inferencer.py
 :404-455 + chunk/base.py:792-807, redesigned as one XLA program): scan over
-patch batches, vmap(dynamic_slice) gather, engine forward, bump multiply,
-then one ``lax.scatter_add`` per buffer per batch (or, opt-in, the pallas
-DMA kernel) to accumulate into the output + weight buffers.
-``Inferencer`` runs it per chip; ``parallel.distributed`` wraps it in
-shard_map and psums the buffers over the mesh.
+patch batches, vmap(dynamic_slice) gather, engine forward, then ONE
+per-batch accumulation step — either a pair of runtime-coordinate
+``lax.scatter_add`` ops (bump multiply on the XLA side) or, opt-in, the
+fused Pallas kernel that does bump weighting, aligned-window placement and
+the HBM read-modify-write in a single VMEM-resident pass
+(ops/pallas_blend.py, ISSUE 14). ``Inferencer`` runs it per chip;
+``parallel.engine`` shards the forward and replays the same accumulation.
 """
 from __future__ import annotations
 
@@ -44,17 +46,50 @@ def stacked_scatter_enabled() -> bool:
     )
 
 
-def make_accumulate(output_patch_size: Tuple[int, int, int]):
-    """The ONE per-batch accumulation step: ``accumulate(out, weight,
-    weighted, wpatch, starts) -> (out, weight)`` via runtime-coordinate
-    ``lax.scatter_add`` (or the pallas DMA kernel when selected), plus
-    the ``(pad_y, pad_x)`` buffer padding the pallas path needs.
+def kernel_tag() -> str:
+    """The selected accumulation kernel as a ProgramCache key component:
+    ``"scatter"`` (the XLA default) or ``"fused-on"`` /
+    ``"fused-interpret"`` for the Pallas kernel. Every program family
+    whose accumulation rides :func:`make_accumulate` folds this tag into
+    its cache key, so flipping ``CHUNKFLOW_PALLAS`` mid-stream builds the
+    right program instead of reusing a stale one (the same re-read-per-
+    chunk convention as ``CHUNKFLOW_MESH``)."""
+    from chunkflow_tpu.ops import pallas_blend
+
+    mode = pallas_blend.pallas_mode()
+    return "scatter" if mode == "off" else f"fused-{mode}"
+
+
+def make_accumulate(output_patch_size: Tuple[int, int, int], bump):
+    """The ONE per-batch accumulation step, in two flavors sharing one
+    kernel selection:
+
+    ``accumulate(out, weight, preds, valid, starts) -> (out, weight)``
+        takes RAW engine predictions; the bump-weight multiply
+        (``preds * bump * valid``) and the weight-patch contribution
+        (``bump * valid``) happen inside the step — on the XLA leg as
+        elementwise ops feeding ``lax.scatter_add``, on the Pallas leg
+        inside the fused kernel's VMEM pass (no weighted / weight-patch /
+        padded stack is ever materialized).
+
+    ``accumulate_weighted(out, weight, weighted, valid, starts)``
+        takes an ALREADY-weighted stack (the serving packer's forward
+        program and the sharded engine's all_gathered stacks apply
+        ``bump*valid`` on their own dispatch); only the weight-buffer
+        contribution ``bump * valid`` is computed inside.
+
+    Returns ``(accumulate, accumulate_weighted, pad_y, pad_x)`` where
+    ``(pad_y, pad_x)`` is the aligned-window buffer padding the Pallas
+    kernel needs (zero on the XLA leg).
 
     Factored out of :func:`build_local_blend` so the serving packer's
-    scatter program (chunkflow_tpu/serve/packer.py) replays *exactly*
-    the accumulation the fused per-chunk program runs — same kernel
-    selection, same dimension numbers, same per-batch grouping — which
-    is what makes packed-vs-per-chunk outputs bit-identical."""
+    scatter program (chunkflow_tpu/serve/packer.py) and the sharded
+    engine's replay (chunkflow_tpu/parallel/engine.py) run *exactly* the
+    accumulation the fused per-chunk program runs — same kernel
+    selection, same weighting expressions, same per-batch grouping —
+    which is what makes packed-vs-per-chunk and mesh-vs-single outputs
+    bit-identical."""
+    import jax.numpy as jnp
     from jax import lax
 
     from chunkflow_tpu.ops import pallas_blend
@@ -64,6 +99,24 @@ def make_accumulate(output_patch_size: Tuple[int, int, int]):
     pad_y, pad_x = (
         pallas_blend.buffer_padding(pout) if mode != "off" else (0, 0)
     )
+    bump = jnp.asarray(bump)
+
+    if mode != "off":
+        interp = mode == "interpret"
+
+        def accumulate(out, weight, preds, valid, starts):
+            return pallas_blend.fused_accumulate_patches(
+                out, weight, preds, valid, bump, starts,
+                pre_weighted=False, interpret=interp,
+            )
+
+        def accumulate_weighted(out, weight, weighted, valid, starts):
+            return pallas_blend.fused_accumulate_patches(
+                out, weight, weighted, valid, bump, starts,
+                pre_weighted=True, interpret=interp,
+            )
+
+        return accumulate, accumulate_weighted, pad_y, pad_x
 
     dnums4 = lax.ScatterDimensionNumbers(
         update_window_dims=(1, 2, 3, 4),
@@ -76,17 +129,24 @@ def make_accumulate(output_patch_size: Tuple[int, int, int]):
         scatter_dims_to_operand_dims=(0, 1, 2),
     )
 
-    def accumulate(out, weight, weighted, wpatch, starts):
-        if mode != "off":
-            return pallas_blend.accumulate_patches(
-                out, weight, weighted, wpatch, starts,
-                interpret=(mode == "interpret"),
-            )
+    def _scatter(out, weight, weighted, wpatch, starts):
         out = lax.scatter_add(out, starts, weighted, dnums4)
         weight = lax.scatter_add(weight, starts, wpatch, dnums3)
         return out, weight
 
-    return accumulate, pad_y, pad_x
+    def accumulate(out, weight, preds, valid, starts):
+        # the same weighting expression, in the same order, the fused
+        # kernel computes in VMEM — (preds * bump) * valid
+        weighted = preds * bump[None, None] \
+            * valid[:, None, None, None, None]
+        wpatch = bump[None] * valid[:, None, None, None]
+        return _scatter(out, weight, weighted, wpatch, starts)
+
+    def accumulate_weighted(out, weight, weighted, valid, starts):
+        wpatch = bump[None] * valid[:, None, None, None]
+        return _scatter(out, weight, weighted, wpatch, starts)
+
+    return accumulate, accumulate_weighted, pad_y, pad_x
 
 
 def build_local_blend(
@@ -109,19 +169,14 @@ def build_local_blend(
     co = num_output_channels
     pin = tuple(input_patch_size)
     pout = tuple(output_patch_size)
-    bump = jnp.asarray(bump)
-
-    from chunkflow_tpu.ops import pallas_blend
-
-    mode = pallas_blend.pallas_mode()
 
     # the shared per-batch accumulation step (and the (8,128)-aligned
     # buffer padding the pallas kernel needs, cropped after the scan)
-    accumulate, pad_y, pad_x = make_accumulate(pout)
+    accumulate, _, pad_y, pad_x = make_accumulate(pout, bump)
 
-    # Stacking every weighted prediction and accumulating ONCE (vs once per
-    # scan batch) removes the per-batch full-buffer traffic on paper — but
-    # on the real chip it measured 0.66 Mvox/s vs 1.48 for the per-batch
+    # Stacking every prediction and accumulating ONCE (vs once per scan
+    # batch) removes the per-batch full-buffer traffic on paper — but on
+    # the real chip it measured 0.66 Mvox/s vs 1.48 for the per-batch
     # scatter (overlapping runtime-coordinate scatter windows serialize),
     # so it is OPT-IN (CHUNKFLOW_BLEND_STACKED=1) and additionally gated by
     # predicted stack size so jumbo chunks (e.g. 108x2048x2048 production
@@ -129,14 +184,12 @@ def build_local_blend(
     stack_max_bytes = stack_budget_bytes()
     use_stacked = stacked_scatter_enabled()
 
-    # Per-patch f32 bytes the stacked path keeps alive: the prediction
-    # stack plus the equal-footprint weight-patch stack, and on the pallas
-    # path additionally their (8,128)-aligned padded copies (up to several
-    # x wider for small patches).
-    patch_bytes = (co + 1) * pout[0] * pout[1] * pout[2] * 4
-    if mode != "off":
-        py_pad, px_pad = pallas_blend.padded_patch_shape(pout[1], pout[2])
-        patch_bytes += (co + 1) * pout[0] * py_pad * px_pad * 4
+    # Per-patch f32 bytes the stacked path keeps alive: the raw
+    # prediction stack, plus (XLA leg only) the weighted copy and the
+    # weight-patch stack the scatter consumes; the fused kernel
+    # materializes neither, but the conservative bound is kept for both
+    # legs so the budget decision cannot flip with the kernel selection.
+    patch_bytes = (2 * co + 1) * pout[0] * pout[1] * pout[2] * 4
 
     @contract(
         chunk=Spec(None, "z", "y", "x"),
@@ -155,24 +208,24 @@ def build_local_blend(
         def forward_batch(b):
             i0 = b * batch_size
             s_in = lax.dynamic_slice(in_starts, (i0, 0), (batch_size, 3))
-            v = lax.dynamic_slice(valid, (i0,), (batch_size,))
             patches = jax.vmap(
                 lambda s: lax.dynamic_slice(
                     chunk, (0, s[0], s[1], s[2]), (ci,) + pin
                 )
             )(s_in)
-            preds = forward(params, patches)
-            return preds * bump[None, None] * v[:, None, None, None, None]
+            # RAW predictions: the bump*valid weighting lives inside the
+            # accumulation step (fused into the kernel's VMEM pass on
+            # the Pallas leg)
+            return forward(params, patches)
 
         if use_stacked and n * patch_bytes <= stack_max_bytes:
-            _, all_w = lax.scan(
+            _, all_preds = lax.scan(
                 lambda c, b: (c, forward_batch(b)),
                 None,
                 jnp.arange(num_batches),
             )
-            all_w = all_w.reshape((n, co) + pout)
-            all_wp = bump[None] * valid[:, None, None, None]
-            out, weight = accumulate(out0, w0, all_w, all_wp, out_starts)
+            all_preds = all_preds.reshape((n, co) + pout)
+            out, weight = accumulate(out0, w0, all_preds, valid, out_starts)
         else:
             def step(carry, b):
                 out, weight = carry
@@ -181,11 +234,8 @@ def build_local_blend(
                     out_starts, (i0, 0), (batch_size, 3)
                 )
                 v = lax.dynamic_slice(valid, (i0,), (batch_size,))
-                weighted = forward_batch(b)
-                wpatch = bump[None] * v[:, None, None, None]
-                out, weight = accumulate(
-                    out, weight, weighted, wpatch, s_out
-                )
+                preds = forward_batch(b)
+                out, weight = accumulate(out, weight, preds, v, s_out)
                 return (out, weight), None
 
             (out, weight), _ = lax.scan(

@@ -1,35 +1,56 @@
-"""Pallas TPU kernel: bump-weighted patch accumulation into the chunk buffer.
+"""Fused Pallas TPU kernel: bump weighting + aligned-window placement +
+overlap-add accumulation in one VMEM-resident pass (ISSUE 14).
 
-The fused inference program's scatter-add (ops/blend.py) is, per patch, a
-read-modify-write of a [co, *pout] region of the HBM-resident output buffer
-plus the same for the weight buffer. The XLA path expresses it as one
-``lax.scatter_add`` per batch; this kernel does the same job as one
-``pallas_call`` over a (B, co, pz) grid with explicit HBM<->VMEM DMAs:
+Before this kernel the blend hot loop was three separate device legs:
 
-- the output/weight buffers stay in HBM (``pl.ANY``) and are aliased
-  in-place (``input_output_aliases``), so no full-buffer copies;
-- per grid step one (8,128)-aligned window covering the patch tile rides
-  DMA into VMEM scratch, the pre-weighted prediction tile (pre-scattered
-  into the same aligned window on the XLA side) is added, and the window
-  rides back — Mosaic requires DMA slice corners provably divisible by
-  the (8,128) tiling, which raw patch strides do not satisfy;
-- the TPU grid is sequential, so overlapping patches accumulate without
-  races — the property the reference gets from its Python loop
-  (chunk/base.py:792-807) and the XLA path gets from scatter-add's
-  defined duplicate-index semantics.
+1. the bump-weight multiply (``preds * bump * valid``) materialized a
+   weighted prediction stack AND a weight-patch stack in HBM
+   (``ops/blend.py`` ``forward_batch``);
+2. an XLA-side ``vmap(dynamic_update_slice)`` pre-scattered each patch
+   into its (8,128)-aligned zero-padded window — materializing BOTH
+   padded stacks (up to several x wider than the patch for small
+   patches) in HBM;
+3. the DMA kernel re-read the padded stacks and did the HBM
+   read-modify-write.
 
-Selection: opt-in via CHUNKFLOW_PALLAS=1 (unmeasured paths don't get to be
-defaults — see pallas_mode); tests run it in interpret mode on CPU
-(CHUNKFLOW_PALLAS=interpret).
+The fused kernel takes the RAW engine predictions, the validity vector
+and the bump constant, and does weighting, placement and the HBM
+read-modify-write per grid step entirely in VMEM: the bump map rides
+VMEM once for the whole grid (constant-index block — the pipeline skips
+the re-copy when the block index does not change), the per-patch
+prediction tile streams in at its raw (unpadded) size, and the only HBM
+traffic left is the aligned-window read-modify-write the accumulation
+fundamentally needs. Nothing is pre-scattered; no weighted, weight-patch
+or padded stack exists anymore.
+
+Alignment rules are unchanged from the round-1 hardware failure: Mosaic
+requires DMA slice corners in the two minor dims *provably* divisible by
+the (8,128) tiling, so the kernel DMAs aligned windows
+(``pl.multiple_of`` hints) and adds the contribution at its (dy, dx)
+offset *inside* the VMEM scratch window. The TPU grid is sequential, so
+overlapping patches accumulate without races, in ascending patch order —
+the same duplicate-update order ``lax.scatter_add`` applies, which is
+what makes the float32 fused path BITWISE identical to the XLA scatter
+path (asserted across the parity matrix in tests/ops/test_pallas_blend.py).
+
+Selection: opt-in via CHUNKFLOW_PALLAS=1 (unmeasured paths don't get to
+be defaults — see pallas_mode); tests run it in interpret mode on CPU
+(CHUNKFLOW_PALLAS=interpret). ``tools/tpu_validation.py
+bench_blend_fused`` stamps the fused-vs-scatter on-chip row.
 """
 from __future__ import annotations
 
 import os
+import sys
 from typing import Tuple
 
 from chunkflow_tpu.core.contracts import Spec, contract
 
 Triple = Tuple[int, int, int]
+
+_ON_VALUES = ("1", "on", "true", "force")
+_OFF_VALUES = ("", "0", "off", "false", "no")
+_WARNED_VALUES: set = set()
 
 
 def pallas_mode() -> str:
@@ -43,15 +64,27 @@ def pallas_mode() -> str:
     on TPU: the kernel compiles and passes its oracle on the chip but has
     no steady-state throughput number yet, and the measured-winner rule
     (docs/performance.md — never ship an unmeasured blend path as default)
-    applies until bench_tpu_bf16_pallas beats the XLA scatter on hardware.
+    applies until bench_blend_fused beats the XLA scatter on hardware.
+
+    Unrecognized values resolve to OFF — a typo must not force-select the
+    compiled Mosaic kernel on a CPU box — but warn ONCE on stderr: a
+    mistyped opt-in (``CHUNKFLOW_PALLAS=ture``) must not silently run the
+    slow path either.
     """
     env = os.environ.get("CHUNKFLOW_PALLAS", "").lower()
     if env == "interpret":
         return "interpret"
-    if env in ("1", "on", "true", "force"):
+    if env in _ON_VALUES:
         return "on"
-    # everything else — unset, explicit off, or a typo — is off: a typo
-    # must not force-select the compiled Mosaic kernel on a CPU box
+    if env not in _OFF_VALUES and env not in _WARNED_VALUES:
+        _WARNED_VALUES.add(env)
+        print(
+            f"CHUNKFLOW_PALLAS={os.environ.get('CHUNKFLOW_PALLAS')!r} is "
+            f"not a recognized value (expected one of "
+            f"0/off/false/no, 1/on/true/force, interpret); treating it as "
+            f"OFF — the XLA scatter path runs, not the fused Pallas kernel",
+            file=sys.stderr,
+        )
     return "off"
 
 
@@ -59,8 +92,8 @@ def pallas_mode() -> str:
 # memref must be *provably* divisible by these (round-1 hardware failure:
 # "Failed to prove that a tile index in dimension 2 is divisible by the
 # tiling (8)"). Patch strides carry no such guarantee, so the kernel only
-# ever DMAs windows whose corners are rounded down to this alignment; the
-# patch is pre-scattered into its aligned window on the XLA side.
+# ever DMAs windows whose corners are rounded down to this alignment and
+# places the patch at its (dy, dx) offset inside the VMEM window.
 _SUBLANE = 8
 _LANE = 128
 
@@ -88,60 +121,79 @@ def buffer_padding(pout: Triple) -> Tuple[int, int]:
     out=Spec("co", "z", "y", "x", dtype="float32"),
     weight=Spec("z", "y", "x", dtype="float32"),
     preds=Spec("b", "co", "pz", "py", "px", dtype="float32"),
-    wpatches=Spec("b", "pz", "py", "px", dtype="float32"),
+    valid=Spec("b", dtype="float32"),
+    bump=Spec("pz", "py", "px", dtype="float32"),
     out_starts=Spec("b", 3, dtype="int32"),
 )
-def accumulate_patches(out, weight, preds, wpatches, out_starts,
-                       interpret: bool = False):
-    """out[:, s:s+p] += preds[b]; weight[s:s+p] += wpatches[b] for every b.
+def fused_accumulate_patches(out, weight, preds, valid, bump, out_starts,
+                             pre_weighted: bool = False,
+                             interpret: bool = False):
+    """out[:, s:s+p] += preds[b]*bump*valid[b]; weight[s:s+p] +=
+    bump*valid[b] for every b — weighting, placement and HBM RMW fused.
 
     out:      [co, Z, Y+pad, X+pad] f32  (donated, updated in place;
               padded per ``buffer_padding`` — caller crops afterwards)
     weight:   [Z, Y+pad, X+pad] f32      (donated, updated in place)
-    preds:    [B, co, pz, py, px] f32, already bump*validity weighted
-    wpatches: [B, pz, py, px] f32
+    preds:    [B, co, pz, py, px] f32 RAW engine predictions — or, with
+              ``pre_weighted=True`` (the serving replay, whose forward
+              program already applied ``bump*valid`` on another
+              dispatch), the already-weighted stack, added as-is
+    valid:    [B] f32 validity (0.0 for batch-padding rows)
+    bump:     [pz, py, px] f32 — one constant-index block, VMEM-resident
+              for the whole grid
     out_starts: [B, 3] int32 zyx corners (within-bounds, batch-padded)
     """
     import jax
     import jax.numpy as jnp
-    from jax import lax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, co, pz, py, px = preds.shape
     py_pad, px_pad = padded_patch_shape(py, px)
 
-    # Aligned window corner per patch + the patch's offset within it.
+    # Aligned window corner per patch + the patch's offset within it —
+    # scalar work only; no per-patch tensor is materialized anymore.
     z0 = out_starts[:, 0]
     y0a = (out_starts[:, 1] // _SUBLANE) * _SUBLANE
     x0a = (out_starts[:, 2] // _LANE) * _LANE
     starts_aligned = jnp.stack([z0, y0a, x0a], axis=1)
-    dyx = jnp.stack([out_starts[:, 1] - y0a, out_starts[:, 2] - x0a], axis=1)
+    dyx = jnp.stack([out_starts[:, 1] - y0a, out_starts[:, 2] - x0a],
+                    axis=1)
+    # scalar-prefetch memory holds 32-bit scalars; 2D shape per the
+    # Mosaic SMEM convention
+    valid2 = valid.reshape(B, 1)
 
-    # Pre-scatter each patch into its zero-padded aligned window (VPU work
-    # fused by XLA into the producing bump-multiply).
-    def place(patch, d):
-        padded = jnp.zeros(patch.shape[:-2] + (py_pad, px_pad), patch.dtype)
-        at = (0,) * (patch.ndim - 2) + (d[0], d[1])
-        return lax.dynamic_update_slice(padded, patch, at)
-
-    preds_pad = jax.vmap(place)(preds, dyx)
-    wpatches_pad = jax.vmap(place)(wpatches, dyx)
-
-    def kernel(starts_ref, preds_ref, wpatch_ref, out_in, w_in, out_ref,
-               w_ref, scratch, sem_in, sem_out):
+    def kernel(starts_ref, dyx_ref, valid_ref, preds_ref, bump_ref,
+               out_in, w_in, out_ref, w_ref, scratch, sem_in, sem_out):
         b = pl.program_id(0)
         c = pl.program_id(1)
         k = pl.program_id(2)
         z0 = starts_ref[b, 0]
         y0 = pl.multiple_of(starts_ref[b, 1], _SUBLANE)
         x0 = pl.multiple_of(starts_ref[b, 2], _LANE)
+        dy = dyx_ref[b, 0]
+        dx = dyx_ref[b, 1]
+        v = valid_ref[b, 0]
+        pred = preds_ref[0, 0, 0]   # [py, px], the raw tile
+        bmp = bump_ref[k]           # [py, px] plane of the resident block
+
+        # weighting in-kernel: same expression, same order, as the XLA
+        # scatter leg's (preds * bump) * valid — bitwise equal f32 ops
+        if pre_weighted:
+            contrib = pred
+        else:
+            contrib = pred * bmp * v
 
         tile = out_ref.at[c, z0 + k, pl.ds(y0, py_pad), pl.ds(x0, px_pad)]
         load = pltpu.make_async_copy(tile, scratch, sem_in)
         load.start()
         load.wait()
-        scratch[:] = scratch[:] + preds_ref[0, 0, 0]
+        # placement fused into the RMW: add at the (dy, dx) offset inside
+        # the VMEM window; cells outside the patch are left untouched
+        # (bitwise what scatter-add does for them)
+        scratch[pl.ds(dy, py), pl.ds(dx, px)] = (
+            scratch[pl.ds(dy, py), pl.ds(dx, px)] + contrib
+        )
         store = pltpu.make_async_copy(scratch, tile, sem_out)
         store.start()
         store.wait()
@@ -152,21 +204,30 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
             wload = pltpu.make_async_copy(wtile, scratch, sem_in)
             wload.start()
             wload.wait()
-            scratch[:] = scratch[:] + wpatch_ref[0, 0]
+            # the weight-patch contribution is computed in-register from
+            # the resident bump block — no wpatch stack exists anymore
+            scratch[pl.ds(dy, py), pl.ds(dx, px)] = (
+                scratch[pl.ds(dy, py), pl.ds(dx, px)] + bmp * v
+            )
             wstore = pltpu.make_async_copy(scratch, wtile, sem_out)
             wstore.start()
             wstore.wait()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=3,
         grid=(B, co, pz),
         in_specs=[
+            # raw prediction tile, streamed per grid step at patch size
             pl.BlockSpec(
-                (1, 1, 1, py_pad, px_pad),
-                lambda b, c, k, starts: (b, c, k, 0, 0),
+                (1, 1, 1, py, px),
+                lambda b, c, k, *prefetch: (b, c, k, 0, 0),
             ),
+            # the bump map as ONE constant-index block: fetched once,
+            # VMEM-resident for the whole grid (the pipeline elides the
+            # copy when the block index does not change)
             pl.BlockSpec(
-                (1, 1, py_pad, px_pad), lambda b, c, k, starts: (b, k, 0, 0)
+                (pz, py, px),
+                lambda b, c, k, *prefetch: (0, 0, 0),
             ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
@@ -189,9 +250,9 @@ def accumulate_patches(out, weight, preds, wpatches, out_starts,
             jax.ShapeDtypeStruct(out.shape, out.dtype),
             jax.ShapeDtypeStruct(weight.shape, weight.dtype),
         ],
-        # tensor inputs (after the scalar-prefetch arg): preds_pad,
-        # wpatches_pad, out, weight -> indices 1..4; alias out->output0,
+        # inputs (scalar-prefetch args count): starts_aligned 0, dyx 1,
+        # valid 2, preds 3, bump 4, out 5, weight 6 -> alias out->output0,
         # weight->output1
-        input_output_aliases={3: 0, 4: 1},
+        input_output_aliases={5: 0, 6: 1},
         interpret=interpret,
-    )(starts_aligned, preds_pad, wpatches_pad, out, weight)
+    )(starts_aligned, dyx, valid2, preds, bump, out, weight)

@@ -368,17 +368,18 @@ class ShardedEngine:
     # ------------------------------------------------------------------
     def _make_blend_parts(self):
         """The pieces shared with the single-device program: bump map,
-        the per-batch accumulation step (same kernel selection / dnums /
-        grouping — ops.blend.make_accumulate) and normalize."""
-        import jax.numpy as jnp
-
-        from chunkflow_tpu.inference.bump import bump_map
+        the per-batch accumulation step (same kernel selection —
+        XLA scatter or the fused Pallas kernel — same dnums, same
+        grouping: ops.blend.make_accumulate, the weighted flavor since
+        the all_gathered stacks already carry bump*valid) and
+        normalize."""
+        from chunkflow_tpu.inference.bump import bump_const
         from chunkflow_tpu.ops.blend import make_accumulate, normalize_blend
 
         pout = self.output_patch_size
-        bump = jnp.asarray(bump_map(pout))
-        accumulate, pad_y, pad_x = make_accumulate(pout)
-        return bump, accumulate, pad_y, pad_x, normalize_blend
+        bump = bump_const(pout)
+        _, accumulate_weighted, pad_y, pad_x = make_accumulate(pout, bump)
+        return bump, accumulate_weighted, pad_y, pad_x, normalize_blend
 
     def _forward_scan(self, bump):
         """Per-device gather+forward over local patch batches. Returns
@@ -427,9 +428,11 @@ class ShardedEngine:
     def _replay(self, accumulate, bump, zyx, pad_y, pad_x, n_ref,
                 normalize_blend):
         """The reference accumulation, replayed verbatim: scan batches of
-        B over the global-order weighted stack and scatter-add with the
-        shared accumulate step, then normalize. Runs replicated on every
-        chip (outputs are identical by construction)."""
+        B over the global-order weighted stack and accumulate with the
+        shared (weighted-flavor) step — XLA scatter-add or the fused
+        Pallas kernel, whichever ``make_accumulate`` selected — then
+        normalize. Runs replicated on every chip (outputs are identical
+        by construction)."""
         import jax.numpy as jnp
         from jax import lax
 
@@ -441,7 +444,6 @@ class ShardedEngine:
         out_dtype = self.out_dtype
 
         def replay(weighted, valid, out_starts):
-            wpatch_all = bump[None] * valid[:, None, None, None]
             out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
             w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
 
@@ -450,10 +452,9 @@ class ShardedEngine:
                 i0 = b * B
                 w = lax.dynamic_slice(
                     weighted, (i0, 0, 0, 0, 0), (B, co) + pout)
-                wp = lax.dynamic_slice(
-                    wpatch_all, (i0, 0, 0, 0), (B,) + pout)
+                v = lax.dynamic_slice(valid, (i0,), (B,))
                 s_out = lax.dynamic_slice(out_starts, (i0, 0), (B, 3))
-                out, weight = accumulate(out, weight, w, wp, s_out)
+                out, weight = accumulate(out, weight, w, v, s_out)
                 return (out, weight), None
 
             (out, weight), _ = lax.scan(
@@ -638,16 +639,14 @@ class ShardedEngine:
         forward = self.forward
 
         def build():
-            import jax.numpy as jnp
-
-            from chunkflow_tpu.inference.bump import bump_map
+            from chunkflow_tpu.inference.bump import bump_const
 
             devices = self._devices
             if devices is None:
                 devices = jax.local_devices()
             devices = np.asarray(devices).reshape(-1)[:n_chips]
             mesh = Mesh(devices, ("data",))
-            bump = jnp.asarray(bump_map(self.output_patch_size))
+            bump = bump_const(self.output_patch_size)
 
             def device_fn(patches, valid, params):
                 # the same weighting expression, in the same order, as
@@ -711,6 +710,13 @@ class ShardedEngine:
     def _run_local(self, arr, grid: PatchGrid, params):
         import jax.numpy as jnp
 
+        from chunkflow_tpu.ops.blend import kernel_tag
+
+        # the accumulation-kernel selection is part of the program key
+        # (the CHUNKFLOW_PALLAS flip convention; no suffix for the XLA
+        # default keeps the historical key strings)
+        tag = kernel_tag()
+        kernel_key = () if tag == "scatter" else (tag,)
         B = self.batch_size
         chunk_shape = tuple(arr.shape)
         if self.spec.kind == "data":
@@ -719,7 +725,8 @@ class ShardedEngine:
             n_pad_g = len(valid)
             n_ref = grid.num_patches + (-grid.num_patches % B)
             program = self.programs.get(
-                ("shard", "data", n_dev, chunk_shape, n_pad_g),
+                ("shard", "data", n_dev, chunk_shape, n_pad_g)
+                + kernel_key,
                 lambda: self._build_data_program(chunk_shape, n_pad_g,
                                                  n_ref),
             )
@@ -746,7 +753,7 @@ class ShardedEngine:
         padded_shape = tuple(arr.shape)
         program = self.programs.get(
             ("shard", "spatial", (ny, nx), padded_shape, part.per_dev,
-             len(part.valid)),
+             len(part.valid)) + kernel_key,
             lambda: self._build_spatial_program(
                 padded_shape, geometry, part.per_dev, len(part.valid)
             ),

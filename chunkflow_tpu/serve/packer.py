@@ -29,12 +29,16 @@ same grouping:
    filler rows are discarded;
 3. *per-task scatter program* (``("serve_scatter", run_shape)`` — keyed
    by the PR 2 compile-cache shape bucket, so ragged chunks that bucket
-   together share one trace): rebuilds the task's ``[n_pad, ...]``
+   together share one trace; ``("serve_scatter_fused", run_shape, tag)``
+   when the fused Pallas kernel is selected, so a CHUNKFLOW_PALLAS flip
+   rebuilds rather than reuses): rebuilds the task's ``[n_pad, ...]``
    weighted stack (missing = padding rows are exact zeros, which is
    bitwise what the fused program scatter-adds for validity-0 entries),
    then replays the *same* scan-over-batches accumulation — same
-   ``ops.blend.make_accumulate`` step, same batch grouping, same order —
-   and the same ``normalize_blend``.
+   ``ops.blend.make_accumulate`` step (the weighted flavor: weight-patch
+   contributions computed inside the step, in the fused kernel's VMEM
+   pass when selected), same batch grouping, same order — and the same
+   ``normalize_blend``.
 
 Provenance: every queued patch carries its request and patch index; the
 dispatcher writes each forward row back into its request's stack, so a
@@ -363,9 +367,9 @@ class PatchPacker:
             import jax
             import jax.numpy as jnp
 
-            from chunkflow_tpu.inference.bump import bump_map
+            from chunkflow_tpu.inference.bump import bump_const
 
-            bump = jnp.asarray(bump_map(tuple(inf.output_patch_size)))
+            bump = bump_const(tuple(inf.output_patch_size))
 
             def program(patches, valid, params):
                 preds = inf._forward(params, patches)
@@ -388,7 +392,7 @@ class PatchPacker:
             import jax.numpy as jnp
             from jax import lax
 
-            from chunkflow_tpu.inference.bump import bump_map
+            from chunkflow_tpu.inference.bump import bump_const
             from chunkflow_tpu.ops.blend import (
                 make_accumulate,
                 normalize_blend,
@@ -397,16 +401,18 @@ class PatchPacker:
             pout = tuple(inf.output_patch_size)
             co = inf.num_output_channels
             B = self.batch_size
-            bump = jnp.asarray(bump_map(pout))
-            accumulate, pad_y, pad_x = make_accumulate(pout)
+            bump = bump_const(pout)
+            # the weighted flavor: the forward program already applied
+            # bump*valid to these rows; the weight-buffer contribution
+            # (bump * validity, f32) is computed inside the step — in
+            # the fused Pallas kernel's VMEM pass when selected
+            _, accumulate_weighted, pad_y, pad_x = make_accumulate(
+                pout, bump)
             out_dtype = inf.output_dtype
             zyx_buf = (run_zyx[0], run_zyx[1] + pad_y, run_zyx[2] + pad_x)
             num_batches = n_pad // B
 
             def program(weighted, valid, out_starts):
-                # wpatch computed on device exactly as the fused
-                # program's step does (bump * validity, f32)
-                wpatch_all = bump[None] * valid[:, None, None, None]
                 out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
                 w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
 
@@ -415,10 +421,10 @@ class PatchPacker:
                     i0 = b * B
                     w = lax.dynamic_slice(
                         weighted, (i0, 0, 0, 0, 0), (B, co) + pout)
-                    wp = lax.dynamic_slice(
-                        wpatch_all, (i0, 0, 0, 0), (B,) + pout)
+                    v = lax.dynamic_slice(valid, (i0,), (B,))
                     s_out = lax.dynamic_slice(out_starts, (i0, 0), (B, 3))
-                    out, weight = accumulate(out, weight, w, wp, s_out)
+                    out, weight = accumulate_weighted(
+                        out, weight, w, v, s_out)
                     return (out, weight), None
 
                 (out, weight), _ = lax.scan(
@@ -433,7 +439,12 @@ class PatchPacker:
             # after the call (GL005): donate it
             return jax.jit(program, donate_argnums=(0,))
 
-        return inf._programs.get(("serve_scatter", tuple(run_zyx)), build)
+        from chunkflow_tpu.ops.blend import kernel_tag
+
+        tag = kernel_tag()
+        key = (("serve_scatter", tuple(run_zyx)) if tag == "scatter"
+               else ("serve_scatter_fused", tuple(run_zyx), tag))
+        return inf._programs.get(key, build)
 
     def _loop(self) -> None:
         while True:

@@ -186,6 +186,20 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py multichip_overlap --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "multichip overlap gate"
 
+# --- fused blend gate ---------------------------------------------------------
+# Fused blend data movement (weighting + aligned-window placement + RMW in
+# one pass) vs the separate-leg structure it replaced, as compiled XLA
+# proxies of both structures (docs/performance.md "The fused Pallas blend
+# kernel"). The run asserts bit-identity across both proxies, the XLA
+# scatter reference AND the real fused Pallas kernel in interpret mode,
+# and that both legs carry roofline rows in programs.json; reports the
+# >=1.2x target as gate_pass (asserted slow-marked in tests/test_bench.py);
+# the process only fails below 1.1x.
+echo "== fused blend gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py blend_fused --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "fused blend gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

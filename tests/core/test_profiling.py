@@ -317,6 +317,70 @@ def test_log_summary_renders_device_programs_table(clean_plane, tmp_path,
     assert "42.0%" in out
 
 
+def test_device_programs_rank_by_lost_seconds(clean_plane):
+    """ISSUE 14 satellite: the DEVICE PROGRAMS ranking key is lost
+    seconds ((dispatch_wall − roofline_s) × calls) — the family
+    furthest above its cost-model floor leads, regardless of compile
+    time; entries without a roofline fall back behind, by compile
+    time."""
+    from chunkflow_tpu.flow.log_summary import summarize_programs
+
+    events = [
+        {"kind": "programs", "name": "program/catalog", "worker": "w1",
+         "t": 2.0, "programs": [
+             # slow compile but NEAR its floor: little to win
+             {"family": "fold", "key": "", "compile_s": 9.0,
+              "exec_mean_s": 0.010, "roofline_s": 0.009,
+              "lost_s": 0.01, "roofline_util": 0.9},
+             # fast compile but far above its floor over many calls:
+             # the fusion target
+             {"family": "scatter", "key": "", "compile_s": 0.2,
+              "exec_mean_s": 0.050, "roofline_s": 0.005,
+              "lost_s": 4.5, "roofline_util": 0.1},
+             # no roofline figure at all: ranks behind both
+             {"family": "mystery", "key": "", "compile_s": 1.0},
+         ]},
+    ]
+    programs = summarize_programs(events)
+    assert [p["family"] for p in programs] == \
+        ["scatter", "fold", "mystery"]
+
+
+def test_stamp_cost_wins_over_xla_cost_analysis(clean_plane, tmp_path):
+    """profiling.stamp_cost: an analytic cost model attached to a
+    program (Pallas custom calls / loop bodies are opaque to XLA's
+    cost_analysis) is what the ledger scores — and lost_s derives from
+    it."""
+    import jax
+
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.core.compile_cache import ProgramCache
+
+    telemetry.configure(str(tmp_path))
+    try:
+        cache = ProgramCache(label="stamped")
+        program = cache.get(
+            ("stamped_family",),
+            lambda: profiling.stamp_cost(
+                jax.jit(lambda x: x * 2.0), flops=123.0,
+                bytes_accessed=4.5e8),
+        )
+        import jax.numpy as jnp
+
+        out = program(jnp.ones((4,)))
+        out.block_until_ready()
+        program(jnp.ones((4,))).block_until_ready()
+        entry = {e["family"]: e for e in profiling.catalog()}[
+            "stamped_family"]
+        assert entry["flops"] == 123.0
+        assert entry["bytes_accessed"] == 4.5e8
+        assert entry["roofline_s"] is not None
+        assert entry["lost_s"] is not None and entry["lost_s"] >= 0.0
+    finally:
+        telemetry.flush()
+        telemetry.configure(None)
+
+
 def test_program_counters_reach_cloud_watch(clean_plane):
     """Satellite: program_* counters flow through the CloudWatch
     publisher with no new mapping code (and the seconds counter gets a

@@ -1,0 +1,263 @@
+"""Quantization-error gates for the CHUNKFLOW_PRECISION forward variants
+(ISSUE 14): bf16/int8 output error against the float32 reference stays
+under stated bounds on both the identity and conv engines (incl. ragged
+and crop-margin traffic), float32 stays bitwise untouched, and the
+packed-serve / mesh parity contracts survive at every precision."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.inference import engines
+from chunkflow_tpu.inference.inferencer import Inferencer
+from chunkflow_tpu.inference.precision import (
+    PRECISIONS,
+    resolve_precision,
+    wrap_apply,
+)
+
+PIN = (4, 16, 16)
+OVERLAP = (2, 8, 8)
+
+# Stated error bounds (max abs error of normalized [0,1]-scale outputs
+# vs the float32 reference; measured headroom ~2-3x on both engines):
+# bf16 rounds params+activations to 8 mantissa bits; int8 is symmetric
+# per-tensor W8A8 fake quantization on a 255-level grid.
+MAX_ABS_ERR = {"bfloat16": 0.02, "int8": 0.05}
+MEAN_ERR = {"bfloat16": 0.005, "int8": 0.01}
+
+
+# ---------------------------------------------------------------------------
+# spec resolution
+# ---------------------------------------------------------------------------
+def test_resolve_precision_defaults_and_aliases(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_PRECISION", raising=False)
+    assert resolve_precision() == "float32"
+    assert resolve_precision("bf16") == "bfloat16"
+    assert resolve_precision("FP32") == "float32"
+    assert resolve_precision("i8") == "int8"
+    for p in PRECISIONS:
+        assert resolve_precision(p) == p
+    monkeypatch.setenv("CHUNKFLOW_PRECISION", "int8")
+    assert resolve_precision() == "int8"
+
+
+def test_resolve_precision_explicit_is_strict():
+    with pytest.raises(ValueError, match="precision"):
+        resolve_precision("float16")
+
+
+def test_resolve_precision_env_typo_warns_once(monkeypatch, capsys):
+    """A mistyped CHUNKFLOW_PRECISION must not silently select a
+    quantized path: one stderr warning, float32 fallback."""
+    from chunkflow_tpu.inference import precision as precision_mod
+
+    monkeypatch.setattr(precision_mod, "_WARNED_VALUES", set())
+    monkeypatch.setenv("CHUNKFLOW_PRECISION", "bfloat61")
+    assert resolve_precision() == "float32"
+    err = capsys.readouterr().err
+    assert "bfloat61" in err and "not a recognized value" in err
+    assert resolve_precision() == "float32"
+    assert capsys.readouterr().err == ""
+
+
+def test_float32_wrap_is_identity_object():
+    """The float32 default returns the engine apply ITSELF — the
+    bitwise guarantee of the default path is structural, not numeric."""
+    def apply(params, batch):
+        return batch
+
+    assert wrap_apply(apply, "float32") is apply
+    assert wrap_apply(apply, "bfloat16") is not apply
+
+
+# ---------------------------------------------------------------------------
+# the quantization-error suite
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def conv_engine():
+    return engines.create_flax_engine(
+        "", None, PIN, num_input_channels=1, num_output_channels=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def id_engine():
+    return engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def crop_engine():
+    return engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=(2, 8, 8),
+        num_input_channels=1, num_output_channels=3,
+    )
+
+
+def _inferencer(engine, precision, crop=False, **kw):
+    if crop:
+        return Inferencer(
+            input_patch_size=PIN,
+            output_patch_size=(2, 8, 8),
+            output_patch_overlap=(1, 4, 4),
+            num_output_channels=3,
+            framework="prebuilt",
+            batch_size=2,
+            engine=engine,
+            precision=precision,
+            crop_output_margin=True,
+            **kw,
+        )
+    return Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=engine,
+        precision=precision,
+        crop_output_margin=False,
+        **kw,
+    )
+
+
+def _traffic(kind: str):
+    rng = np.random.default_rng(17)
+    if kind == "ragged":
+        # non-divisible extents: edge snapping, batch padding rows
+        return Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    return Chunk(rng.random((8, 40, 48)).astype(np.float32))
+
+
+@pytest.mark.parametrize("precision", ["bfloat16", "int8"])
+@pytest.mark.parametrize("engine_kind", ["identity", "conv"])
+@pytest.mark.parametrize("traffic", ["plain", "ragged"])
+def test_quantization_error_bounds(id_engine, conv_engine, engine_kind,
+                                   precision, traffic):
+    """bf16/int8 outputs stay within the stated error bounds of the
+    float32 reference — the gate narrow variants must pass to land
+    (ISSUE 14 acceptance: no unmeasured path ships as default)."""
+    engine = id_engine if engine_kind == "identity" else conv_engine
+    chunk = _traffic(traffic)
+    ref = np.asarray(_inferencer(engine, "float32")(chunk).array)
+    got = np.asarray(_inferencer(engine, precision)(chunk).array)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    err = np.abs(got.astype(np.float64) - ref.astype(np.float64))
+    scale = max(float(np.abs(ref).max()), 1.0)
+    assert err.max() <= MAX_ABS_ERR[precision] * scale, (
+        f"{engine_kind}/{precision}/{traffic}: max abs err "
+        f"{err.max():.5f} exceeds {MAX_ABS_ERR[precision]}")
+    assert err.mean() <= MEAN_ERR[precision] * scale, (
+        f"{engine_kind}/{precision}/{traffic}: mean err "
+        f"{err.mean():.5f} exceeds {MEAN_ERR[precision]}")
+    # a narrow variant that changes NOTHING would be a wiring bug
+    assert err.max() > 0.0
+
+
+@pytest.mark.parametrize("precision", ["bfloat16", "int8"])
+def test_quantization_error_crop_margin(crop_engine, precision):
+    """The bounds hold through the crop-margin path (pout < pin, real
+    (1, 4, 4) margin crop after the blend)."""
+    chunk = _traffic("ragged")
+    ref = np.asarray(_inferencer(crop_engine, "float32",
+                                 crop=True)(chunk).array)
+    got = np.asarray(_inferencer(crop_engine, precision,
+                                 crop=True)(chunk).array)
+    err = np.abs(got.astype(np.float64) - ref.astype(np.float64))
+    assert err.max() <= MAX_ABS_ERR[precision]
+    assert err.max() > 0.0
+
+
+def test_uint8_quantization_contract_survives(id_engine):
+    """The normalize_blend uint8 contract is unchanged: a narrow forward
+    moves the uint8 result by at most one quantization level on the
+    identity oracle (err*255 < 1 at the stated bf16 bound)."""
+    chunk = _traffic("plain")
+    ref = np.asarray(_inferencer(id_engine, "float32",
+                                 output_dtype="uint8")(chunk).array)
+    got = np.asarray(_inferencer(id_engine, "bfloat16",
+                                 output_dtype="uint8")(chunk).array)
+    assert got.dtype == np.uint8 == ref.dtype
+    assert np.abs(got.astype(np.int32) - ref.astype(np.int32)).max() <= 1
+
+
+def test_float32_default_bitwise_untouched(id_engine, monkeypatch):
+    """Explicit float32, env-default float32 and no-spec construction
+    are the SAME path bitwise (and structurally: engine.apply itself)."""
+    monkeypatch.delenv("CHUNKFLOW_PRECISION", raising=False)
+    chunk = _traffic("ragged")
+    default = _inferencer(id_engine, None)
+    assert default.precision == "float32"
+    assert default._apply is id_engine.apply
+    ref = np.asarray(default(chunk).array)
+    explicit = np.asarray(_inferencer(id_engine, "float32")(chunk).array)
+    assert np.array_equal(ref, explicit)
+    monkeypatch.setenv("CHUNKFLOW_PRECISION", "bfloat16")
+    via_env = _inferencer(id_engine, None)
+    assert via_env.precision == "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# parity contracts survive at every precision
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("precision", ["bfloat16", "int8"])
+def test_packed_serve_parity_survives_precision(id_engine, precision):
+    """Packed-vs-per-chunk bitwise identity holds AT EVERY precision:
+    the packer inherits the same wrapped forward through _forward, so
+    quantization cannot diverge the two paths."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    rng = np.random.default_rng(3)
+    chunks = [
+        Chunk(rng.random((4, 16, 48), dtype=np.float32),
+              voxel_offset=(8 * i, 0, 0))
+        for i in range(3)
+    ]
+    inf = Inferencer(
+        input_patch_size=PIN,
+        num_output_channels=3,
+        framework="prebuilt",
+        engine=id_engine,
+        batch_size=4,
+        precision=precision,
+        crop_output_margin=False,
+    )
+    refs = [np.asarray(inf(c).array) for c in chunks]
+    packer = PatchPacker(inf, max_wait_ms=2.0)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=60).array) for h in handles]
+    finally:
+        packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices (tests/conftest.py)")
+@pytest.mark.parametrize("precision", ["bfloat16", "int8"])
+def test_mesh_parity_survives_precision(id_engine, precision):
+    """Mesh-vs-single bitwise identity holds AT EVERY precision: the
+    sharded engine shards the same wrapped forward and replays the same
+    accumulation."""
+    chunk = _traffic("ragged")
+    ref = np.asarray(_inferencer(id_engine, precision)(chunk).array)
+    out = np.asarray(
+        _inferencer(id_engine, precision, mesh="data=2")(chunk).array)
+    assert np.array_equal(out, ref)
+
+
+def test_precision_composes_with_fused_kernel(id_engine, monkeypatch):
+    """bf16 forward + fused Pallas blend (interpret) equals bf16 forward
+    + XLA scatter bitwise — precision quantizes the forward, the
+    accumulation stays float32 either way."""
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    chunk = _traffic("ragged")
+    ref = np.asarray(_inferencer(id_engine, "bfloat16")(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    got = np.asarray(_inferencer(id_engine, "bfloat16")(chunk).array)
+    assert np.array_equal(got, ref)

@@ -1,4 +1,7 @@
-"""Pallas scatter-accumulate kernel (interpret mode on CPU) vs XLA path."""
+"""Fused Pallas blend kernel (interpret mode on CPU) vs the XLA scatter
+path: BITWISE parity across the PR 13 matrix (ISSUE 14 acceptance) —
+plain/ragged/uint8/crop-margin traffic x single-device and
+``data=N``/``y=A,x=B`` meshes, plus packed-serve traffic."""
 import os
 
 import numpy as np
@@ -6,16 +9,19 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.inference import engines
+from chunkflow_tpu.inference.inferencer import Inferencer
+
+PIN = (4, 16, 16)
+OVERLAP = (2, 8, 8)
+
 
 def _run_identity(monkeypatch, mode, shape=(8, 32, 32)):
     monkeypatch.setenv("CHUNKFLOW_PALLAS", mode)
-    # build_local_blend reads CHUNKFLOW_PALLAS when the Inferencer is built
-    from chunkflow_tpu.inference.inferencer import Inferencer
-    from chunkflow_tpu.chunk.base import Chunk
-
     inferencer = Inferencer(
-        input_patch_size=(4, 16, 16),
-        output_patch_overlap=(2, 8, 8),
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
         num_output_channels=2,
         framework="identity",
         batch_size=2,
@@ -29,16 +35,20 @@ def _run_identity(monkeypatch, mode, shape=(8, 32, 32)):
 # (9, 35, 33) produces patch corners with no (8,128) alignment at all —
 # exercises the aligned-window machinery end to end
 @pytest.mark.parametrize("shape", [(8, 32, 32), (9, 35, 33)])
-def test_pallas_accumulate_matches_xla(monkeypatch, shape):
+def test_fused_bitwise_matches_xla(monkeypatch, shape):
+    """The float32 fused path is BITWISE identical to the XLA scatter
+    path (ISSUE 14 acceptance — tighter than the old atol=1e-5 bound:
+    same weighting expressions, same ascending-patch accumulation
+    order)."""
     _, ref = _run_identity(monkeypatch, "0", shape)
     _, got = _run_identity(monkeypatch, "interpret", shape)
-    np.testing.assert_allclose(got, ref, atol=1e-5)
+    assert np.array_equal(got, ref)
 
 
 @pytest.mark.parametrize("shape", [(8, 32, 32), (9, 35, 33)])
 def test_pallas_identity_oracle(monkeypatch, shape):
     chunk, got = _run_identity(monkeypatch, "interpret", shape)
-    # identity oracle holds through the pallas scatter path
+    # identity oracle holds through the fused blend path
     arr = np.asarray(chunk.array)
     np.testing.assert_allclose(got[0], arr, atol=1e-5)
     np.testing.assert_allclose(got[1], arr, atol=1e-5)
@@ -47,11 +57,12 @@ def test_pallas_identity_oracle(monkeypatch, shape):
 @pytest.mark.parametrize("mode", ["0", "interpret"])
 def test_blend_stacked_optin_matches_per_batch_default(monkeypatch, mode):
     """The opt-in stacked single-accumulation (CHUNKFLOW_BLEND_STACKED=1,
-    kept for hardware A/B) must agree with the per-batch default."""
+    kept for hardware A/B) must agree with the per-batch default —
+    bitwise now that both weight inside the shared accumulate step."""
     _, ref = _run_identity(monkeypatch, mode, (9, 35, 33))
     monkeypatch.setenv("CHUNKFLOW_BLEND_STACKED", "1")
     _, got = _run_identity(monkeypatch, mode, (9, 35, 33))
-    np.testing.assert_allclose(got, ref, atol=1e-5)
+    assert np.array_equal(got, ref)
 
 
 @pytest.mark.parametrize("mode", ["0", "interpret"])
@@ -62,75 +73,289 @@ def test_blend_stacked_budget_fallback(monkeypatch, mode):
     _, ref = _run_identity(monkeypatch, mode, (9, 35, 33))
     monkeypatch.setenv("CHUNKFLOW_BLEND_STACK_MAX_GB", "0.0000001")
     _, got = _run_identity(monkeypatch, mode, (9, 35, 33))
-    np.testing.assert_allclose(got, ref, atol=1e-5)
+    assert np.array_equal(got, ref)
 
 
-def test_pallas_matches_xla_blend_on_overlapping_patches(monkeypatch):
-    """Dense-overlap parity: the pallas DMA kernel (interpret mode) and
-    the ops/blend.py scatter-add path must agree on a fixture where every
-    patch overlaps several neighbours (stride = half patch per axis)."""
+def test_fused_bitwise_on_overlapping_patches(monkeypatch):
+    """Dense-overlap parity: the fused kernel (interpret mode) and the
+    ops/blend.py scatter-add path must agree BITWISE on a fixture where
+    every patch overlaps several neighbours (stride = half patch)."""
     _, ref = _run_identity(monkeypatch, "0", (10, 40, 40))
     _, got = _run_identity(monkeypatch, "interpret", (10, 40, 40))
-    np.testing.assert_allclose(got, ref, atol=1e-5)
+    assert np.array_equal(got, ref)
 
 
-def test_accumulate_patches_overlapping_windows_vs_numpy():
-    """Direct kernel check with overlapping windows: sequential-grid
-    accumulation order must reproduce numpy's += semantics exactly."""
+# ---------------------------------------------------------------------------
+# direct kernel checks
+# ---------------------------------------------------------------------------
+def _kernel_fixture(seed, co=3, Z=5, Y=32, X=40, B=4, pz=3, py=12, px=16):
+    from chunkflow_tpu.ops import pallas_blend
+
+    rng = np.random.default_rng(seed)
+    pad_y, pad_x = pallas_blend.buffer_padding((pz, py, px))
+    out = np.zeros((co, Z, Y + pad_y, X + pad_x), np.float32)
+    weight = np.zeros((Z, Y + pad_y, X + pad_x), np.float32)
+    preds = rng.standard_normal((B, co, pz, py, px)).astype(np.float32)
+    bump = (rng.random((pz, py, px)) * 5 + 1).astype(np.float32)
+    valid = np.ones((B,), np.float32)
+    valid[-1] = 0.0  # one batch-padding row
+    return out, weight, preds, bump, valid
+
+
+def test_fused_kernel_overlapping_windows_vs_numpy():
+    """Direct kernel check with overlapping windows: weighting +
+    placement + sequential-grid accumulation must reproduce numpy's
+    ``+= (preds*bump)*valid`` semantics bitwise."""
     import jax.numpy as jnp
 
     from chunkflow_tpu.ops import pallas_blend
 
-    rng = np.random.default_rng(7)
-    co, Z, Y, X = 3, 5, 32, 40
-    B, pz, py, px = 4, 3, 12, 16
-    pad_y, pad_x = pallas_blend.buffer_padding((pz, py, px))
-    out = np.zeros((co, Z, Y + pad_y, X + pad_x), np.float32)
-    weight = np.zeros((Z, Y + pad_y, X + pad_x), np.float32)
-    preds = rng.random((B, co, pz, py, px)).astype(np.float32)
-    wpatches = rng.random((B, pz, py, px)).astype(np.float32)
-    # stride ~ half patch: every window overlaps its neighbours in all axes
+    out, weight, preds, bump, valid = _kernel_fixture(7)
+    B, co, pz, py, px = preds.shape
+    # stride ~ half patch: every window overlaps its neighbours; a
+    # duplicate corner exercises the in-order accumulation
     starts = np.array(
         [[0, 0, 0], [1, 6, 8], [2, 12, 16], [1, 6, 8]], np.int32
     )
-
-    got_out, got_w = pallas_blend.accumulate_patches(
+    got_out, got_w = pallas_blend.fused_accumulate_patches(
         jnp.asarray(out), jnp.asarray(weight), jnp.asarray(preds),
-        jnp.asarray(wpatches), jnp.asarray(starts), interpret=True,
+        jnp.asarray(valid), jnp.asarray(bump), jnp.asarray(starts),
+        interpret=True,
     )
     exp_out, exp_w = out.copy(), weight.copy()
     for b in range(B):
         z, y, x = starts[b]
-        exp_out[:, z:z + pz, y:y + py, x:x + px] += preds[b]
-        exp_w[z:z + pz, y:y + py, x:x + px] += wpatches[b]
-    np.testing.assert_allclose(np.asarray(got_out), exp_out, atol=1e-5)
-    np.testing.assert_allclose(np.asarray(got_w), exp_w, atol=1e-5)
+        exp_out[:, z:z + pz, y:y + py, x:x + px] += \
+            (preds[b] * bump[None]) * valid[b]
+        exp_w[z:z + pz, y:y + py, x:x + px] += bump * valid[b]
+    assert np.array_equal(np.asarray(got_out), exp_out)
+    assert np.array_equal(np.asarray(got_w), exp_w)
 
 
-def test_accumulate_patches_unaligned_offsets_vs_numpy():
-    """Direct kernel check: arbitrary (not 8/128-divisible) corners."""
+def test_fused_kernel_pre_weighted_vs_numpy():
+    """The pre-weighted flavor (the serving replay / sharded-engine
+    stacks): rows added as-is, weight contributions bump*valid."""
     import jax.numpy as jnp
 
     from chunkflow_tpu.ops import pallas_blend
 
-    rng = np.random.default_rng(3)
-    co, Z, Y, X = 2, 6, 40, 48
-    B, pz, py, px = 3, 2, 9, 11
-    pad_y, pad_x = pallas_blend.buffer_padding((pz, py, px))
-    out = np.zeros((co, Z, Y + pad_y, X + pad_x), np.float32)
-    weight = np.zeros((Z, Y + pad_y, X + pad_x), np.float32)
-    preds = rng.random((B, co, pz, py, px)).astype(np.float32)
-    wpatches = rng.random((B, pz, py, px)).astype(np.float32)
+    out, weight, preds, bump, valid = _kernel_fixture(
+        3, co=2, Z=6, Y=40, X=48, B=3, pz=2, py=9, px=11)
+    B, co, pz, py, px = preds.shape
     starts = np.array([[0, 1, 5], [3, 17, 30], [1, 31, 37]], np.int32)
-
-    got_out, got_w = pallas_blend.accumulate_patches(
-        jnp.asarray(out), jnp.asarray(weight), jnp.asarray(preds),
-        jnp.asarray(wpatches), jnp.asarray(starts), interpret=True,
+    wstack = (preds * bump[None, None]) * valid[:, None, None, None, None]
+    got_out, got_w = pallas_blend.fused_accumulate_patches(
+        jnp.asarray(out), jnp.asarray(weight), jnp.asarray(wstack),
+        jnp.asarray(valid), jnp.asarray(bump), jnp.asarray(starts),
+        pre_weighted=True, interpret=True,
     )
     exp_out, exp_w = out.copy(), weight.copy()
     for b in range(B):
         z, y, x = starts[b]
-        exp_out[:, z:z + pz, y:y + py, x:x + px] += preds[b]
-        exp_w[z:z + pz, y:y + py, x:x + px] += wpatches[b]
-    np.testing.assert_allclose(np.asarray(got_out), exp_out, atol=1e-6)
-    np.testing.assert_allclose(np.asarray(got_w), exp_w, atol=1e-6)
+        exp_out[:, z:z + pz, y:y + py, x:x + px] += wstack[b]
+        exp_w[z:z + pz, y:y + py, x:x + px] += bump * valid[b]
+    assert np.array_equal(np.asarray(got_out), exp_out)
+    assert np.array_equal(np.asarray(got_w), exp_w)
+
+
+# ---------------------------------------------------------------------------
+# pallas_mode: typo warning (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+def test_pallas_mode_warns_once_on_typo(monkeypatch, capsys):
+    """A mistyped opt-in (CHUNKFLOW_PALLAS=ture) must not silently run
+    the slow path: one stderr warning per unrecognized value, then
+    quiet; recognized values never warn."""
+    from chunkflow_tpu.ops import pallas_blend
+
+    monkeypatch.setattr(pallas_blend, "_WARNED_VALUES", set())
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "ture")
+    assert pallas_blend.pallas_mode() == "off"
+    err = capsys.readouterr().err
+    assert "ture" in err and "not a recognized value" in err
+    # second call with the same typo: silent (warned once)
+    assert pallas_blend.pallas_mode() == "off"
+    assert capsys.readouterr().err == ""
+    # a DIFFERENT typo warns again
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "yes please")
+    assert pallas_blend.pallas_mode() == "off"
+    assert "not a recognized value" in capsys.readouterr().err
+    # recognized values never warn
+    for value, expected in [("0", "off"), ("off", "off"), ("", "off"),
+                            ("1", "on"), ("force", "on"),
+                            ("interpret", "interpret")]:
+        monkeypatch.setenv("CHUNKFLOW_PALLAS", value)
+        assert pallas_blend.pallas_mode() == expected
+    assert capsys.readouterr().err == ""
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 14 parity matrix: fused vs XLA scatter, bitwise, across
+# traffic classes, meshes, and packed-serve traffic
+# ---------------------------------------------------------------------------
+def _traffic_chunk(traffic: str, seed: int):
+    rng = np.random.default_rng(seed)
+    if traffic == "ragged":
+        return Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    if traffic == "uint8":
+        return Chunk(rng.integers(0, 256, (8, 40, 48), dtype=np.uint8))
+    return Chunk(rng.random((8, 40, 48)).astype(np.float32))
+
+
+def _matrix_inferencer(crop: bool, mesh=None):
+    if crop:
+        engine = engines.create_identity_engine(
+            input_patch_size=PIN, output_patch_size=(2, 8, 8),
+            num_input_channels=1, num_output_channels=3,
+        )
+        return Inferencer(
+            input_patch_size=PIN,
+            output_patch_size=(2, 8, 8),
+            output_patch_overlap=(1, 4, 4),
+            num_output_channels=3,
+            framework="prebuilt",
+            batch_size=2,
+            engine=engine,
+            mesh=mesh,
+            crop_output_margin=True,
+        )
+    engine = engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+    return Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=engine,
+        mesh=mesh,
+        crop_output_margin=False,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices (tests/conftest.py)")
+@pytest.mark.parametrize("mesh", [None, "data=2", "y=2,x=2"])
+@pytest.mark.parametrize(
+    "traffic", ["plain", "ragged", "uint8", "crop_margin"]
+)
+def test_fused_parity_matrix(monkeypatch, mesh, traffic):
+    """ISSUE 14 acceptance: the float32 fused path is BITWISE identical
+    to the XLA scatter path in interpret mode across the PR 13 parity
+    matrix — every traffic class, single-device AND both mesh kinds
+    (the fused kernel runs inside the sharded replay too)."""
+    crop = traffic == "crop_margin"
+    chunk = _traffic_chunk(traffic, seed=abs(hash(traffic)) % 2**31)
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    ref = np.asarray(_matrix_inferencer(crop, mesh=mesh)(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    got = np.asarray(_matrix_inferencer(crop, mesh=mesh)(chunk).array)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    assert np.array_equal(got, ref), (
+        f"fused path diverged from XLA scatter (mesh={mesh}, "
+        f"traffic={traffic}; max abs diff "
+        f"{np.abs(got.astype(np.float64) - ref.astype(np.float64)).max():.3e})"
+    )
+
+
+def test_fused_parity_packed_serve(monkeypatch):
+    """Packed-serve traffic through the fused serve_scatter program is
+    bitwise identical to the XLA-scatter packed path AND the per-chunk
+    fused path (the serving leg of the ISSUE 14 matrix)."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    rng = np.random.default_rng(5)
+    chunks = [
+        Chunk(rng.random((4, 16, 48), dtype=np.float32),
+              voxel_offset=(8 * i, 0, 0))
+        for i in range(4)
+    ]
+
+    def packed(mode):
+        monkeypatch.setenv("CHUNKFLOW_PALLAS", mode)
+        inf = Inferencer(
+            input_patch_size=PIN,
+            num_output_channels=2,
+            framework="identity",
+            batch_size=4,
+            crop_output_margin=False,
+        )
+        packer = PatchPacker(inf, max_wait_ms=2.0)
+        try:
+            handles = [packer.submit(c) for c in chunks]
+            return [np.asarray(h.result(timeout=60).array)
+                    for h in handles]
+        finally:
+            packer.close()
+        # the fused key is distinct, so the packer builds the fused
+        # serve_scatter program rather than reusing the XLA one
+
+    ref = packed("0")
+    got = packed("interpret")
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    inf = Inferencer(
+        input_patch_size=PIN,
+        num_output_channels=2,
+        framework="identity",
+        batch_size=4,
+        crop_output_margin=False,
+    )
+    per_chunk = [np.asarray(inf(c).array) for c in chunks]
+    for r, g, p in zip(ref, got, per_chunk):
+        assert np.array_equal(g, r)
+        assert np.array_equal(g, p)
+
+
+def test_fused_key_rebuilds_on_env_flip(monkeypatch):
+    """Flipping CHUNKFLOW_PALLAS mid-stream builds the fused program
+    under its own cache key instead of reusing the stale XLA one (the
+    CHUNKFLOW_MESH re-read convention, now for the kernel selection)."""
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=2,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(1)
+    chunk = Chunk(rng.random((8, 32, 32)).astype(np.float32))
+    ref = np.asarray(inf(chunk).array)
+    assert ("scatter",) in inf._programs
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    got = np.asarray(inf(chunk).array)
+    assert ("scatter_fused", "fused-interpret") in inf._programs
+    assert np.array_equal(got, ref)
+    assert inf._programs.builds == 2
+
+
+def test_fused_modules_are_graftlint_clean():
+    """ISSUE 14 satellite: GL001-GL014 clean over the new/changed kernel
+    modules, asserted in-suite (the whole-repo gate covers them too;
+    this pins the specific modules so a future baseline regeneration
+    cannot quietly grandfather a finding here)."""
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        [
+            "chunkflow_tpu/ops/pallas_blend.py",
+            "chunkflow_tpu/ops/blend.py",
+            "chunkflow_tpu/inference/precision.py",
+            "chunkflow_tpu/inference/inferencer.py",
+            "chunkflow_tpu/inference/bump.py",
+            "chunkflow_tpu/serve/packer.py",
+            "chunkflow_tpu/parallel/engine.py",
+            "chunkflow_tpu/core/profiling.py",
+        ],
+        config, repo_root=repo_root,
+    )
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]

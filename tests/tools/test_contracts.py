@@ -132,10 +132,10 @@ def test_contract_accepts_chunk_objects():
 
 
 def test_real_entry_point_contract_fires():
-    # pallas accumulate_patches declares int32 starts; float starts are the
+    # the fused pallas kernel declares int32 starts; float starts are the
     # classic silent-cast bug this contract exists to catch
     from chunkflow_tpu.ops.pallas_blend import (
-        accumulate_patches, buffer_padding,
+        buffer_padding, fused_accumulate_patches,
     )
 
     co, Z, Y, X = 1, 2, 8, 16
@@ -144,7 +144,9 @@ def test_real_entry_point_contract_fires():
     out = jnp.zeros((co, Z, Y + pad_y, X + pad_x), jnp.float32)
     weight = jnp.zeros((Z, Y + pad_y, X + pad_x), jnp.float32)
     preds = jnp.ones((1, co, pz, py, px), jnp.float32)
-    wpatches = jnp.ones((1, pz, py, px), jnp.float32)
+    valid = jnp.ones((1,), jnp.float32)
+    bump = jnp.ones((pz, py, px), jnp.float32)
     with pytest.raises(ContractError, match="int32"):
-        accumulate_patches(out, weight, preds, wpatches,
-                           jnp.zeros((1, 3), jnp.float32), interpret=True)
+        fused_accumulate_patches(out, weight, preds, valid, bump,
+                                 jnp.zeros((1, 3), jnp.float32),
+                                 interpret=True)

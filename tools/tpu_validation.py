@@ -429,6 +429,50 @@ def bench_flagship_pallas():
     return _bench("1", "tpu", "bfloat16", 4)
 
 
+@step("bench_blend_fused")
+def bench_blend_fused():
+    """Fused-vs-scatter ON-CHIP A/B (ISSUE 14): the fused Pallas kernel
+    (weighting + aligned-window placement + HBM read-modify-write in one
+    VMEM pass, ops/pallas_blend.py) against the XLA per-batch scatter
+    default on the flagship config — both legs banked in ONE row so the
+    comparison is atomic. This is the row that RETIRES the stale
+    1.79 Mvox/s/chip cached headline (BENCH_r03-r05: the identical
+    pre-rework row replayed three rounds): a fresh fused-vs-scatter pair
+    supersedes it the first tunnel window that has a chip. A CPU-only
+    window records an honest skip — the structural win is gated on CPU
+    by ``bench.py blend_fused`` and correctness by the interpret-mode
+    parity matrix in tier-1, but neither is an on-chip number."""
+    plat = _platform()
+    if plat not in ("tpu", "axon"):
+        return {
+            "skipped": True,
+            "platform": plat,
+            "note": (
+                "CPU-only window: the fused-vs-scatter A/B needs a "
+                "chip; bench.py blend_fused gates the data-movement "
+                "structure on CPU and tests/ops/test_pallas_blend.py "
+                "pins interpret-mode bit-identity in tier-1 — re-run "
+                "when the tunnel has a chip to stamp the row that "
+                "retires the 1.79 cached headline"
+            ),
+        }
+    scatter = _bench("0", "tpu", "bfloat16", 4)
+    fused = _bench("1", "tpu", "bfloat16", 4)
+    speedup = (fused["mvox_s"] / scatter["mvox_s"]
+               if scatter.get("mvox_s") else None)
+    return {
+        "mvox_s": fused.get("mvox_s"),
+        "scatter_mvox_s": scatter.get("mvox_s"),
+        "speedup": round(speedup, 3) if speedup else None,
+        "note": (
+            "fused Pallas blend (one VMEM pass: weighting + placement "
+            "+ RMW; no weighted/padded stacks) vs the XLA per-batch "
+            "scatter default, same flagship config — supersedes the "
+            "BENCH_r03-r05 cached 1.79 row (pre-rework code)"
+        ),
+    }
+
+
 @step("e2e_split")
 def e2e_split():
     """Where does the flagship config's wall time go? Separate H2D,
@@ -968,6 +1012,9 @@ def main():
              bench_pipeline_seg, bench_pipeline_seg_streamed,
              bench_cli_task_loop, bench_jumbo,
              bench_flagship_pallas,
+             bench_blend_fused,  # fused-vs-scatter A/B in ONE row
+             # (ISSUE 14): the measurement that retires the stale 1.79
+             # cached headline; cheap skip on a CPU-only window
              bench_multichip,  # unified-engine slice row (ISSUE 13):
              # cheap skip on a single-chip tunnel, the first real
              # multi-chip throughput number when a slice window opens
