@@ -225,9 +225,17 @@ class Chunk(np.lib.mixins.NDArrayOperatorsMixin):
 
     # ---- device movement -------------------------------------------------
     def device(self, sharding=None) -> "Chunk":
-        """Move payload to the default accelerator (or given sharding)."""
+        """Move payload to the default accelerator (or given sharding).
+        The payload ships in its RAW dtype — uint8 rides the wire at 1/4
+        the bytes of float32; conversion happens on device inside the
+        inference program (ops/pallas_gather.py). This is the staging
+        seam: host-resident payloads count ``transfer/h2d_bytes``."""
         import jax
 
+        if not self.is_on_device:
+            from chunkflow_tpu.core import profiling
+
+            profiling.note_h2d(np.asarray(self.array).nbytes)
         arr = jax.device_put(self.array, sharding)
         return self._with_array(arr)
 

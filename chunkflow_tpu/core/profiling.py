@@ -65,7 +65,7 @@ from chunkflow_tpu.core import telemetry
 
 __all__ = [
     "instrument_program", "stamp_cost", "catalog", "write_catalog",
-    "device_peaks",
+    "device_peaks", "note_h2d", "h2d_by_family",
     "capture", "maybe_capture", "note_retrace", "note_stall",
     "note_slo_page", "start_task_window", "note_task_done",
     "wait_for_captures", "capture_base_dir",
@@ -299,6 +299,34 @@ def stamp_cost(program, flops: Optional[float] = None,
     return _CostStamped(program, cost)
 
 
+_H2D_LOCK = threading.Lock()
+_H2D: dict = {}  # program family -> staged H2D bytes
+
+
+def note_h2d(nbytes, key=None, label: str = "") -> None:
+    """Count one host->device staging transfer at the staging seam
+    (ISSUE 15): the ``transfer/h2d_bytes`` / ``transfer/h2d_chunks``
+    counters make the front-half win visible in byte terms, and ``key``
+    (a ProgramCache key) attributes the bytes to the program family that
+    consumes them — the ``h2d_bytes`` column of the programs.json
+    catalog / log-summary DEVICE PROGRAMS table. No-op under the
+    telemetry kill switch."""
+    if not telemetry.enabled():
+        return
+    telemetry.inc("transfer/h2d_bytes", float(nbytes))
+    telemetry.inc("transfer/h2d_chunks")
+    if key is not None:
+        family, _ = _family_of(key, label)
+        with _H2D_LOCK:
+            _H2D[family] = _H2D.get(family, 0.0) + float(nbytes)
+
+
+def h2d_by_family() -> dict:
+    """Staged H2D bytes per program family (a copy)."""
+    with _H2D_LOCK:
+        return dict(_H2D)
+
+
 def _family_of(key, label: str) -> Tuple[str, str]:
     """(family, shape-ish remainder) from a ProgramCache key. Keys are
     tuples like ``("scatter",)`` / ``("fold", (8, 32, 32))``; anything
@@ -339,6 +367,7 @@ def catalog() -> list:
     under async dispatch, see module docstring)."""
     with _LEDGER_LOCK:
         records = list(_LEDGER.values())
+    h2d = h2d_by_family()
     out = []
     for rec in records:
         with rec.lock:
@@ -391,6 +420,9 @@ def catalog() -> list:
         entry["achieved_flops_per_s"] = (
             round(flops / exec_s, 2) if flops and exec_s else None
         )
+        # staged H2D bytes attributed to this family (note_h2d): the
+        # front-half "what does this program cost the PCIe link" column
+        entry["h2d_bytes"] = h2d.get(rec.family)
         out.append(entry)
     out.sort(key=lambda e: -(e["compile_s"] or 0.0))
     return out
@@ -718,6 +750,8 @@ def _on_reset() -> None:
     _WINDOW = None
     with _LEDGER_LOCK:
         _LEDGER.clear()
+    with _H2D_LOCK:
+        _H2D.clear()
     with _STATE_LOCK:
         _LAST_CAPTURE_T = None
         _STALL_PHASE, _STALL_TICKS = None, 0

@@ -8,7 +8,12 @@ bandwidth. This module is the chunk-level analog, built on three facts of
 the JAX execution model:
 
 1. ``jax.device_put`` is asynchronous — staging chunk *k+1* host→device
-   costs the host a call, not a wait, while chunk *k* computes;
+   costs the host a call, not a wait, while chunk *k* computes; the
+   staging ring ships each chunk ONCE, in its RAW dtype (uint8 at 1/4
+   the bytes of float32 — conversion happens inside the program's
+   device-resident front half, ops/pallas_gather.py), and every upload
+   counts ``transfer/h2d_bytes``/``transfer/h2d_chunks`` at the
+   ``Chunk.device`` seam;
 2. dispatch is asynchronous — ``infer_async`` enqueues chunk *k*'s fused
    program and starts the result's ``copy_to_host_async`` without
    blocking;

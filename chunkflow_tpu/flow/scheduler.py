@@ -48,6 +48,13 @@ timer attribution differ.
 Ownership contract is inherited from flow/pipeline.py: buffers staged by
 the executor are donated into the program (``consume=True``); anything
 that arrived already device-resident stays caller-owned.
+
+The staging ring ships each chunk ONCE in its RAW dtype (ISSUE 15): the
+host pad/convert phase no longer exists — shape-bucket padding and the
+int->f32 normalization run device-side inside the program's gather front
+(ops/pallas_gather.py), so a uint8 task crosses PCIe at 1/4 the float32
+bytes and exactly 1x chunk size (``transfer/h2d_bytes`` at the
+``Chunk.device`` seam is the proof).
 """
 from __future__ import annotations
 

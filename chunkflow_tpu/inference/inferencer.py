@@ -223,24 +223,28 @@ class Inferencer:
     def _scatter_key(self) -> tuple:
         """ProgramCache key for the single-device blend program. The
         accumulation-kernel selection (XLA scatter vs the fused Pallas
-        kernel, ops/blend.kernel_tag) is part of the key, so flipping
-        ``CHUNKFLOW_PALLAS`` mid-stream builds the right program instead
-        of reusing a stale one — the same re-read-per-chunk convention
-        as ``CHUNKFLOW_MESH``."""
+        kernel, ops/blend.kernel_tag) AND the gather-front selection
+        (``CHUNKFLOW_GATHER``, ops/pallas_gather.gather_key — empty for
+        the default device leg) are part of the key, so flipping either
+        env mid-stream builds the right program instead of reusing a
+        stale one — the same re-read-per-chunk convention as
+        ``CHUNKFLOW_MESH``."""
         from chunkflow_tpu.ops.blend import kernel_tag
+        from chunkflow_tpu.ops.pallas_gather import gather_key
 
         tag = kernel_tag()
-        return ("scatter",) if tag == "scatter" else ("scatter_fused", tag)
+        base = ("scatter",) if tag == "scatter" else ("scatter_fused", tag)
+        return base + gather_key()
 
     @property
     def _program(self):
         """The compiled single-device blend program, if built (tests) —
-        whichever accumulation kernel it selected."""
+        whichever accumulation kernel and gather front it selected."""
         prog = self._programs.peek(("scatter",))
         if prog is not None:
             return prog
         for key, cached in self._programs.items():
-            if key and key[0] == "scatter_fused":
+            if key and key[0] in ("scatter", "scatter_fused"):
                 return cached
         return None
 
@@ -732,24 +736,46 @@ class Inferencer:
                 self.output_patch_overlap,
             )
 
+        from chunkflow_tpu.core import profiling
+        from chunkflow_tpu.ops import pallas_gather
+
         arr = chunk.array
-        if not chunk.is_on_device:
+        was_on_device = chunk.is_on_device
+        if not was_on_device:
             arr = np.asarray(arr)
         # int images normalize to [0, 1] float32 (reference :395-399).
-        # Transfer the NARROW dtype and convert on device: a uint8 EM
-        # chunk rides H2D at 1/4 the bytes of a host-side float32
-        # conversion, and XLA fuses the convert+scale into one kernel.
+        # Transfer the NARROW dtype: a uint8 EM chunk rides H2D at 1/4
+        # the bytes of a host-side float32 conversion. With the
+        # device-resident front half (ISSUE 15, the default) the chunk
+        # stays RAW past this point too — the selected gather leg
+        # (ops/pallas_gather.py) converts inside the program (whole-chunk
+        # on the XLA leg, per-tile in VMEM on the Pallas leg).
+        # CHUNKFLOW_GATHER=off restores the eager pre-program conversion
+        # below bit-identically (conversion and edge-padding commute
+        # exactly with slicing); fold keeps it — its program family
+        # contracts on float32 input.
         dt = np.dtype(chunk.dtype)
-        if dt.kind in "iu":
+        raw_front = (
+            not use_fold
+            and pallas_gather.gather_mode() != "host"
+            and pallas_gather.raw_eligible(dt)
+        )
+        if raw_front:
+            arr = jnp.asarray(arr)
+            h2d_nbytes = arr.nbytes
+        elif dt.kind in "iu":
             scale = np.float32(1.0 / np.iinfo(dt).max)
             if dt.itemsize <= 4:
+                h2d_nbytes = arr.nbytes
                 arr = jnp.asarray(arr).astype(jnp.float32) * scale
             else:
                 # 64-bit ints would silently wrap in jnp.asarray (x64
                 # disabled downcasts to 32-bit first); convert on host
                 arr = jnp.asarray(np.asarray(arr, dtype=np.float32)) * scale
+                h2d_nbytes = arr.nbytes
         else:
             arr = jnp.asarray(arr, dtype=jnp.float32)
+            h2d_nbytes = arr.nbytes
         if arr is chunk.array and not consume:
             # every inference program donates its chunk argument (GL005):
             # the buffer is dead after the call. A device-resident float32
@@ -770,6 +796,18 @@ class Inferencer:
 
         if self._device_params is None:
             self._device_params = jax.device_put(self.engine.params)
+
+        if not was_on_device:
+            # the staging seam: per-chunk H2D bytes (transfer/h2d_*;
+            # pipeline-staged chunks count in Chunk.device instead),
+            # attributed to the program family about to consume them
+            if use_fold:
+                h2d_key = ("fold",)
+            elif shard_engine is None:
+                h2d_key = self._scatter_key()
+            else:
+                h2d_key = ("shard",)
+            profiling.note_h2d(h2d_nbytes, key=h2d_key)
 
         if use_fold:
             result = self._run_fold(arr)

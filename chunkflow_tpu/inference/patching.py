@@ -5,6 +5,12 @@ crop margin (input - output)//2, stride = output size - output overlap,
 edge snapping so the last patch ends exactly at the chunk boundary. The
 output is a static [N, 3] coordinate array that the fused XLA program scans
 over, instead of the reference's Python list of slice pairs.
+
+This starts table IS the device-resident front half's index structure
+(ISSUE 15): every gather leg — the per-chunk program, the serving
+packer's cross-request batch assembler, and each chip of a sharded
+mesh — walks the resident chunk by these coordinates
+(ops/pallas_gather.py), so no per-patch host slicing exists anywhere.
 """
 from __future__ import annotations
 

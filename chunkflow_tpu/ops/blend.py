@@ -165,6 +165,8 @@ def build_local_blend(
     import jax.numpy as jnp
     from jax import lax
 
+    from chunkflow_tpu.ops import pallas_gather
+
     ci = num_input_channels
     co = num_output_channels
     pin = tuple(input_patch_size)
@@ -173,6 +175,14 @@ def build_local_blend(
     # the shared per-batch accumulation step (and the (8,128)-aligned
     # buffer padding the pallas kernel needs, cropped after the scan)
     accumulate, _, pad_y, pad_x = make_accumulate(pout, bump)
+    # the front half (ISSUE 15): the chunk arrives RAW (device-resident
+    # once, narrow dtype) and the selected gather leg converts it —
+    # whole-chunk f32 on the XLA legs (a no-op for the host front's
+    # pre-converted f32 traffic, so CHUNKFLOW_GATHER=off runs the exact
+    # historical program), per-tile in VMEM on the Pallas legs (the
+    # full-chunk f32 materialization never exists in HBM). Callers fold
+    # pallas_gather.gather_key() into the program key.
+    prepare_chunk, gather_batch = pallas_gather.make_gather(ci, pin)
 
     # Stacking every prediction and accumulating ONCE (vs once per scan
     # batch) removes the per-batch full-buffer traffic on paper — but on
@@ -204,15 +214,12 @@ def build_local_blend(
         num_batches = n // batch_size
         out0 = jnp.zeros((co,) + zyx_buf, dtype=jnp.float32)
         w0 = jnp.zeros(zyx_buf, dtype=jnp.float32)
+        chunk_like = prepare_chunk(chunk)
 
         def forward_batch(b):
             i0 = b * batch_size
             s_in = lax.dynamic_slice(in_starts, (i0, 0), (batch_size, 3))
-            patches = jax.vmap(
-                lambda s: lax.dynamic_slice(
-                    chunk, (0, s[0], s[1], s[2]), (ci,) + pin
-                )
-            )(s_in)
+            patches = gather_batch(chunk_like, s_in)
             # RAW predictions: the bump*valid weighting lives inside the
             # accumulation step (fused into the kernel's VMEM pass on
             # the Pallas leg)

@@ -381,35 +381,43 @@ class ShardedEngine:
         _, accumulate_weighted, pad_y, pad_x = make_accumulate(pout, bump)
         return bump, accumulate_weighted, pad_y, pad_x, normalize_blend
 
-    def _forward_scan(self, bump):
+    def _make_front(self):
+        """The device-resident front half shared with the single-device
+        program (ops/pallas_gather.make_gather, ISSUE 15): ``prepare``
+        converts the RAW chip-local chunk (or slab) to float32 on the
+        XLA legs / alignment-pads it for the Pallas kernel, ``gather``
+        slices one batch of patch windows. Resolved at build time —
+        callers fold ``gather_key()`` into the program key so a
+        ``CHUNKFLOW_GATHER`` flip rebuilds."""
+        from chunkflow_tpu.ops.pallas_gather import make_gather
+
+        return make_gather(self.num_input_channels, self.input_patch_size)
+
+    def _forward_scan(self, bump, prepare, gather):
         """Per-device gather+forward over local patch batches. Returns
         ``scan_stack(chunk_like, in_starts, valid, params) -> [P, co,
         *pout]`` computing ``forward * bump * valid`` in batches of B —
         the identical per-row math (and per-batch shape) of the
-        single-device program's ``forward_batch``."""
-        import jax
+        single-device program's ``forward_batch``. ``chunk_like`` is the
+        RAW chip-local chunk: ``prepare`` runs here, AFTER any halo
+        exchange, so exchanges ship the narrow dtype."""
         import jax.numpy as jnp
         from jax import lax
 
         B = self.batch_size
-        ci = self.num_input_channels
         co = self.num_output_channels
-        pin = self.input_patch_size
         pout = self.output_patch_size
         forward = self.forward
 
-        def scan_stack(chunk_like, in_starts, valid, params):
+        def scan_stack(chunk_raw, in_starts, valid, params):
             n_local = in_starts.shape[0]
+            chunk_like = prepare(chunk_raw)
 
             def fwd_batch(b):
                 i0 = b * B
                 s_in = lax.dynamic_slice(in_starts, (i0, 0), (B, 3))
                 v = lax.dynamic_slice(valid, (i0,), (B,))
-                patches = jax.vmap(
-                    lambda s: lax.dynamic_slice(
-                        chunk_like, (0, s[0], s[1], s[2]), (ci,) + pin
-                    )
-                )(s_in)
+                patches = gather(chunk_like, s_in)
                 preds = forward(params, patches)
                 return (preds * bump[None, None]
                         * v[:, None, None, None, None])
@@ -482,7 +490,8 @@ class ShardedEngine:
         mesh = self.mesh()
         n_dev = mesh.devices.size
         bump, accumulate, pad_y, pad_x, normalize = self._make_blend_parts()
-        scan_stack = self._forward_scan(bump)
+        prepare, gather = self._make_front()
+        scan_stack = self._forward_scan(bump, prepare, gather)
         replay = self._replay(accumulate, bump, chunk_shape[1:], pad_y,
                               pad_x, n_ref, normalize)
         assert n_pad_g % n_dev == 0
@@ -539,7 +548,8 @@ class ShardedEngine:
         ny, nx = self.spec.shape
         (yslab, hl_y, hr_y, _), (xslab, hl_x, hr_x, _) = geometry
         bump, accumulate, pad_y, pad_x, normalize = self._make_blend_parts()
-        scan_stack = self._forward_scan(bump)
+        prepare, gather = self._make_front()
+        scan_stack = self._forward_scan(bump, prepare, gather)
         replay = self._replay(accumulate, bump, chunk_shape[1:], pad_y,
                               pad_x, n_ref, normalize)
         fwd_y = [(i, i + 1) for i in range(ny - 1)]
@@ -711,12 +721,14 @@ class ShardedEngine:
         import jax.numpy as jnp
 
         from chunkflow_tpu.ops.blend import kernel_tag
+        from chunkflow_tpu.ops.pallas_gather import gather_key
 
-        # the accumulation-kernel selection is part of the program key
-        # (the CHUNKFLOW_PALLAS flip convention; no suffix for the XLA
-        # default keeps the historical key strings)
+        # the accumulation-kernel AND gather-front selections are part
+        # of the program key (the CHUNKFLOW_PALLAS / CHUNKFLOW_GATHER
+        # flip convention; no suffix for the defaults keeps the
+        # historical key strings)
         tag = kernel_tag()
-        kernel_key = () if tag == "scatter" else (tag,)
+        kernel_key = (() if tag == "scatter" else (tag,)) + gather_key()
         B = self.batch_size
         chunk_shape = tuple(arr.shape)
         if self.spec.kind == "data":
@@ -785,9 +797,16 @@ class ShardedEngine:
                 and self.spec.kind == "data":
             import jax.numpy as jnp
 
+            from chunkflow_tpu.ops.pallas_gather import convert_chunk
             from chunkflow_tpu.parallel.distributed import (
                 build_sharded_program,
             )
+
+            # the cross-host recipe keeps its float32 global-array
+            # contract: a raw chunk converts host-side with the same
+            # IEEE expression the device front applies (bitwise equal)
+            if np.dtype(arr.dtype) != np.float32:
+                arr = np.asarray(convert_chunk(np.asarray(arr)))
 
             mesh = multihost.global_mesh()
             B = self.batch_size

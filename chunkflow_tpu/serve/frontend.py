@@ -437,6 +437,13 @@ class ServingService(CoordinationService):
         self.admission = admission or AdmissionController()
         self.default_deadline_s = float(default_deadline_s)
         self.max_body_bytes = int(max_body_mb * (1 << 20))
+        # volume-reference requests: one PrecomputedVolume handle per
+        # (path) for the process lifetime — handles carry the cached
+        # tensorstore stores + KV sidecar, and their cutouts ride the
+        # shared hot-block LRU (volume/storage.py), so repeated serving
+        # loads of overlapping regions hit host memory, not the store
+        self._volumes: dict = {}
+        self._volumes_lock = threading.Lock()
 
     def handle(self, method: str, path: str, body: Optional[bytes] = None):
         if method == "POST" and path == "/infer":
@@ -490,7 +497,77 @@ class ServingService(CoordinationService):
             raise ValueError("request body must be a JSON object")
         return payload
 
+    def _volume(self, path: str):
+        """The cached PrecomputedVolume handle for one dataset path."""
+        with self._volumes_lock:
+            vol = self._volumes.get(path)
+        if vol is not None:
+            return vol
+        from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+        vol = PrecomputedVolume(path)
+        with self._volumes_lock:
+            # benign race: last writer wins, both handles share the
+            # process-wide backend/KV caches anyway
+            self._volumes[path] = vol
+        return vol
+
+    def _load_volume_chunk(self, payload: dict) -> Chunk:
+        """A volume-reference request: instead of inline ``data_b64``
+        the body names a precomputed volume and a bbox, and the serving
+        plane cuts the chunk out itself — through
+        :meth:`PrecomputedVolume.cutout`, i.e. block-decomposed
+        concurrent reads riding the shared hot-block LRU
+        (docs/storage.md), so overlapping serving loads hit host memory
+        instead of re-reading the store."""
+        path = payload.get("volume_path")
+        if not isinstance(path, str) or not path:
+            raise ValueError("volume_path must be a non-empty string")
+        if payload.get("data_b64") is not None:
+            raise ValueError(
+                "volume_path and data_b64 are mutually exclusive")
+        start = payload.get("bbox_start")
+        size = payload.get("bbox_size")
+        if (not isinstance(start, (list, tuple)) or len(start) != 3
+                or not all(isinstance(v, int) for v in start)):
+            raise ValueError("bbox_start must be three ints (zyx voxels)")
+        if (not isinstance(size, (list, tuple)) or len(size) != 3
+                or not all(isinstance(v, int) and v > 0 for v in size)):
+            raise ValueError(
+                "bbox_size must be three positive ints (zyx voxels)")
+        mip = payload.get("mip", 0)
+        if not isinstance(mip, int) or mip < 0:
+            raise ValueError("mip must be a non-negative int")
+        try:
+            vol = self._volume(path)
+            nchan = vol.num_channels
+            itemsize = np.dtype(vol.dtype).itemsize
+        except ValueError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — bad dataset = client error
+            raise ValueError(
+                f"cannot open volume {path!r}: "
+                f"{type(exc).__name__}: {exc}") from None
+        est = int(np.prod(size)) * nchan * itemsize
+        if est > self.max_body_bytes:
+            raise ValueError(
+                f"bbox implies {est} bytes, over the "
+                f"{self.max_body_bytes >> 20} MiB request bound")
+        from chunkflow_tpu.core.bbox import BoundingBox
+
+        bbox = BoundingBox.from_delta(tuple(start), tuple(size))
+        try:
+            return vol.cutout(bbox, mip=mip)
+        except ValueError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — unreadable region
+            raise ValueError(
+                f"cutout {tuple(start)}+{tuple(size)} failed: "
+                f"{type(exc).__name__}: {exc}") from None
+
     def _decode_chunk(self, payload: dict) -> Chunk:
+        if payload.get("volume_path") is not None:
+            return self._load_volume_chunk(payload)
         shape = payload.get("shape")
         if (not isinstance(shape, (list, tuple)) or len(shape) not in (3, 4)
                 or not all(isinstance(s, int) and s > 0 for s in shape)):

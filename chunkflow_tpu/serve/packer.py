@@ -131,12 +131,17 @@ class PendingResult:
 
 
 class _Request:
-    """Per-request provenance + accumulation state (host side)."""
+    """Per-request provenance + accumulation state. With the
+    device-resident front half (ISSUE 15, the default) the request's
+    chunk lives in ``device_chunk`` — uploaded ONCE, raw dtype — and
+    ``patches`` stays None; the host front half (``CHUNKFLOW_GATHER=
+    off`` or a raw-ineligible dtype) keeps the gathered host ``patches``
+    list instead."""
 
     __slots__ = (
         "chunk", "handle", "deadline", "trace_id", "orig_zyx", "run_zyx",
-        "n", "n_pad", "out_starts", "valid", "patches", "weighted",
-        "remaining", "lock", "enqueued_t",
+        "n", "n_pad", "in_starts", "out_starts", "valid", "patches",
+        "device_chunk", "weighted", "remaining", "lock", "enqueued_t",
     )
 
     def __init__(self, chunk, handle, deadline, trace_id):
@@ -287,20 +292,28 @@ class PatchPacker:
         return self.submit(chunk, deadline=deadline).result(timeout)
 
     def _prepare(self, req: _Request) -> None:
-        """Host-side request prep: f32 normalization, bucket padding,
-        patch gather, provenance bookkeeping. Pure numpy — exactness
-        notes in the module docstring."""
+        """Request prep: bucket padding, grid enumeration, provenance
+        bookkeeping — and the chunk's ONE trip to the device.
+
+        Device front half (the default): the chunk uploads ONCE in its
+        raw dtype (uint8 ships 1/4 the bytes of the old per-patch f32
+        re-uploads), is edge-padded to the bucket shape on device, and
+        batches later gather patch rows from it by index
+        (:meth:`_gather_program`) — per-chunk H2D drops from
+        ~(patch/stride)^3 x to 1x chunk size. The ``CHUNKFLOW_GATHER=
+        off`` kill switch (or a raw-ineligible dtype) restores the host
+        gather bit-identically: conversion, edge-padding and slicing are
+        IEEE-exact value copies that commute, so both fronts hand the
+        forward program bitwise-equal batches."""
+        import jax.numpy as jnp
+
+        from chunkflow_tpu.core import profiling
+        from chunkflow_tpu.ops import pallas_gather
+
         inf = self.inferencer
         chunk = req.chunk
         req.orig_zyx = tuple(chunk.shape[-3:])
         req.run_zyx = inf._run_shape(req.orig_zyx)
-        arr = _host_float32(chunk)
-        if req.run_zyx != req.orig_zyx:
-            pad = [(0, 0)] + [
-                (0, r - s) for r, s in zip(req.run_zyx, req.orig_zyx)
-            ]
-            # same edge-replicate the device path applies for bucketing
-            arr = np.pad(arr, pad, mode="edge")
         grid = enumerate_patches(
             req.run_zyx,
             inf.input_patch_size,
@@ -310,16 +323,50 @@ class PatchPacker:
         in_starts, out_starts, valid = pad_to_batch(grid, self.batch_size)
         req.n = grid.num_patches
         req.n_pad = len(valid)
+        req.in_starts = in_starts
         req.out_starts = out_starts
         req.valid = valid
         pin = tuple(inf.input_patch_size)
         pout = tuple(inf.output_patch_size)
         co = inf.num_output_channels
-        req.patches = [
-            arr[:, s[0]:s[0] + pin[0], s[1]:s[1] + pin[1],
-                s[2]:s[2] + pin[2]]
-            for s in in_starts[:req.n]
+        pad = [(0, 0)] + [
+            (0, r - s) for r, s in zip(req.run_zyx, req.orig_zyx)
         ]
+        device_front = (
+            pallas_gather.gather_mode() != "host"
+            and pallas_gather.raw_eligible(chunk.dtype)
+        )
+        if device_front:
+            arr = chunk.array
+            if not chunk.is_on_device:
+                arr = np.asarray(arr)
+                profiling.note_h2d(arr.nbytes, key=("serve_gather",))
+            arr = jnp.asarray(arr)  # the request's ONE H2D, raw dtype
+            if arr.ndim == 3:
+                arr = arr[None]
+            if req.run_zyx != req.orig_zyx:
+                # same edge-replicate the per-chunk path applies for
+                # bucketing — on the raw dtype (pad commutes with the
+                # conversion exactly)
+                arr = jnp.pad(arr, pad, mode="edge")
+            prepare, _ = pallas_gather.make_gather(
+                inf.num_input_channels, pin)
+            # resident form per leg: f32 once for the XLA gather, raw +
+            # alignment pad for the Pallas kernel — applied here so
+            # batches don't re-run it per dispatch
+            req.device_chunk = prepare(arr)
+            req.patches = None
+        else:
+            arr = _host_float32(chunk)
+            if req.run_zyx != req.orig_zyx:
+                # same edge-replicate the device path applies for bucketing
+                arr = np.pad(arr, pad, mode="edge")
+            req.device_chunk = None
+            req.patches = [
+                arr[:, s[0]:s[0] + pin[0], s[1]:s[1] + pin[1],
+                    s[2]:s[2] + pin[2]]
+                for s in in_starts[:req.n]
+            ]
         # padding rows stay exact zeros: bitwise what the fused program's
         # validity-0 entries contribute to the scatter-add
         req.weighted = np.zeros((req.n_pad, co) + pout, dtype=np.float32)
@@ -383,6 +430,38 @@ class PatchPacker:
             return jax.jit(program, donate_argnums=(0,))
 
         return inf._programs.get(("serve_forward",), build)
+
+    def _gather_program(self):
+        """The device-front batch assembler: gathers one packed batch's
+        rows for ONE request out of its resident chunk and overlays them
+        onto the accumulating batch via exact selection (``jnp.where``
+        keeps other requests' rows — and signed zeros — untouched).
+        Rows this request does not own carry mask 0 and starts (0,0,0).
+        Keyed by the gather selection (``CHUNKFLOW_GATHER`` flips
+        rebuild); jit handles chunk-shape/slot-count polymorphism."""
+        inf = self.inferencer
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            from chunkflow_tpu.ops import pallas_gather
+
+            _, gather = pallas_gather.make_gather(
+                inf.num_input_channels, tuple(inf.input_patch_size))
+
+            def program(chunk_like, starts, rowmask, acc):
+                rows = gather(chunk_like, starts)
+                mask = rowmask[:, None, None, None, None]
+                return jnp.where(mask > 0, rows, acc)
+
+            # acc is packer-owned and dead after the call (GL005); the
+            # resident chunk is NOT donated — later batches gather from it
+            return jax.jit(program, donate_argnums=(3,))
+
+        from chunkflow_tpu.ops.pallas_gather import gather_key
+
+        return inf._programs.get(("serve_gather",) + gather_key(), build)
 
     def _scatter_program(self, run_zyx, n_pad):
         inf = self.inferencer
@@ -493,11 +572,26 @@ class PatchPacker:
             slots = -(-len(live) // per) * per
         pin = tuple(inf.input_patch_size)
         ci = inf.num_input_channels
-        batch_np = np.zeros((slots, ci) + pin, dtype=np.float32)
         valid_np = np.zeros((slots,), dtype=np.float32)
+        host_rows = []  # (row, req, idx): host-front requests
+        dev_rows: dict = {}  # id(req) -> (req, [(row, idx), ...])
         for row, (req, idx, _) in enumerate(live):
-            batch_np[row] = req.patches[idx]
             valid_np[row] = 1.0
+            if req.patches is not None:
+                host_rows.append((row, req, idx))
+            else:
+                dev_rows.setdefault(id(req), (req, []))[1].append(
+                    (row, idx))
+
+        from chunkflow_tpu.core import profiling
+
+        # host-front rows (kill switch / raw-ineligible dtypes) assemble
+        # on the host and ride H2D gathered, as before
+        batch_np = None
+        if host_rows or not dev_rows:
+            batch_np = np.zeros((slots, ci) + pin, dtype=np.float32)
+            for row, req, idx in host_rows:
+                batch_np[row] = req.patches[idx]
         # per-chip occupancy: live patches over every chip's slots — the
         # same gauge the single-chip serving plane feeds, now spanning
         # the slice (docs/multichip.md "The three seams")
@@ -510,11 +604,34 @@ class PatchPacker:
 
         if inf._device_params is None:
             inf._device_params = jax.device_put(inf.engine.params)
+
+        # assemble the device batch: host-front rows upload gathered (the
+        # pre-ISSUE-15 structure, counted at the staging seam); device-
+        # front rows gather out of each request's RESIDENT chunk — no
+        # patch bytes cross the PCIe link
+        if batch_np is not None and (host_rows or not dev_rows):
+            if host_rows:
+                profiling.note_h2d(batch_np.nbytes, key=("serve_forward",))
+            batch_dev = jnp.asarray(batch_np)
+        else:
+            batch_dev = jnp.zeros((slots, ci) + pin, dtype=jnp.float32)
+        for req, rows in dev_rows.values():
+            starts = np.zeros((slots, 3), dtype=np.int32)
+            mask = np.zeros((slots,), dtype=np.float32)
+            for row, idx in rows:
+                starts[row] = req.in_starts[idx]
+                mask[row] = 1.0
+            gather = self._gather_program()
+            batch_dev = gather(
+                req.device_chunk, jnp.asarray(starts),
+                jnp.asarray(mask), batch_dev,
+            )
+
         program = (engine.serve_forward_program() if engine is not None
                    else self._forward_program())
         with telemetry.span("serving/forward", occupancy=round(occupancy, 3)):
             out = program(
-                jnp.asarray(batch_np), jnp.asarray(valid_np),
+                batch_dev, jnp.asarray(valid_np),
                 inf._device_params,
             )
             out_np = np.asarray(out)
@@ -523,9 +640,11 @@ class PatchPacker:
         for row, (req, idx, _) in enumerate(live):
             with req.lock:
                 req.weighted[idx] = out_np[row]
-                req.patches[idx] = None  # free the gathered input early
+                if req.patches is not None:
+                    req.patches[idx] = None  # free the gathered input early
                 req.remaining -= 1
                 if req.remaining == 0:
+                    req.device_chunk = None  # release the resident chunk
                     done.append(req)
         for req in done:
             try:

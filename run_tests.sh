@@ -200,6 +200,18 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py blend_fused --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "fused blend gate"
 
+# Device-resident front half (raw chunk uploaded once, convert+gather on
+# device) vs the host gather+convert+re-upload structure it replaced
+# (docs/performance.md "The device-resident front half"). The run asserts
+# bit-identity across both legs AND the real Pallas gather kernel in
+# interpret mode, and that both legs carry roofline rows in
+# programs.json; reports the >=1.2x target as gate_pass (asserted
+# slow-marked in tests/test_bench.py); the process only fails below 1.1x.
+echo "== front half gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py front_half --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "front half gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

@@ -1,0 +1,417 @@
+"""Device-resident front half (ops/pallas_gather.py, ISSUE 15): the
+device-gather legs (XLA dynamic_slice default + Pallas kernel in
+interpret mode) vs the ``CHUNKFLOW_GATHER=off`` host front half —
+BITWISE across the PR 13 parity matrix (plain/ragged/uint8/crop-margin x
+single-device and ``data=N``/``y=A,x=B`` meshes), packed-serve traffic,
+and every ``CHUNKFLOW_PRECISION``; plus the env-flip-rebuilds contract,
+the warn-once env parsing, the direct kernel oracle, and the
+``transfer/h2d_*`` staging-seam counters."""
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.inference import engines
+from chunkflow_tpu.inference.inferencer import Inferencer
+
+PIN = (4, 16, 16)
+OVERLAP = (2, 8, 8)
+
+GATHER_MODES = ["", "interpret"]  # device-resident legs, vs "off" ref
+
+
+@pytest.fixture
+def clean_telemetry(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_TELEMETRY", raising=False)
+    telemetry.reset()
+    yield monkeypatch
+    telemetry.reset()
+
+
+def _traffic_chunk(traffic: str, seed: int):
+    rng = np.random.default_rng(seed)
+    if traffic == "ragged":
+        return Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    if traffic == "uint8":
+        return Chunk(rng.integers(0, 256, (8, 40, 48), dtype=np.uint8))
+    return Chunk(rng.random((8, 40, 48)).astype(np.float32))
+
+
+def _matrix_inferencer(crop: bool, mesh=None, precision=None):
+    if crop:
+        engine = engines.create_identity_engine(
+            input_patch_size=PIN, output_patch_size=(2, 8, 8),
+            num_input_channels=1, num_output_channels=3,
+        )
+        return Inferencer(
+            input_patch_size=PIN,
+            output_patch_size=(2, 8, 8),
+            output_patch_overlap=(1, 4, 4),
+            num_output_channels=3,
+            framework="prebuilt",
+            batch_size=2,
+            engine=engine,
+            mesh=mesh,
+            precision=precision,
+            crop_output_margin=True,
+        )
+    engine = engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+    return Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=engine,
+        mesh=mesh,
+        precision=precision,
+        crop_output_margin=False,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE 15 parity matrix: device gather vs host gather, bitwise
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices (tests/conftest.py)")
+@pytest.mark.parametrize("mesh", [None, "data=2", "y=2,x=2"])
+@pytest.mark.parametrize(
+    "traffic", ["plain", "ragged", "uint8", "crop_margin"]
+)
+def test_gather_parity_matrix(monkeypatch, mesh, traffic):
+    """ISSUE 15 acceptance: both device-gather legs (XLA dynamic_slice
+    default AND the Pallas kernel in interpret mode) are BITWISE
+    identical to the CHUNKFLOW_GATHER=off host front across every
+    traffic class, single-device and both mesh kinds (the gather front
+    runs inside the sharded forward too)."""
+    crop = traffic == "crop_margin"
+    chunk = _traffic_chunk(traffic, seed=abs(hash(traffic)) % 2**31)
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "off")
+    ref = np.asarray(_matrix_inferencer(crop, mesh=mesh)(chunk).array)
+    for mode in GATHER_MODES:
+        monkeypatch.setenv("CHUNKFLOW_GATHER", mode)
+        got = np.asarray(_matrix_inferencer(crop, mesh=mesh)(chunk).array)
+        assert got.dtype == ref.dtype and got.shape == ref.shape
+        assert np.array_equal(got, ref), (
+            f"device gather diverged from host gather (mesh={mesh}, "
+            f"traffic={traffic}, mode={mode or 'device'}; max abs diff "
+            f"{np.abs(got.astype(np.float64) - ref.astype(np.float64)).max():.3e})"
+        )
+
+
+@pytest.mark.parametrize("precision", ["bfloat16", "int8"])
+def test_gather_parity_at_precisions(monkeypatch, precision):
+    """The bitwise device-vs-host gather contract survives every
+    CHUNKFLOW_PRECISION: the front half hands the (wrapped) forward
+    bitwise-equal patches, so the quantized outputs are bitwise equal
+    too."""
+    chunk = _traffic_chunk("uint8", seed=11)
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "off")
+    ref = np.asarray(
+        _matrix_inferencer(False, precision=precision)(chunk).array)
+    for mode in GATHER_MODES:
+        monkeypatch.setenv("CHUNKFLOW_GATHER", mode)
+        got = np.asarray(
+            _matrix_inferencer(False, precision=precision)(chunk).array)
+        assert np.array_equal(got, ref), (precision, mode)
+
+
+def test_gather_parity_packed_serve(monkeypatch):
+    """Packed-serve traffic with the device-resident front (request
+    chunk uploaded once, batches gathered on device across requests) is
+    bitwise identical to the host-gather packed path AND the per-chunk
+    path — for both device legs, on uint8 AND float32 ragged traffic."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    def chunks():
+        rng = np.random.default_rng(5)
+        out = []
+        for i in range(4):
+            if i % 2:
+                out.append(Chunk(
+                    rng.integers(0, 256, (4, 16, 48), dtype=np.uint8),
+                    voxel_offset=(8 * i, 0, 0)))
+            else:
+                out.append(Chunk(
+                    rng.random((4, 16, 48), dtype=np.float32),
+                    voxel_offset=(8 * i, 0, 0)))
+        return out
+
+    def packed(mode):
+        monkeypatch.setenv("CHUNKFLOW_GATHER", mode)
+        inf = Inferencer(
+            input_patch_size=PIN,
+            num_output_channels=2,
+            framework="identity",
+            batch_size=4,
+            crop_output_margin=False,
+        )
+        packer = PatchPacker(inf, max_wait_ms=2.0)
+        try:
+            handles = [packer.submit(c) for c in chunks()]
+            return [np.asarray(h.result(timeout=60).array)
+                    for h in handles]
+        finally:
+            packer.close()
+
+    ref = packed("off")
+    for mode in GATHER_MODES:
+        got = packed(mode)
+        monkeypatch.setenv("CHUNKFLOW_GATHER", mode)
+        inf = Inferencer(
+            input_patch_size=PIN,
+            num_output_channels=2,
+            framework="identity",
+            batch_size=4,
+            crop_output_margin=False,
+        )
+        per_chunk = [np.asarray(inf(c).array) for c in chunks()]
+        for r, g, p in zip(ref, got, per_chunk):
+            assert np.array_equal(g, r), (mode,)
+            assert np.array_equal(g, p), (mode,)
+
+
+def test_gather_key_rebuilds_on_env_flip(monkeypatch):
+    """Flipping CHUNKFLOW_GATHER mid-stream builds the selected front's
+    program under its own cache key instead of reusing a stale one (the
+    CHUNKFLOW_PALLAS/CHUNKFLOW_MESH re-read convention) — and the
+    default device leg keeps the historical ``("scatter",)`` key."""
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "")
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=2,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(1)
+    chunk = Chunk(rng.integers(0, 256, (8, 32, 32), dtype=np.uint8))
+    ref = np.asarray(inf(chunk).array)
+    assert ("scatter",) in inf._programs
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "off")
+    got = np.asarray(inf(chunk).array)
+    assert ("scatter", "gather-host") in inf._programs
+    assert np.array_equal(got, ref)
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "interpret")
+    got = np.asarray(inf(chunk).array)
+    assert ("scatter", "gather-pallas-interpret") in inf._programs
+    assert np.array_equal(got, ref)
+    assert inf._programs.builds == 3
+
+
+# ---------------------------------------------------------------------------
+# env parsing: warn once on unrecognized values (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+def test_gather_mode_warns_once_on_typo(monkeypatch, capsys):
+    """A mistyped CHUNKFLOW_GATHER must not silently pick a front: one
+    stderr warning per unrecognized value (resolving to the default
+    device leg), then quiet; recognized values never warn."""
+    from chunkflow_tpu.ops import pallas_gather
+
+    monkeypatch.setattr(pallas_gather, "_WARNED_VALUES", set())
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "divice")
+    assert pallas_gather.gather_mode() == "device"
+    err = capsys.readouterr().err
+    assert "divice" in err and "not a recognized value" in err
+    # second call with the same typo: silent (warned once)
+    assert pallas_gather.gather_mode() == "device"
+    assert capsys.readouterr().err == ""
+    # a DIFFERENT typo warns again
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "yes please")
+    assert pallas_gather.gather_mode() == "device"
+    assert "not a recognized value" in capsys.readouterr().err
+    # recognized values never warn
+    for value, expected in [("", "device"), ("on", "device"),
+                            ("device", "device"), ("xla", "device"),
+                            ("0", "host"), ("off", "host"),
+                            ("host", "host"), ("pallas", "pallas"),
+                            ("force", "pallas"),
+                            ("interpret", "interpret")]:
+        monkeypatch.setenv("CHUNKFLOW_GATHER", value)
+        assert pallas_gather.gather_mode() == expected
+    assert capsys.readouterr().err == ""
+
+
+# ---------------------------------------------------------------------------
+# direct kernel checks
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("dtype", ["uint8", "uint16", "float32"])
+def test_gather_kernel_vs_numpy(dtype):
+    """Direct kernel oracle: window DMA + in-VMEM conversion must
+    reproduce numpy's convert-then-slice bitwise — for int dtypes
+    (normalized by 1/iinfo.max) and float32 (no conversion) — at starts
+    with no (sublane, 128) alignment at all."""
+    import jax.numpy as jnp
+
+    from chunkflow_tpu.ops import pallas_gather
+
+    rng = np.random.default_rng(7)
+    ci, shape = 2, (9, 40, 50)
+    pin = (3, 12, 18)
+    if dtype == "float32":
+        raw = rng.standard_normal((ci,) + shape).astype(np.float32)
+        expected_full = raw
+    else:
+        info = np.iinfo(np.dtype(dtype))
+        raw = rng.integers(0, info.max, (ci,) + shape).astype(dtype)
+        expected_full = raw.astype(np.float32) * np.float32(1.0 / info.max)
+    starts = np.array(
+        [[0, 0, 0], [1, 7, 13], [6, 28, 32], [2, 19, 5]], np.int32
+    )
+    pad_y, pad_x = pallas_gather.gather_buffer_padding(pin, raw.dtype)
+    padded = np.pad(raw, [(0, 0), (0, 0), (0, pad_y), (0, pad_x)])
+    got = np.asarray(pallas_gather.gather_patches(
+        jnp.asarray(padded), jnp.asarray(starts), pin, interpret=True))
+    assert got.dtype == np.float32
+    for b, (z, y, x) in enumerate(starts):
+        exp = expected_full[:, z:z + pin[0], y:y + pin[1], x:x + pin[2]]
+        assert np.array_equal(got[b], exp), (dtype, b)
+
+
+def test_gather_window_alignment_by_dtype():
+    """The aligned-window geometry follows the dtype's Mosaic tiling:
+    8 sublanes for f32, 16 for 16-bit, 32 for 8-bit; lanes always
+    128."""
+    from chunkflow_tpu.ops import pallas_gather
+
+    assert pallas_gather.gather_window(12, 18, np.float32) == (24, 256)
+    assert pallas_gather.gather_window(12, 18, np.uint16) == (32, 256)
+    assert pallas_gather.gather_window(12, 18, np.uint8) == (64, 256)
+    # buffer padding covers the worst-case round-down at a flush edge
+    for dt in (np.float32, np.uint16, np.uint8):
+        wy, wx = pallas_gather.gather_window(12, 18, dt)
+        assert pallas_gather.gather_buffer_padding((3, 12, 18), dt) == (
+            wy - 12, wx - 18)
+
+
+# ---------------------------------------------------------------------------
+# the staging seam: transfer/h2d_* counters (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+def test_h2d_counter_once_per_chunk(clean_telemetry):
+    """The sync per-chunk path counts exactly one raw-chunk upload at
+    the staging seam, attributed to the consuming program family in the
+    profiling catalog."""
+    from chunkflow_tpu.core import profiling
+
+    clean_telemetry.setenv("CHUNKFLOW_GATHER", "")
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=2,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 256, (8, 32, 32), dtype=np.uint8)
+    inf(Chunk(arr))
+    snap = telemetry.snapshot()
+    assert snap["counters"]["transfer/h2d_chunks"] == 1
+    assert snap["counters"]["transfer/h2d_bytes"] == arr.nbytes
+    assert profiling.h2d_by_family().get("scatter") == arr.nbytes
+    # the programs.json catalog carries the per-family column
+    entries = {e["family"]: e for e in profiling.catalog()}
+    assert entries["scatter"]["h2d_bytes"] == arr.nbytes
+
+
+def test_h2d_counter_staged_chunk(clean_telemetry):
+    """Pipeline-staged chunks count at Chunk.device (raw bytes, once);
+    the already-resident chunk is NOT recounted at dispatch."""
+    clean_telemetry.setenv("CHUNKFLOW_GATHER", "")
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=2,
+        framework="identity",
+        batch_size=2,
+        crop_output_margin=False,
+    )
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 256, (8, 32, 32), dtype=np.uint8)
+    staged = inf.stage(Chunk(arr))
+    assert staged.is_on_device
+    inf.infer_async(staged, consume=True).array.block_until_ready()
+    snap = telemetry.snapshot()
+    assert snap["counters"]["transfer/h2d_chunks"] == 1
+    assert snap["counters"]["transfer/h2d_bytes"] == arr.nbytes
+
+
+def test_h2d_packed_serve_device_vs_host(clean_telemetry):
+    """The acceptance byte contract: with the device front a request's
+    chunk crosses H2D ONCE at raw size; the host front re-uploads every
+    gathered patch as float32 — ~(patch/stride)^3 x more bytes, visible
+    on the same counter."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    def run(mode):
+        clean_telemetry.setenv("CHUNKFLOW_GATHER", mode)
+        telemetry.reset()
+        inf = Inferencer(
+            input_patch_size=PIN,
+            output_patch_overlap=OVERLAP,
+            num_output_channels=2,
+            framework="identity",
+            batch_size=2,
+            crop_output_margin=False,
+        )
+        rng = np.random.default_rng(9)
+        arr = rng.integers(0, 256, (8, 32, 32), dtype=np.uint8)
+        packer = PatchPacker(inf, max_wait_ms=1.0)
+        try:
+            out = packer.submit(Chunk(arr)).result(timeout=60)
+            assert out is not None
+        finally:
+            packer.close()
+        return arr.nbytes, telemetry.snapshot()["counters"]
+
+    nbytes, device_counters = run("")
+    assert device_counters["transfer/h2d_chunks"] == 1
+    assert device_counters["transfer/h2d_bytes"] == nbytes
+    _, host_counters = run("off")
+    # the host front ships gathered float32 batches: strictly more
+    # bytes than the raw chunk — the (patch/stride)^3 x overlap factor
+    # times the 4x dtype widening
+    assert host_counters["transfer/h2d_bytes"] >= 4 * nbytes
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# graftlint pin over the ISSUE 15 modules
+# ---------------------------------------------------------------------------
+def test_gather_modules_are_graftlint_clean():
+    """ISSUE 15 acceptance: GL001-GL014 clean over the new/changed
+    front-half modules, asserted in-suite (the whole-repo gate covers
+    them too; this pins the specific modules so a future baseline
+    regeneration cannot quietly grandfather a finding here)."""
+    from pathlib import Path
+
+    from tools.graftlint.config import load_config
+    from tools.graftlint.engine import lint_paths
+
+    repo_root = Path(__file__).resolve().parents[2]
+    config = load_config(repo_root / "pyproject.toml")
+    findings, _ = lint_paths(
+        [
+            "chunkflow_tpu/ops/pallas_gather.py",
+            "chunkflow_tpu/ops/blend.py",
+            "chunkflow_tpu/inference/inferencer.py",
+            "chunkflow_tpu/serve/packer.py",
+            "chunkflow_tpu/serve/frontend.py",
+            "chunkflow_tpu/parallel/engine.py",
+            "chunkflow_tpu/chunk/base.py",
+            "chunkflow_tpu/core/profiling.py",
+            "chunkflow_tpu/flow/log_summary.py",
+        ],
+        config, repo_root=repo_root,
+    )
+    assert not findings, [
+        f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
+    ]

@@ -483,3 +483,136 @@ def test_cli_serve_end_to_end(clean, tmp_path):
     assert "serving: http://127.0.0.1:" in out
     assert "serve drained:" in out
     assert result["run"].exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# volume-reference requests ride the shared BlockCache (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+def test_volume_reference_request_rides_block_cache(clean, tmp_path):
+    """A request naming a precomputed volume + bbox instead of inline
+    data: the serving plane cuts the chunk out itself through
+    PrecomputedVolume.cutout — block-decomposed reads riding the shared
+    hot-block LRU (docs/storage.md) — and the result is bit-exact with
+    the same region posted inline. A second overlapping request hits the
+    cache instead of the store."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+    from chunkflow_tpu.volume.storage import reset_shared_cache
+
+    clean.delenv("CHUNKFLOW_STORAGE_CACHE_MB", raising=False)
+    reset_shared_cache()
+    vol = PrecomputedVolume.create(
+        str(tmp_path / "vol"),
+        volume_size=(16, 48, 48),
+        voxel_size=(40, 4, 4),
+        voxel_offset=(0, 0, 0),
+        dtype="uint8",
+        block_size=(8, 16, 16),
+    )
+    source = Chunk.create((16, 48, 48), dtype=np.uint8,
+                          voxel_size=(40, 4, 4))
+    vol.save(source)
+    # drop the write-through-populated cache so the FIRST serving load
+    # demonstrably reads the store (misses), and the second hits
+    reset_shared_cache()
+
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1)
+    service = ServingService(backend)
+    try:
+        body = json.dumps({
+            "volume_path": str(tmp_path / "vol"),
+            "bbox_start": [0, 0, 0],
+            "bbox_size": [8, 32, 48],
+            "deadline_s": 30.0,
+        }).encode()
+        status, payload = service.handle("POST", "/infer", body)
+        assert status == 200, payload
+        inline = np.asarray(source.array)[:8, :32, :48]
+        ref_status, ref_payload = service.handle(
+            "POST", "/infer", infer_body(inline))
+        assert ref_status == 200
+        assert np.array_equal(decode_response(payload),
+                              decode_response(ref_payload))
+
+        misses_before = telemetry.snapshot()["counters"].get(
+            "storage/misses", 0)
+        assert misses_before > 0  # the first load really hit the store
+        status, _ = service.handle("POST", "/infer", body)
+        assert status == 200
+        counters = telemetry.snapshot()["counters"]
+        # the repeat load is served from the shared hot-block LRU: hits
+        # accrue, misses do not
+        assert counters.get("storage/hits", 0) > 0
+        assert counters.get("storage/misses", 0) == misses_before
+        # one cached volume handle, reused across requests
+        assert len(service._volumes) == 1
+    finally:
+        backend.close()
+        reset_shared_cache()
+
+
+def test_volume_reference_request_validation(clean, tmp_path):
+    """Volume-reference request validation is a clean 400: bad bbox,
+    mixing inline data with a volume ref, an unreadable dataset, and an
+    over-bound bbox all fail without touching the worker pool."""
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1)
+    service = ServingService(backend, max_body_mb=1.0)
+    try:
+        def post(payload):
+            return service.handle(
+                "POST", "/infer", json.dumps(payload).encode())
+
+        status, payload = post({"volume_path": str(tmp_path / "nope"),
+                                "bbox_start": [0, 0, 0],
+                                "bbox_size": [8, 16, 16]})
+        assert status == 400 and "cannot open volume" in payload["error"]
+        status, payload = post({"volume_path": "x", "bbox_start": [0, 0],
+                                "bbox_size": [8, 16, 16]})
+        assert status == 400 and "bbox_start" in payload["error"]
+        status, payload = post({"volume_path": "x",
+                                "bbox_start": [0, 0, 0],
+                                "bbox_size": [8, 16, 0]})
+        assert status == 400 and "bbox_size" in payload["error"]
+        status, payload = post({"volume_path": "x",
+                                "bbox_start": [0, 0, 0],
+                                "bbox_size": [8, 16, 16],
+                                "data_b64": "AAAA"})
+        assert status == 400 and "mutually exclusive" in payload["error"]
+        status, payload = post({"volume_path": "x",
+                                "bbox_start": [0, 0, 0],
+                                "bbox_size": [8, 16, 16],
+                                "mip": -1})
+        assert status == 400 and "mip" in payload["error"]
+    finally:
+        backend.close()
+
+
+def test_volume_reference_over_bound_bbox_rejected(clean, tmp_path):
+    """A bbox implying more bytes than the request bound is refused
+    BEFORE any store read."""
+    pytest.importorskip("tensorstore")
+    from chunkflow_tpu.volume.precomputed import PrecomputedVolume
+
+    PrecomputedVolume.create(
+        str(tmp_path / "vol"),
+        volume_size=(16, 48, 48),
+        voxel_size=(40, 4, 4),
+        voxel_offset=(0, 0, 0),
+        dtype="uint8",
+        block_size=(8, 16, 16),
+    )
+    inferencer = make_inferencer()
+    backend = LocalBackend(inferencer, workers=1)
+    service = ServingService(backend, max_body_mb=0.00001)
+    try:
+        status, payload = service.handle("POST", "/infer", json.dumps({
+            "volume_path": str(tmp_path / "vol"),
+            "bbox_start": [0, 0, 0],
+            "bbox_size": [8, 32, 48],
+        }).encode())
+        assert status == 400
+        assert "over the" in payload["error"]
+    finally:
+        backend.close()

@@ -473,6 +473,56 @@ def bench_blend_fused():
     }
 
 
+@step("bench_front_half")
+def bench_front_half():
+    """Device-gather vs host-gather ON-CHIP A/B (ISSUE 15): the
+    device-resident front half (raw chunk uploaded once, convert+gather
+    in-program — ops/pallas_gather.py) against the CHUNKFLOW_GATHER=off
+    host front on the flagship config — both legs banked in ONE row so
+    the comparison is atomic. On a real tunnel the delta is PCIe bytes:
+    the host front re-converts and the per-chunk path pays an eager
+    whole-chunk f32 materialization before the program. A CPU-only
+    window records an honest skip — the structural win is gated on CPU
+    by ``bench.py front_half`` and correctness by the gather parity
+    matrix in tier-1, but neither is an on-chip number."""
+    plat = _platform()
+    if plat not in ("tpu", "axon"):
+        return {
+            "skipped": True,
+            "platform": plat,
+            "note": (
+                "CPU-only window: the device-vs-host front-half A/B "
+                "needs a chip; bench.py front_half gates the "
+                "H2D/data-movement structure on CPU and "
+                "tests/ops/test_pallas_gather.py pins bitwise parity "
+                "in tier-1 — re-run when the tunnel has a chip"
+            ),
+        }
+    prev = os.environ.get("CHUNKFLOW_GATHER")
+    try:
+        os.environ["CHUNKFLOW_GATHER"] = "off"
+        host = _bench("0", "tpu", "bfloat16", 4)
+        os.environ["CHUNKFLOW_GATHER"] = "on"
+        device = _bench("0", "tpu", "bfloat16", 4)
+    finally:
+        if prev is None:
+            os.environ.pop("CHUNKFLOW_GATHER", None)
+        else:
+            os.environ["CHUNKFLOW_GATHER"] = prev
+    speedup = (device["mvox_s"] / host["mvox_s"]
+               if host.get("mvox_s") else None)
+    return {
+        "mvox_s": device.get("mvox_s"),
+        "host_mvox_s": host.get("mvox_s"),
+        "speedup": round(speedup, 3) if speedup else None,
+        "note": (
+            "device-resident front half (raw chunk resident, in-program "
+            "convert+gather) vs the CHUNKFLOW_GATHER=off host front, "
+            "same flagship config, one atomic row"
+        ),
+    }
+
+
 @step("e2e_split")
 def e2e_split():
     """Where does the flagship config's wall time go? Separate H2D,
@@ -1015,6 +1065,9 @@ def main():
              bench_blend_fused,  # fused-vs-scatter A/B in ONE row
              # (ISSUE 14): the measurement that retires the stale 1.79
              # cached headline; cheap skip on a CPU-only window
+             bench_front_half,  # device-vs-host front-half A/B in ONE
+             # row (ISSUE 15): the PCIe-bytes measurement; cheap skip
+             # on a CPU-only window
              bench_multichip,  # unified-engine slice row (ISSUE 13):
              # cheap skip on a single-chip tunnel, the first real
              # multi-chip throughput number when a slice window opens
