@@ -1,0 +1,91 @@
+"""Shared warn-once env-mode parsing (ISSUE 16 satellite).
+
+Three kernel/precision selection knobs grew the same parser
+independently — ``CHUNKFLOW_PALLAS`` (ops/pallas_blend.py),
+``CHUNKFLOW_GATHER`` (ops/pallas_gather.py) and the lenient env path of
+``CHUNKFLOW_PRECISION`` (inference/precision.py) — each with the same
+three-part contract:
+
+1. recognized values map to a mode, case-insensitively;
+2. unrecognized values resolve to a SAFE default (a typo must never
+   force-select a compiled Mosaic kernel or a quantized forward, and
+   must never silently pick a slow fallback either);
+3. the fallback warns ONCE per distinct unrecognized value on stderr,
+   tracked in a per-variable warned-set so long-lived workers don't
+   spam and tests can reset it.
+
+:func:`resolve` is that contract, once, so the fused patch program's
+future knob (ROADMAP: gather->forward->blend in one kernel) does not
+become copy #4. Callers keep their own module-level ``_WARNED_VALUES``
+set and pass it in — the established test seam monkeypatches the
+caller's set, and per-module sets keep one variable's typos from
+muting another's.
+
+Import-light on purpose: selection helpers run before jax loads.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Dict, Optional, Sequence, Set, Tuple
+
+__all__ = ["resolve"]
+
+#: fallback warned-sets for callers that don't carry their own,
+#: keyed per variable so CHUNKFLOW_PALLAS typos never mute
+#: CHUNKFLOW_GATHER warnings
+_WARNED_BY_VAR: Dict[str, Set[str]] = {}
+
+
+def resolve(
+    var: str,
+    choices: Dict[str, Tuple[str, ...]],
+    default: str,
+    note: str,
+    warned: Optional[Set[str]] = None,
+    normalize: Optional[Callable[[str], str]] = None,
+) -> str:
+    """The mode selected by env var ``var``: the first ``choices`` entry
+    whose recognized-value tuple contains the (lowercased, optionally
+    ``normalize``d) env value; ``default`` with a one-time stderr
+    warning otherwise.
+
+    choices:   mode -> recognized raw values (include ``""`` wherever
+               unset-env should land WITHOUT warning)
+    note:      what the fallback means operationally, appended to the
+               warning so a typo'd opt-in says which path actually runs
+    warned:    the caller's per-variable warned-set (module-level, so
+               tests can reset it); defaults to an internal per-``var``
+               set
+    normalize: alias folding applied after lowercasing (the precision
+               spec's ``bf16`` -> ``bfloat16``)
+    """
+    env = os.environ.get(var, "").lower()
+    if normalize is not None:
+        env = normalize(env)
+    for mode, values in choices.items():
+        if env in values:
+            return mode
+    if warned is None:
+        warned = _WARNED_BY_VAR.setdefault(var, set())
+    if env not in warned:
+        warned.add(env)
+        expected = ", ".join(
+            "/".join(v for v in values if v) or "(unset)"
+            for values in choices.values()
+        )
+        print(
+            f"{var}={os.environ.get(var)!r} is not a recognized value "
+            f"(expected one of {expected}); {note}",
+            file=sys.stderr,
+        )
+    return default
+
+
+def recognized_values(choices: Dict[str, Sequence[str]]) -> Tuple[str, ...]:
+    """Every recognized raw value across ``choices`` (tests enumerate
+    these to assert no recognized value ever warns)."""
+    out = []
+    for values in choices.values():
+        out.extend(values)
+    return tuple(out)

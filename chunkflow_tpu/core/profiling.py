@@ -140,8 +140,8 @@ class _ProgramRecord:
 
     __slots__ = (
         "family", "key", "label", "build_s", "compile_s", "flops",
-        "bytes_accessed", "optimal_s", "calls", "dispatch_s",
-        "platform", "device_kind", "lock",
+        "bytes_accessed", "vmem_bytes", "optimal_s", "calls",
+        "dispatch_s", "platform", "device_kind", "lock",
     )
 
     def __init__(self, family: str, key: str, label: str, build_s: float):
@@ -152,6 +152,7 @@ class _ProgramRecord:
         self.compile_s: Optional[float] = None
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
+        self.vmem_bytes: Optional[float] = None
         self.optimal_s: Optional[float] = None
         self.calls = 0
         self.dispatch_s = 0.0  # post-compile dispatch wall, cumulative
@@ -232,11 +233,13 @@ class _InstrumentedProgram:
                 rec.platform, rec.device_kind = _device_identity()
                 flops = cost.get("flops")
                 nbytes = cost.get("bytes accessed")
+                vmem = cost.get("vmem_bytes")
                 optimal = cost.get("optimal_seconds")
                 rec.flops = float(flops) if flops is not None else None
                 rec.bytes_accessed = (
                     float(nbytes) if nbytes is not None else None
                 )
+                rec.vmem_bytes = float(vmem) if vmem is not None else None
                 rec.optimal_s = (
                     float(optimal) if optimal is not None else None
                 )
@@ -283,19 +286,28 @@ class _CostStamped:
 
 
 def stamp_cost(program, flops: Optional[float] = None,
-               bytes_accessed: Optional[float] = None):
+               bytes_accessed: Optional[float] = None,
+               vmem_bytes: Optional[float] = None):
     """Attach an ANALYTIC cost model to a program before it enters a
     ProgramCache: the ledger then scores its roofline against these
     numbers instead of XLA's ``cost_analysis()``. Use for programs the
     unoptimized-HLO analysis cannot see into (Pallas custom calls) or
     systematically miscounts (loop-body traffic) — the stamp is the
     builder's arithmetic, so it must state what the program actually
-    moves/computes, not what would look good."""
+    moves/computes, not what would look good. ``vmem_bytes`` is the
+    kernel's analytic on-chip footprint (block windows, double-buffered
+    where the pipeline does, plus scratch — the GL021 arithmetic; see
+    ``ops/pallas_blend.fused_kernel_cost`` /
+    ``ops/pallas_gather.gather_kernel_cost``), surfaced as the catalog's
+    ``vmem_bytes`` column so a budget regression shows up in the DEVICE
+    PROGRAMS table before it shows up as a Mosaic OOM."""
     cost: dict = {}
     if flops is not None:
         cost["flops"] = float(flops)
     if bytes_accessed is not None:
         cost["bytes accessed"] = float(bytes_accessed)
+    if vmem_bytes is not None:
+        cost["vmem_bytes"] = float(vmem_bytes)
     return _CostStamped(program, cost)
 
 
@@ -382,6 +394,7 @@ def catalog() -> list:
                 ),
                 "flops": rec.flops,
                 "bytes_accessed": rec.bytes_accessed,
+                "vmem_bytes": rec.vmem_bytes,
                 "optimal_s": rec.optimal_s,
                 "calls": rec.calls + (1 if rec.compile_s is not None else 0),
                 "dispatch_total_s": round(rec.dispatch_s, 4),

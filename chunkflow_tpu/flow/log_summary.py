@@ -395,8 +395,8 @@ def print_program_summary(programs: List[dict], top: int = 10) -> None:
           "× calls; util is an upper bound under async dispatch):")
     print(
         f"  {'family':<14} {'key':<12} {'lost_s':>8} {'compile_s':>9} "
-        f"{'flops':>9} {'bytes':>9} {'h2d':>9} {'exec_ms':>8} "
-        f"{'roofline':>8}"
+        f"{'flops':>9} {'bytes':>9} {'vmem':>8} {'h2d':>9} "
+        f"{'exec_ms':>8} {'roofline':>8}"
     )
     for entry in programs[:top]:
         exec_s = entry.get("exec_mean_s")
@@ -409,6 +409,7 @@ def print_program_summary(programs: List[dict], top: int = 10) -> None:
             f"{entry.get('compile_s') or 0.0:>9.3f} "
             f"{_fmt_quantity(entry.get('flops'), 1e9, 'G'):>9} "
             f"{_fmt_quantity(entry.get('bytes_accessed'), 2**20, 'M'):>9} "
+            f"{_fmt_quantity(entry.get('vmem_bytes'), 2**20, 'M'):>8} "
             f"{_fmt_quantity(entry.get('h2d_bytes'), 2**20, 'M'):>9} "
             f"{exec_s * 1e3 if exec_s else 0.0:>8.2f} "
             f"{(f'{util:.1%}' if util is not None else '-'):>8}"

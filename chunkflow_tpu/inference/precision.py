@@ -49,9 +49,9 @@ identity AND conv engines, including ragged and crop-margin traffic.
 """
 from __future__ import annotations
 
-import os
-import sys
 from typing import Callable, Optional
+
+from chunkflow_tpu.core import envmode
 
 __all__ = ["PRECISIONS", "resolve_precision", "wrap_apply"]
 
@@ -60,13 +60,20 @@ PRECISIONS = ("float32", "bfloat16", "int8")
 _ALIASES = {"f32": "float32", "fp32": "float32", "bf16": "bfloat16",
             "i8": "int8"}
 
+_MODE_CHOICES = {
+    "float32": ("", "float32"),
+    "bfloat16": ("bfloat16",),
+    "int8": ("int8",),
+}
+
 _WARNED_VALUES: set = set()
 
 
 def resolve_precision(value: Optional[str] = None) -> str:
     """The effective forward precision. An explicit ``value`` is strict
     (unknown -> ``ValueError``); the ``CHUNKFLOW_PRECISION`` env var is
-    lenient (unknown -> one-time stderr warning, float32)."""
+    lenient (unknown -> one-time stderr warning, float32 — the shared
+    warn-once contract in core/envmode.py)."""
     if value is not None:
         v = str(value).lower()
         v = _ALIASES.get(v, v)
@@ -75,22 +82,13 @@ def resolve_precision(value: Optional[str] = None) -> str:
                 f"precision must be one of {PRECISIONS} (got {value!r})"
             )
         return v
-    env = os.environ.get("CHUNKFLOW_PRECISION", "").lower()
-    env = _ALIASES.get(env, env)
-    if env in ("", "float32"):
-        return "float32"
-    if env in PRECISIONS:
-        return env
-    if env not in _WARNED_VALUES:
-        _WARNED_VALUES.add(env)
-        print(
-            f"CHUNKFLOW_PRECISION={os.environ.get('CHUNKFLOW_PRECISION')!r}"
-            f" is not a recognized value (expected one of "
-            f"{'/'.join(PRECISIONS)}); running the float32 default — a "
-            f"typo must not silently select a quantized forward",
-            file=sys.stderr,
-        )
-    return "float32"
+    return envmode.resolve(
+        "CHUNKFLOW_PRECISION", _MODE_CHOICES, default="float32",
+        note="running the float32 default — a typo must not silently "
+             "select a quantized forward",
+        warned=_WARNED_VALUES,
+        normalize=lambda env: _ALIASES.get(env, env),
+    )
 
 
 def _cast_float_leaves(tree, dtype):

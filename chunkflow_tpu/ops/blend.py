@@ -53,11 +53,20 @@ def kernel_tag() -> str:
     whose accumulation rides :func:`make_accumulate` folds this tag into
     its cache key, so flipping ``CHUNKFLOW_PALLAS`` mid-stream builds the
     right program instead of reusing a stale one (the same re-read-per-
-    chunk convention as ``CHUNKFLOW_MESH``)."""
+    chunk convention as ``CHUNKFLOW_MESH``). The interpret tag carries
+    the kernelcheck sanitizer's ``+kc`` suffix while it is live — its
+    hooks change the traced program, so they are part of the program
+    identity."""
     from chunkflow_tpu.ops import pallas_blend
 
     mode = pallas_blend.pallas_mode()
-    return "scatter" if mode == "off" else f"fused-{mode}"
+    if mode == "off":
+        return "scatter"
+    if mode == "interpret":
+        from chunkflow_tpu.testing import kernelcheck
+
+        return f"fused-interpret{kernelcheck.key_suffix()}"
+    return f"fused-{mode}"
 
 
 def make_accumulate(output_patch_size: Tuple[int, int, int], bump):

@@ -40,16 +40,20 @@ bench_blend_fused`` stamps the fused-vs-scatter on-chip row.
 """
 from __future__ import annotations
 
-import os
-import sys
 from typing import Tuple
 
+from chunkflow_tpu.core import envmode
 from chunkflow_tpu.core.contracts import Spec, contract
 
 Triple = Tuple[int, int, int]
 
 _ON_VALUES = ("1", "on", "true", "force")
 _OFF_VALUES = ("", "0", "off", "false", "no")
+_MODE_CHOICES = {
+    "off": _OFF_VALUES,
+    "on": _ON_VALUES,
+    "interpret": ("interpret",),
+}
 _WARNED_VALUES: set = set()
 
 
@@ -67,25 +71,17 @@ def pallas_mode() -> str:
     applies until bench_blend_fused beats the XLA scatter on hardware.
 
     Unrecognized values resolve to OFF — a typo must not force-select the
-    compiled Mosaic kernel on a CPU box — but warn ONCE on stderr: a
-    mistyped opt-in (``CHUNKFLOW_PALLAS=ture``) must not silently run the
-    slow path either.
+    compiled Mosaic kernel on a CPU box — but warn ONCE on stderr
+    (core/envmode.py holds the shared contract): a mistyped opt-in
+    (``CHUNKFLOW_PALLAS=ture``) must not silently run the slow path
+    either.
     """
-    env = os.environ.get("CHUNKFLOW_PALLAS", "").lower()
-    if env == "interpret":
-        return "interpret"
-    if env in _ON_VALUES:
-        return "on"
-    if env not in _OFF_VALUES and env not in _WARNED_VALUES:
-        _WARNED_VALUES.add(env)
-        print(
-            f"CHUNKFLOW_PALLAS={os.environ.get('CHUNKFLOW_PALLAS')!r} is "
-            f"not a recognized value (expected one of "
-            f"0/off/false/no, 1/on/true/force, interpret); treating it as "
-            f"OFF — the XLA scatter path runs, not the fused Pallas kernel",
-            file=sys.stderr,
-        )
-    return "off"
+    return envmode.resolve(
+        "CHUNKFLOW_PALLAS", _MODE_CHOICES, default="off",
+        note="treating it as OFF — the XLA scatter path runs, not the "
+             "fused Pallas kernel",
+        warned=_WARNED_VALUES,
+    )
 
 
 # Mosaic tiling of the two minor dims: DMA slice offsets into a tiled HBM
@@ -115,6 +111,42 @@ def buffer_padding(pout: Triple) -> Tuple[int, int]:
     buffer edge whose aligned corner rounds down by up to 7/127)."""
     py_pad, px_pad = padded_patch_shape(pout[1], pout[2])
     return (py_pad - pout[1], px_pad - pout[2])
+
+
+def fused_kernel_cost(B: int, co: int, pout: Triple) -> dict:
+    """Analytic cost of one :func:`fused_accumulate_patches` build —
+    the builder's own arithmetic, for ``profiling.stamp_cost`` and
+    ``tools/kernel_report.py``. VMEM is the GL021 model: pipelined
+    blocks double-buffered unless constant-index, plus scratch. Bytes
+    are per whole grid; ``bytes_per_step`` is the worst (c == 0) step,
+    which RMWs both the out and the weight window.
+
+    Returns ``{grid_steps, vmem_bytes, bytes_per_step, bytes_accessed,
+    flops}``.
+    """
+    pz, py, px = pout
+    py_pad, px_pad = padded_patch_shape(py, px)
+    tile = py * px * 4          # the streamed preds block (1,1,1,py,px)
+    window = py_pad * px_pad * 4  # one aligned RMW window / the scratch
+    vmem = (
+        2 * tile              # preds block, dynamic index: double-buffered
+        + pz * py * px * 4    # bump block, constant index: one copy
+        + window              # VMEM scratch
+    )
+    grid_steps = B * co * pz
+    # every step: read its preds tile + RMW one out window; the c == 0
+    # step additionally RMWs the weight window
+    bytes_accessed = (
+        grid_steps * tile
+        + B * (co + 1) * pz * window * 2
+    )
+    return {
+        "grid_steps": grid_steps,
+        "vmem_bytes": vmem,
+        "bytes_per_step": tile + 4 * window,
+        "bytes_accessed": bytes_accessed,
+        "flops": B * (2 * co + 1) * pz * py * px,  # *bump, *valid, +acc
+    }
 
 
 @contract(
@@ -148,6 +180,9 @@ def fused_accumulate_patches(out, weight, preds, valid, bump, out_starts,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from chunkflow_tpu.testing import kernelcheck
+
+    check = kernelcheck.active(interpret)
     B, co, pz, py, px = preds.shape
     py_pad, px_pad = padded_patch_shape(py, px)
 
@@ -168,6 +203,13 @@ def fused_accumulate_patches(out, weight, preds, valid, bump, out_starts,
         b = pl.program_id(0)
         c = pl.program_id(1)
         k = pl.program_id(2)
+        if check:
+            # the overlapping-RMW-order trace (patches must accumulate
+            # ascending to match scatter_add) + the scratch canary: the
+            # full-window load below overwrites the poison before any
+            # read, so a clean kernel is bit-identical
+            kernelcheck.observe_grid("fused_blend", b)
+            kernelcheck.poison_scratch(scratch)
         z0 = starts_ref[b, 0]
         y0 = pl.multiple_of(starts_ref[b, 1], _SUBLANE)
         x0 = pl.multiple_of(starts_ref[b, 2], _LANE)
@@ -243,7 +285,12 @@ def fused_accumulate_patches(out, weight, preds, valid, bump, out_starts,
         ],
     )
 
-    return pl.pallas_call(
+    if check:
+        kernelcheck.check_bounds(
+            starts_aligned, (pz, py_pad, px_pad), out.shape[1:],
+            "fused_blend",
+        )
+    result = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
@@ -256,3 +303,6 @@ def fused_accumulate_patches(out, weight, preds, valid, bump, out_starts,
         input_output_aliases={5: 0, 6: 1},
         interpret=interpret,
     )(starts_aligned, dyx, valid2, preds, bump, out, weight)
+    if check:
+        result = kernelcheck.check_result(result, "fused_blend")
+    return result

@@ -54,17 +54,23 @@ and must not force-select the compiled Mosaic kernel either).
 """
 from __future__ import annotations
 
-import os
-import sys
 from typing import Tuple
 
 import numpy as np
+
+from chunkflow_tpu.core import envmode
 
 Triple = Tuple[int, int, int]
 
 _DEVICE_VALUES = ("", "1", "on", "true", "device", "xla")
 _HOST_VALUES = ("0", "off", "false", "no", "host")
 _PALLAS_VALUES = ("pallas", "force")
+_MODE_CHOICES = {
+    "device": _DEVICE_VALUES,
+    "host": _HOST_VALUES,
+    "pallas": _PALLAS_VALUES,
+    "interpret": ("interpret",),
+}
 _WARNED_VALUES: set = set()
 
 _LANE = 128
@@ -73,27 +79,15 @@ _LANE = 128
 def gather_mode() -> str:
     """'device' | 'host' | 'pallas' | 'interpret' — resolved from
     ``CHUNKFLOW_GATHER`` (re-read per call so tests and long-lived
-    workers can flip it; the cache-key tag makes the flip rebuild)."""
-    env = os.environ.get("CHUNKFLOW_GATHER", "").lower()
-    if env in _DEVICE_VALUES:
-        return "device"
-    if env in _HOST_VALUES:
-        return "host"
-    if env in _PALLAS_VALUES:
-        return "pallas"
-    if env == "interpret":
-        return "interpret"
-    if env not in _WARNED_VALUES:
-        _WARNED_VALUES.add(env)
-        print(
-            f"CHUNKFLOW_GATHER={os.environ.get('CHUNKFLOW_GATHER')!r} is "
-            f"not a recognized value (expected one of on/device/1, "
-            f"off/host/0, pallas/force, interpret); using the default "
-            f"device-resident XLA gather — not the host front half, not "
-            f"the compiled Pallas kernel",
-            file=sys.stderr,
-        )
-    return "device"
+    workers can flip it; the cache-key tag makes the flip rebuild).
+    Unrecognized values warn once and fall to the device leg
+    (core/envmode.py holds the shared warn-once contract)."""
+    return envmode.resolve(
+        "CHUNKFLOW_GATHER", _MODE_CHOICES, default="device",
+        note="using the default device-resident XLA gather — not the "
+             "host front half, not the compiled Pallas kernel",
+        warned=_WARNED_VALUES,
+    )
 
 
 def gather_tag() -> str:
@@ -104,7 +98,13 @@ def gather_tag() -> str:
         return "dev"
     if mode == "host":
         return "host"
-    return f"pallas-{'interpret' if mode == 'interpret' else 'on'}"
+    if mode == "interpret":
+        # the kernelcheck sanitizer instruments the interpret trace, so
+        # its on/off state is part of the program identity
+        from chunkflow_tpu.testing import kernelcheck
+
+        return f"pallas-interpret{kernelcheck.key_suffix()}"
+    return "pallas-on"
 
 
 def gather_key() -> tuple:
@@ -193,6 +193,40 @@ def raw_eligible(dtype) -> bool:
 # the Pallas gather kernel
 # ---------------------------------------------------------------------------
 
+def gather_kernel_cost(B: int, ci: int, input_patch_size: Triple,
+                       dtype) -> dict:
+    """Analytic cost of one :func:`gather_patches` build — the
+    builder's own arithmetic, for ``profiling.stamp_cost`` and
+    ``tools/kernel_report.py``. VMEM is the GL021 model: the pipelined
+    output block double-buffered (dynamic index), plus the raw-dtype
+    window scratch; the resident chunk is ANY-space and costs nothing
+    on chip. Bytes per step: one aligned raw window in, one f32 patch
+    tile out.
+
+    Returns ``{grid_steps, vmem_bytes, bytes_per_step, bytes_accessed,
+    flops}``.
+    """
+    import numpy as np
+
+    pz, py, px = input_patch_size
+    itemsize = np.dtype(dtype).itemsize
+    wy, wx = gather_window(py, px, dtype)
+    vmem = (
+        2 * py * px * 4     # out block (1,1,1,py,px) f32: double-buffered
+        + wy * wx * itemsize  # raw-dtype window scratch
+    )
+    grid_steps = B * ci * pz
+    step_bytes = wy * wx * itemsize + py * px * 4
+    return {
+        "grid_steps": grid_steps,
+        "vmem_bytes": vmem,
+        "bytes_per_step": step_bytes,
+        "bytes_accessed": grid_steps * step_bytes,
+        # int->f32 scale is one multiply per output voxel; f32 moves only
+        "flops": grid_steps * py * px if _int_scale(dtype) else 0,
+    }
+
+
 def gather_patches(chunk, in_starts, input_patch_size: Triple,
                    interpret: bool = False):
     """``out[b] = convert(chunk[:, s:s+pin])`` for every row of the
@@ -214,6 +248,9 @@ def gather_patches(chunk, in_starts, input_patch_size: Triple,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from chunkflow_tpu.testing import kernelcheck
+
+    check = kernelcheck.active(interpret)
     ci = chunk.shape[0]
     pz, py, px = input_patch_size
     B = in_starts.shape[0]
@@ -234,6 +271,10 @@ def gather_patches(chunk, in_starts, input_patch_size: Triple,
         b = pl.program_id(0)
         c = pl.program_id(1)
         k = pl.program_id(2)
+        if check:
+            # canary: the full-window DMA below overwrites the poison
+            # before any read, so a clean kernel is bit-identical
+            kernelcheck.poison_scratch(scratch)
         z = starts_ref[b, 0] + k
         y0 = pl.multiple_of(starts_ref[b, 1], sub)
         x0 = pl.multiple_of(starts_ref[b, 2], _LANE)
@@ -271,12 +312,20 @@ def gather_patches(chunk, in_starts, input_patch_size: Triple,
         ],
     )
 
-    return pl.pallas_call(
+    if check:
+        kernelcheck.check_bounds(
+            starts_aligned, (pz, wy, wx), chunk.shape[1:],
+            "gather_patches",
+        )
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, ci, pz, py, px), jnp.float32),
         interpret=interpret,
     )(starts_aligned, dyx, chunk)
+    if check:
+        out = kernelcheck.check_result(out, "gather_patches")
+    return out
 
 
 # ---------------------------------------------------------------------------

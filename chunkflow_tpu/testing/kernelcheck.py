@@ -1,0 +1,299 @@
+"""Kernelcheck: the interpret-mode Pallas kernel sanitizer.
+
+The GL020-series lint rules (tools/graftlint/pallas.py) prove what is
+provable STATICALLY — hint presence, analytic VMEM, alias structure,
+copy protocol shape. What they cannot see is runtime values: a starts
+table whose aligned window runs past the padded buffer edge, a scratch
+cell read before any DMA wrote it, a grid walked out of patch order so
+overlapping read-modify-writes accumulate in the wrong sequence. Those
+defects are invisible on the CPU box too — unless the interpret-mode
+runs the tier-1 parity suites already do are made to LOOK. Kernelcheck
+is that look, in the locksmith mold (testing/locksmith.py):
+
+* **dynamic-slice bounds**: every batch's aligned window corner is
+  asserted in-bounds against the (padded) buffer extent before the
+  kernel runs (:func:`check_bounds`) — an OOB DMA that interpret mode
+  would clamp or garble, and hardware would corrupt silently;
+* **scratch read-before-write**: VMEM scratch is poisoned at the top of
+  every grid step (:func:`poison_scratch` — NaN for float scratch, the
+  dtype max for int scratch) and the kernel result is swept for NaN
+  canaries (:func:`check_result`). A correct kernel DMAs the full
+  window over the poison before reading, so the result is bit-identical
+  with the sanitizer on — zero false positives by construction; a
+  read-before-write surfaces the poison in the output;
+* **RMW grid order**: kernels that read-modify-write overlapping
+  windows (the fused blend) report their patch index per grid step
+  (:func:`observe_grid` via ``jax.debug.callback``), and
+  :func:`check_result` verifies the recorded walk is non-decreasing —
+  ascending patch order is what makes the fused path bitwise equal to
+  ``lax.scatter_add``'s duplicate-update order. Tracing is ARMED
+  per-label (:func:`arm_grid_trace`) by the dedicated kernelcheck
+  tests, which drive ONE kernel invocation at a time: inside a batch
+  scan, callbacks from consecutive invocations interleave (the NaN
+  reduction of step *i* carries no data dependence on step *i+1*'s
+  kernel), so an always-on order check would flag correct programs.
+
+Enabled for the whole tier-1 suite via ``tests/conftest.py``
+(``CHUNKFLOW_KERNELCHECK=1``), so every interpret parity test doubles
+as a kernel sanitizer run. The kill switch is absolute: disabled, every
+seam is a strict no-op — no callbacks in the trace, no poison writes,
+no state, byte-identical programs. Because the hooks change the traced
+program, the kernel cache tags (``ops/blend.kernel_tag``,
+``ops/pallas_gather.gather_tag``) grow a ``+kc`` suffix while the
+sanitizer is live on an interpret leg, so an env flip REBUILDS instead
+of reusing a stale program (the CHUNKFLOW_GATHER convention).
+
+Violations raise :class:`KernelCheckError` from the host callback
+(surfacing as ``XlaRuntimeError`` through the runtime) in mode
+``raise`` (default), or are recorded for :func:`report` in mode ``log``
+(``CHUNKFLOW_KERNELCHECK_MODE``). Import-light: no jax at module
+import; telemetry only on the violation path.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = [
+    "KernelCheckError", "enabled", "active", "key_suffix",
+    "check_bounds", "check_result", "poison_scratch", "observe_grid",
+    "arm_grid_trace", "report", "reset_state", "publish",
+]
+
+_OFF_VALUES = ("", "0", "off", "false", "no")
+
+
+class KernelCheckError(RuntimeError):
+    """A kernel soundness violation observed at interpret-mode runtime:
+    an out-of-bounds DMA window, a scratch read-before-write canary in
+    the kernel result, or an out-of-order RMW grid walk."""
+
+
+def enabled() -> bool:
+    """The master switch (``CHUNKFLOW_KERNELCHECK``), re-read per call
+    so tests and long-lived workers can flip it; the ``+kc`` cache-tag
+    suffix makes the flip rebuild."""
+    return os.environ.get(
+        "CHUNKFLOW_KERNELCHECK", "").lower() not in _OFF_VALUES
+
+
+def _mode() -> str:
+    return os.environ.get("CHUNKFLOW_KERNELCHECK_MODE", "raise")
+
+
+def active(interpret: bool) -> bool:
+    """Whether the sanitizer instruments THIS kernel build: enabled and
+    interpret mode. Compiled Mosaic legs are never instrumented — host
+    callbacks do not belong in a hardware hot loop, and the poison
+    writes would cost real VMEM bandwidth there."""
+    return bool(interpret) and enabled()
+
+
+def key_suffix() -> str:
+    """``"+kc"`` while the sanitizer is live (for the kernel cache
+    tags), else ``""`` — disabled must leave every key byte-identical
+    to the pre-kernelcheck world."""
+    return "+kc" if enabled() else ""
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.violations: List[dict] = []
+        self.checks = 0
+        #: labels whose grid walk is being recorded (armed by tests
+        #: driving one kernel invocation at a time)
+        self.armed: set = set()
+        #: label -> recorded grid walk (patch indices)
+        self.grid_traces: Dict[str, List[int]] = {}
+
+    def count_check(self) -> None:
+        with self._lock:
+            self.checks += 1
+
+    def record_visit(self, label: str, idx: int) -> None:
+        with self._lock:
+            if label in self.armed:
+                self.grid_traces.setdefault(label, []).append(idx)
+
+    def take_trace(self, label: str) -> List[int]:
+        with self._lock:
+            return self.grid_traces.pop(label, [])
+
+    def violation(self, kind: str, detail: str) -> None:
+        with self._lock:
+            self.violations.append({"kind": kind, "detail": detail})
+        try:
+            from chunkflow_tpu.core import telemetry
+
+            telemetry.inc("kernelcheck/violations")
+        except Exception:
+            pass
+        if _mode() == "raise":
+            raise KernelCheckError(detail)
+
+
+_registry = _Registry()
+
+
+def reset_state() -> None:
+    """Drop recorded violations, check counts, grid traces and arming
+    (tests)."""
+    with _registry._lock:
+        _registry.violations.clear()
+        _registry.grid_traces.clear()
+        _registry.armed.clear()
+        _registry.checks = 0
+
+
+def arm_grid_trace(label: str) -> None:
+    """Start recording the grid walk for ``label``. Only armed labels
+    record (and are verified by :func:`check_result`); arming is for
+    tests that drive ONE kernel invocation at a time — interleaved
+    invocations (a batch scan) would mix their walks."""
+    with _registry._lock:
+        _registry.armed.add(label)
+        _registry.grid_traces.pop(label, None)
+
+
+def report() -> dict:
+    """Snapshot: check/violation counts and the recorded violations
+    (for tests, debugging, end-of-run summaries). Never touches disk."""
+    with _registry._lock:
+        return {
+            "enabled": enabled(),
+            "checks": _registry.checks,
+            "violations": list(_registry.violations),
+        }
+
+
+def publish() -> None:
+    """Fold the counts into ``kernelcheck/*`` telemetry gauges — on
+    demand, never per-check."""
+    if not enabled():
+        return
+    from chunkflow_tpu.core import telemetry
+
+    snap = report()
+    telemetry.gauge("kernelcheck/checks", snap["checks"])
+    telemetry.gauge("kernelcheck/violations", len(snap["violations"]))
+
+
+# ---------------------------------------------------------------------------
+# host-side checks (callbacks)
+# ---------------------------------------------------------------------------
+def _host_check_bounds(starts, window: Tuple[int, ...],
+                       extent: Tuple[int, ...], label: str) -> None:
+    import numpy as np
+
+    _registry.count_check()
+    starts = np.asarray(starts)
+    for b in range(starts.shape[0]):
+        for d in range(len(window)):
+            lo = int(starts[b, d])
+            hi = lo + int(window[d])
+            if lo < 0 or hi > int(extent[d]):
+                _registry.violation(
+                    "oob-slice",
+                    f"{label}: batch {b} dim {d}: aligned window "
+                    f"[{lo}, {hi}) runs outside the buffer extent "
+                    f"{int(extent[d])} — the DMA reads/writes memory "
+                    f"the buffer does not own (interpret mode clamps, "
+                    f"hardware corrupts); pad the buffer "
+                    f"(gather_buffer_padding / buffer_padding) or fix "
+                    f"the starts table",
+                )
+                return
+
+
+def _host_check_result(has_nan, label: str) -> None:
+    _registry.count_check()
+    walk = _registry.take_trace(label)
+    bad = next((i for i in range(1, len(walk))
+                if walk[i] < walk[i - 1]), None)
+    if bad is not None:
+        _registry.violation(
+            "rmw-order",
+            f"{label}: grid walked patch {walk[bad]} after patch "
+            f"{walk[bad - 1]} — overlapping read-modify-writes must "
+            f"accumulate in ascending patch order to stay bitwise "
+            f"equal to lax.scatter_add's duplicate-update order",
+        )
+        return
+    if bool(has_nan):
+        _registry.violation(
+            "scratch-canary",
+            f"{label}: NaN canary in the kernel result — a scratch "
+            f"cell was read before any DMA/store wrote it this grid "
+            f"step (kernelcheck poisons VMEM scratch at the top of "
+            f"every step), or the kernel computed NaN outright",
+        )
+
+
+# ---------------------------------------------------------------------------
+# traced-side seams (call only when ``active(interpret)``)
+# ---------------------------------------------------------------------------
+def check_bounds(starts, window: Sequence[int], extent: Sequence[int],
+                 label: str) -> None:
+    """Assert every batch row's aligned window ``starts[b] +
+    window <= extent`` (and ``starts >= 0``) on the host, before the
+    kernel consumes the table. ``starts`` may be a tracer — the check
+    rides a ``jax.debug.callback``; ``window``/``extent`` are static
+    ints."""
+    import jax
+
+    jax.debug.callback(
+        _host_check_bounds, starts,
+        window=tuple(int(w) for w in window),
+        extent=tuple(int(e) for e in extent), label=label,
+    )
+
+
+def check_result(out, label: str):
+    """Sweep the kernel result(s) for NaN canaries and verify the grid
+    walk recorded under ``label`` (if any) is in ascending patch order.
+    Returns ``out`` unchanged — the callback hangs off a reduction of
+    the result, so it fires only after the kernel finished."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(out)
+    has_nan = jnp.zeros((), jnp.bool_)
+    for leaf in leaves:
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            has_nan = has_nan | jnp.isnan(leaf).any()
+    jax.debug.callback(_host_check_result, has_nan, label=label)
+    return out
+
+
+def poison_scratch(ref) -> None:
+    """Fill a VMEM scratch ref with canary values at the top of a grid
+    step: NaN for float scratch, the dtype max for int scratch (int
+    poison is detectable only when a downstream float conversion would
+    overflow expectations — NaN is the real tripwire). A correct kernel
+    overwrites the full window before reading, so results are
+    bit-identical with the poison in place."""
+    import jax.numpy as jnp
+
+    dt = ref.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        ref[...] = jnp.full(ref.shape, jnp.nan, dt)
+    else:
+        ref[...] = jnp.full(ref.shape, jnp.iinfo(dt).max, dt)
+
+
+def observe_grid(label: str, idx) -> None:
+    """Record one grid step's patch index for the RMW-order verifier
+    (best-effort: interpret mode executes callbacks synchronously in
+    grid order; :func:`check_result` consumes and clears the trace)."""
+    import jax
+
+    jax.debug.callback(_record_visit, idx, label=label)
+
+
+def _record_visit(idx, label: str) -> None:
+    _registry.record_visit(label, int(idx))

@@ -17,9 +17,10 @@ stage_time() {
 # --- baseline guard -------------------------------------------------------
 # The graftlint baseline was emptied in PR 2 (all GL005 donate_argnums
 # findings fixed) and has stayed empty through the GL010-series
-# concurrency rules (ISSUE 10): any entry reappearing — for ANY rule,
-# and a GL010+ key especially, since every real concurrency hit was
-# fixed or inline-annotated, never grandfathered — means someone
+# concurrency rules (ISSUE 10) and the GL020-series Pallas kernel rules
+# (ISSUE 16): any entry reappearing — for ANY rule, and a GL010+/GL020+
+# key especially, since every real concurrency or kernel-soundness hit
+# was fixed or inline-annotated, never grandfathered — means someone
 # re-grandfathered a finding instead of fixing it. Fail loudly
 # (docs/linting.md).
 python - <<'EOF' || exit 1
@@ -28,9 +29,11 @@ with open("tools/graftlint/baseline.json") as f:
     findings = json.load(f).get("findings", {})
 if findings:
     concurrency = [k for k in findings if "::GL01" in k]
+    pallas = [k for k in findings if "::GL02" in k]
     print(
         f"graftlint baseline is not empty ({len(findings)} grandfathered "
-        f"finding(s), {len(concurrency)} from the GL010-series); fix the "
+        f"finding(s), {len(concurrency)} from the GL010-series, "
+        f"{len(pallas)} from the GL020-series); fix the "
         "findings instead of re-grandfathering them (docs/linting.md)",
         file=sys.stderr,
     )
@@ -74,6 +77,18 @@ echo "== locksmith overhead gate =="
 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py locksmith_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "locksmith overhead gate"
+
+# --- kernelcheck overhead gate ----------------------------------------------
+# Kernel-sanitizer-on vs -off wall time over the interpret-mode Pallas
+# parity legs (docs/linting.md "Runtime kernel sanitizer"). The JSON
+# line reports the <5% target as gate_pass; the process only fails past
+# 25% (the sanitizer landed work somewhere hot), so shared-box noise
+# cannot redden CI. The on leg also proves a clean workload raises no
+# violation (the tier-1 no-false-positives contract).
+echo "== kernelcheck overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py kernelcheck_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "kernelcheck overhead gate"
 
 # --- telemetry overhead gate ----------------------------------------------
 # Telemetry-on vs -off wall time on the pipeline_overlap workload

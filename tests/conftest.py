@@ -47,6 +47,14 @@ from chunkflow_tpu.testing import locksmith  # noqa: E402
 
 locksmith.install()
 
+# Kernelcheck Pallas sanitizer (chunkflow_tpu/testing/kernelcheck.py):
+# poison VMEM scratch, assert DMA windows in-bounds and verify the RMW
+# grid order on every interpret-mode kernel run, so the tier-1 parity
+# suites double as kernel sanitizer runs. Default ON for the suite;
+# CHUNKFLOW_KERNELCHECK=0 disables (a strict no-op — no callbacks, no
+# poison, byte-identical traces).
+os.environ.setdefault("CHUNKFLOW_KERNELCHECK", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

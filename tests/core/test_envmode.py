@@ -1,0 +1,99 @@
+"""core/envmode.py: the shared warn-once env-mode parser (ISSUE 16
+satellite). The three callers' own warn-once tests (pallas_mode,
+gather_mode, resolve_precision) keep covering their ends of the seam;
+these tests pin the helper's contract directly so the fused patch
+program's future knob can rely on it without growing copy #4."""
+import pytest
+
+from chunkflow_tpu.core import envmode
+
+CHOICES = {
+    "off": ("", "0", "off"),
+    "on": ("1", "on", "force"),
+    "interpret": ("interpret",),
+}
+
+
+@pytest.fixture
+def clean_var(monkeypatch):
+    monkeypatch.delenv("CHUNKFLOW_ENVMODE_TEST", raising=False)
+    monkeypatch.setattr(envmode, "_WARNED_BY_VAR", {})
+    return "CHUNKFLOW_ENVMODE_TEST"
+
+
+def resolve(warned=None):
+    return envmode.resolve(
+        "CHUNKFLOW_ENVMODE_TEST", CHOICES, default="off",
+        note="treating it as OFF", warned=warned,
+    )
+
+
+def test_recognized_values_resolve_without_warning(
+        clean_var, monkeypatch, capsys):
+    for value, expected in [("", "off"), ("0", "off"), ("off", "off"),
+                            ("1", "on"), ("force", "on"),
+                            ("interpret", "interpret"),
+                            ("INTERPRET", "interpret")]:
+        monkeypatch.setenv(clean_var, value)
+        assert resolve() == expected
+    monkeypatch.delenv(clean_var)
+    assert resolve() == "off"  # unset -> the ""-bearing choice
+    assert capsys.readouterr().err == ""
+
+
+def test_unrecognized_warns_once_per_value(clean_var, monkeypatch, capsys):
+    warned = set()
+    monkeypatch.setenv(clean_var, "ture")
+    assert resolve(warned) == "off"
+    err = capsys.readouterr().err
+    assert "ture" in err and "not a recognized value" in err
+    assert "treating it as OFF" in err
+    # same typo again: silent
+    assert resolve(warned) == "off"
+    assert capsys.readouterr().err == ""
+    # a different typo warns again
+    monkeypatch.setenv(clean_var, "yes please")
+    assert resolve(warned) == "off"
+    assert "yes please" in capsys.readouterr().err
+    assert warned == {"ture", "yes please"}
+
+
+def test_warning_lists_recognized_values(clean_var, monkeypatch, capsys):
+    monkeypatch.setenv(clean_var, "bogus")
+    resolve(set())
+    err = capsys.readouterr().err
+    # every non-empty recognized value group is named in the warning
+    assert "0/off" in err and "1/on/force" in err and "interpret" in err
+
+
+def test_internal_warned_sets_are_per_variable(monkeypatch, capsys):
+    monkeypatch.setattr(envmode, "_WARNED_BY_VAR", {})
+    monkeypatch.setenv("CHUNKFLOW_ENVMODE_A", "oops")
+    monkeypatch.setenv("CHUNKFLOW_ENVMODE_B", "oops")
+    envmode.resolve("CHUNKFLOW_ENVMODE_A", CHOICES, "off", "note a")
+    # the same typo on a DIFFERENT variable still warns: per-var sets
+    envmode.resolve("CHUNKFLOW_ENVMODE_B", CHOICES, "off", "note b")
+    err = capsys.readouterr().err
+    assert "CHUNKFLOW_ENVMODE_A" in err and "CHUNKFLOW_ENVMODE_B" in err
+    # and each variable's second hit is silent
+    envmode.resolve("CHUNKFLOW_ENVMODE_A", CHOICES, "off", "note a")
+    envmode.resolve("CHUNKFLOW_ENVMODE_B", CHOICES, "off", "note b")
+    assert capsys.readouterr().err == ""
+
+
+def test_normalize_folds_aliases_before_matching(
+        clean_var, monkeypatch, capsys):
+    aliases = {"fast": "on"}
+    monkeypatch.setenv(clean_var, "FAST")
+    got = envmode.resolve(
+        clean_var, CHOICES, "off", "note",
+        warned=set(), normalize=lambda env: aliases.get(env, env),
+    )
+    assert got == "on"
+    assert capsys.readouterr().err == ""
+
+
+def test_recognized_values_enumeration():
+    assert envmode.recognized_values(CHOICES) == (
+        "", "0", "off", "1", "on", "force", "interpret"
+    )

@@ -1,0 +1,150 @@
+"""Kernel window geometry, pinned as a table (ISSUE 16 satellite):
+``padded_patch_shape`` / ``buffer_padding`` on the blend side and the
+dtype-tiling ``gather_window`` / ``gather_buffer_padding`` table on the
+gather side — including the flush-at-edge worst case the padding
+exists for. The analytic cost helpers (``fused_kernel_cost`` /
+``gather_kernel_cost``) are pinned against the same arithmetic so the
+stamped programs.json VMEM column cannot drift from the geometry.
+"""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.ops import pallas_blend, pallas_gather
+
+
+# ---------------------------------------------------------------------------
+# blend-side geometry (f32 only: the blend kernel is float32)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("py,px,expected", [
+    (1, 1, (8, 128)),       # tiny patch still needs one full tile
+    (8, 128, (16, 256)),    # exactly one tile + worst-case offset slack
+    (12, 16, (24, 256)),
+    (64, 64, (72, 256)),    # the bench geometry
+    (9, 129, (16, 256)),
+])
+def test_padded_patch_shape(py, px, expected):
+    assert pallas_blend.padded_patch_shape(py, px) == expected
+
+
+def test_padded_patch_shape_covers_any_offset():
+    # the window must hold a (py, px) patch at ANY in-window offset
+    # (dy, dx) in [0, 8) x [0, 128) — that is its whole job
+    for py, px in [(1, 1), (7, 127), (8, 128), (30, 200)]:
+        py_pad, px_pad = pallas_blend.padded_patch_shape(py, px)
+        assert py_pad % 8 == 0 and px_pad % 128 == 0
+        assert py_pad >= py + 7 and px_pad >= px + 127
+
+
+def test_buffer_padding_is_window_minus_patch():
+    for pout in [(3, 12, 16), (4, 64, 64), (2, 7, 127)]:
+        pad_y, pad_x = pallas_blend.buffer_padding(pout)
+        py_pad, px_pad = pallas_blend.padded_patch_shape(
+            pout[1], pout[2])
+        assert (pad_y, pad_x) == (py_pad - pout[1], px_pad - pout[2])
+
+
+def test_buffer_padding_covers_flush_at_edge():
+    # worst case: a patch ENDING at the unpadded buffer edge whose
+    # aligned corner rounds down by (sublane-1, lane-1) — the padded
+    # buffer must still contain the full aligned window
+    pout = (3, 12, 16)
+    Y, X = 40, 48
+    pad_y, pad_x = pallas_blend.buffer_padding(pout)
+    py_pad, px_pad = pallas_blend.padded_patch_shape(pout[1], pout[2])
+    y, x = Y - pout[1], X - pout[2]  # flush at the edge
+    y0, x0 = (y // 8) * 8, (x // 128) * 128
+    assert y0 + py_pad <= Y + pad_y
+    assert x0 + px_pad <= X + pad_x
+
+
+# ---------------------------------------------------------------------------
+# gather-side geometry: the dtype-tiling table
+# ---------------------------------------------------------------------------
+GATHER_TABLE = [
+    # dtype     sublane  (py, px)   expected window
+    ("float32", 8,  (12, 18), (24, 256)),
+    ("uint16",  16, (12, 18), (32, 256)),
+    ("uint8",   32, (12, 18), (64, 256)),
+    ("float32", 8,  (64, 64), (72, 256)),
+    ("uint16",  16, (64, 64), (80, 256)),
+    ("uint8",   32, (64, 64), (96, 256)),
+    ("float32", 8,  (8, 128), (16, 256)),
+    ("uint16",  16, (16, 128), (32, 256)),
+    ("uint8",   32, (32, 128), (64, 256)),
+]
+
+
+@pytest.mark.parametrize("dtype,sub,patch,window", GATHER_TABLE)
+def test_gather_window_table(dtype, sub, patch, window):
+    dt = np.dtype(dtype)
+    assert pallas_gather._sublane(dt) == sub
+    assert pallas_gather.gather_window(*patch, dt) == window
+    wy, wx = window
+    assert wy % sub == 0 and wx % 128 == 0
+    # covers any offset in [0, sub) x [0, 128)
+    assert wy >= patch[0] + sub - 1 and wx >= patch[1] + 127
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "uint16", "float32"])
+def test_gather_buffer_padding_covers_flush_at_edge(dtype):
+    dt = np.dtype(dtype)
+    pin = (3, 12, 18)
+    Y, X = 50, 70
+    pad_y, pad_x = pallas_gather.gather_buffer_padding(pin, dt)
+    wy, wx = pallas_gather.gather_window(pin[1], pin[2], dt)
+    assert (pad_y, pad_x) == (wy - pin[1], wx - pin[2])
+    sub = pallas_gather._sublane(dt)
+    y, x = Y - pin[1], X - pin[2]  # flush at the edge
+    y0, x0 = (y // sub) * sub, (x // 128) * 128
+    assert y0 + wy <= Y + pad_y
+    assert x0 + wx <= X + pad_x
+
+
+# ---------------------------------------------------------------------------
+# the analytic cost helpers track the geometry (the stamp_cost/GL021
+# arithmetic)
+# ---------------------------------------------------------------------------
+def test_fused_kernel_cost_tracks_geometry():
+    B, co, pout = 4, 3, (3, 12, 16)
+    pz, py, px = pout
+    py_pad, px_pad = pallas_blend.padded_patch_shape(py, px)
+    cost = pallas_blend.fused_kernel_cost(B, co, pout)
+    assert cost["grid_steps"] == B * co * pz
+    # GL021 model: preds tile x2 (dynamic index), bump block x1
+    # (constant index), scratch window x1
+    assert cost["vmem_bytes"] == (
+        2 * py * px * 4 + pz * py * px * 4 + py_pad * px_pad * 4)
+    assert cost["bytes_per_step"] == py * px * 4 + 4 * py_pad * px_pad * 4
+    assert cost["bytes_accessed"] == (
+        B * co * pz * py * px * 4
+        + B * (co + 1) * pz * py_pad * px_pad * 4 * 2)
+    assert cost["flops"] == B * (2 * co + 1) * pz * py * px
+
+
+@pytest.mark.parametrize("dtype", ["uint8", "uint16", "float32"])
+def test_gather_kernel_cost_tracks_geometry(dtype):
+    dt = np.dtype(dtype)
+    B, ci, pin = 5, 2, (3, 12, 18)
+    pz, py, px = pin
+    wy, wx = pallas_gather.gather_window(py, px, dt)
+    cost = pallas_gather.gather_kernel_cost(B, ci, pin, dt)
+    assert cost["grid_steps"] == B * ci * pz
+    assert cost["vmem_bytes"] == 2 * py * px * 4 + wy * wx * dt.itemsize
+    step = wy * wx * dt.itemsize + py * px * 4
+    assert cost["bytes_per_step"] == step
+    assert cost["bytes_accessed"] == B * ci * pz * step
+    # int chunks pay one scale multiply per output voxel; f32 moves only
+    expected_flops = B * ci * pz * py * px if dtype != "float32" else 0
+    assert cost["flops"] == expected_flops
+
+
+def test_kernel_costs_fit_default_vmem_budget():
+    # the shipping geometries must sit far under the 16 MiB device
+    # budget — the GL021 rule enforces this statically, this pins the
+    # helper's arithmetic to the same conclusion
+    for pout in [(4, 64, 64), (8, 32, 32)]:
+        assert pallas_blend.fused_kernel_cost(
+            8, 3, pout)["vmem_bytes"] < 16 * 2**20
+        for dtype in ("uint8", "uint16", "float32"):
+            assert pallas_gather.gather_kernel_cost(
+                8, 2, pout, np.dtype(dtype))["vmem_bytes"] < 16 * 2**20

@@ -1,0 +1,15 @@
+"""Seeded-defect corpus for the Pallas kernel soundness plane (ISSUE 16).
+
+One deliberately-broken kernel per GL020-GL024 lint rule plus two
+runtime defects (a scratch read-before-write and an out-of-bounds DMA
+window) only the kernelcheck sanitizer can see, each with a twin: the
+lint fixtures get a ``# graftlint: disable=`` suppressed twin, the
+runtime fixtures run clean with ``CHUNKFLOW_KERNELCHECK=0`` (the strict
+no-op proof). tests/tools/test_kernel_corpus.py asserts every defect is
+DETECTED and every twin is quiet — the corpus is the regression harness
+that keeps the detectors honest.
+
+These files sit under ``tests/`` deliberately: the repo-wide graftlint
+gate's include set (``pyproject.toml``) never lints them, so the
+baseline stays empty while the corpus stays red.
+"""

@@ -1,0 +1,41 @@
+"""GL020 seed: a dynamic DMA slice corner into an ANY-space ref with no
+``pl.multiple_of`` hint on the second-minor dim — the exact shape of the
+round-1 hardware failure ("failed to prove that a tile index ... is
+divisible by the tiling (8)")."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, starts, interpret=False):
+    def kernel(starts_ref, x_ref, o_ref, scratch, sem):
+        b = pl.program_id(0)
+        y0 = starts_ref[b, 0]  # BUG: no pl.multiple_of(.., 8) hint
+        x0 = pl.multiple_of(starts_ref[b, 1], 128)
+        copy = pltpu.make_async_copy(
+            x_ref.at[pl.ds(y0, 8), pl.ds(x0, 128)], scratch, sem)
+        copy.start()
+        copy.wait()
+        o_ref[...] = scratch[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(starts.shape[0],),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((8, 128), lambda b, s: (0, 0))],
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        interpret=interpret,
+    )(starts, x)

@@ -1,0 +1,24 @@
+"""GL021 seed: double-buffered 8 MiB block windows on both input and
+output — 32 MiB of analytic VMEM against a 16 MiB device budget. The
+kernel is semantically fine; it simply cannot compile on hardware."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, interpret=False):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],  # BUG
+        out_specs=pl.BlockSpec((1024, 2048), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 2048), jnp.float32),
+        interpret=interpret,
+    )(x)

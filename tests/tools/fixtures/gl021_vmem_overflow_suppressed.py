@@ -1,0 +1,24 @@
+"""Suppressed twin of gl021_vmem_overflow (a kernel targeting a part
+with a bigger budget would disable the rule and set
+CHUNKFLOW_VMEM_BUDGET in CI instead)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, interpret=False):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(  # graftlint: disable=GL021
+        kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1024, 2048), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 2048), jnp.float32),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,25 @@
+"""GL022 seed: the kernel reads its output ref (an accumulate) but no
+``input_output_aliases`` entry ties an input to that output — XLA hands
+the kernel a FRESH buffer and the read sees undefined contents (zeros
+in interpret mode, so prior contributions silently vanish)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, interpret=False):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = o_ref[...] + x_ref[...]  # BUG: RMW, no alias
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,25 @@
+"""Suppressed twin of gl022_unaliased_rmw (legitimate only for a
+kernel whose output-read is provably of cells the same grid step
+already wrote — which this one is not; the twin exists to pin the
+suppression mechanics)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, interpret=False):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = o_ref[...] + x_ref[...]  # graftlint: disable=GL022
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,31 @@
+"""Suppressed twin of gl023_unwaited_copy (legitimate when a later
+pipeline stage provably waits the semaphore — a pattern this repo does
+not use; the twin pins the suppression mechanics)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def pallas_mode():
+    return "off"
+
+
+def build(x, interpret=False):
+    def kernel(x_ref, o_ref, scratch, sem):
+        copy = pltpu.make_async_copy(x_ref, scratch, sem)
+        copy.start()  # graftlint: disable=GL023
+        o_ref[...] = scratch[...]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(x)

@@ -1,0 +1,17 @@
+"""GL024 seed: a bare pallas_call — no ``*_mode`` env selector in the
+module and no ``interpret=`` threaded from a caller. A CPU box (or any
+platform the author did not anticipate) hard-fails instead of falling
+back to an XLA path."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def build(x):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(  # BUG: no selection seam
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,16 @@
+"""Suppressed twin of gl024_unguarded_call (legitimate for a
+hardware-only diagnostic script that must never silently fall back;
+the twin pins the suppression mechanics)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def build(x):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    return pl.pallas_call(  # graftlint: disable=GL024
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,60 @@
+"""Runtime seed: an out-of-bounds DMA window the lint cannot see.
+
+The slice corners are properly ``pl.multiple_of``-hinted (GL020
+passes), but the starts TABLE is wrong at runtime: the last row's
+aligned window runs 128 columns past the padded buffer edge. Interpret
+mode clamps the read and the output is quietly wrong; hardware DMAs
+memory the buffer does not own. Only kernelcheck's
+:func:`chunkflow_tpu.testing.kernelcheck.check_bounds` assertion over
+the concrete starts values catches it before the kernel runs.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from chunkflow_tpu.testing import kernelcheck
+
+
+def pallas_mode():
+    return "interpret"
+
+
+def build(x, interpret=True):
+    """x: [16, 256] f32 -> [2, 8, 128] f32 windows at hinted corners.
+    BUG: the second start row (8, 256) puts its x-window at [256, 384)
+    — past the 256-column extent."""
+    check = kernelcheck.active(interpret)
+    starts = jnp.array([[0, 0], [8, 256]], jnp.int32)
+
+    def kernel(starts_ref, x_ref, o_ref, scratch, sem):
+        b = pl.program_id(0)
+        y0 = pl.multiple_of(starts_ref[b, 0], 8)
+        x0 = pl.multiple_of(starts_ref[b, 1], 128)
+        copy = pltpu.make_async_copy(
+            x_ref.at[pl.ds(y0, 8), pl.ds(x0, 128)], scratch, sem)
+        copy.start()
+        copy.wait()
+        o_ref[0] = scratch[...]
+
+    if check:
+        kernelcheck.check_bounds(starts, (8, 128), x.shape, "rt_oob")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(2,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda b, s: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32),
+        interpret=interpret,
+    )(starts, x)
+    if check:
+        out = kernelcheck.check_result(out, "rt_oob")
+    return out

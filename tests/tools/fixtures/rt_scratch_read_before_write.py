@@ -1,0 +1,57 @@
+"""Runtime seed: a scratch read-before-write the lint cannot see.
+
+The kernel DMAs only the TOP half of its scratch window but reads the
+whole window — rows 8:16 carry whatever the previous grid step (or
+nothing at all) left there. Statically every copy is started and
+waited, every slice constant-aligned, the output write-only: the
+GL020-series passes this kernel. Only kernelcheck's poison catches it:
+with the scratch NaN-filled at the top of each step, the unwritten rows
+surface as NaN canaries in the result (:func:`chunkflow_tpu.testing.
+kernelcheck.check_result`). With the sanitizer off the defect runs
+silently — the scratch carries whatever interpret/hardware happens to
+leave there and nothing flags the output as wrong.
+"""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from chunkflow_tpu.testing import kernelcheck
+
+
+def pallas_mode():
+    return "interpret"
+
+
+def build(x, interpret=True):
+    """x: [4, 16, 128] f32 -> [16, 128] f32 (last grid step's window).
+    BUG: only rows 0:8 of the 16-row scratch are ever written."""
+    check = kernelcheck.active(interpret)
+
+    def kernel(x_ref, o_ref, scratch, sem):
+        if check:
+            kernelcheck.poison_scratch(scratch)
+        copy = pltpu.make_async_copy(
+            x_ref.at[pl.program_id(0), pl.ds(0, 8), pl.ds(0, 128)],
+            scratch.at[pl.ds(0, 8)],
+            sem,
+        )
+        copy.start()
+        copy.wait()
+        o_ref[...] = scratch[...]  # BUG: rows 8:16 never written
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((16, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((16, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=interpret,
+    )(x)
+    if check:
+        out = kernelcheck.check_result(out, "rt_scratch_rbw")
+    return out

@@ -1,0 +1,534 @@
+"""GL020-series Pallas/Mosaic kernel soundness rule tests: one positive
+and one suppressed case per rule (the established graftlint pattern),
+plus the pallas_call site model they rest on (tools/graftlint/pallas.py)
+and the shipping-kernel zero-findings guarantee — a lint that flags the
+kernels it exists to protect would be deleted within a week.
+"""
+import textwrap
+from pathlib import Path
+
+from tools.graftlint.config import Config
+from tools.graftlint.context import FileContext
+from tools.graftlint.engine import lint_file
+from tools.graftlint.pallas import get_pallas_model, vmem_budget_bytes
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def run(src, path="chunkflow_tpu/ops/example.py", config=None):
+    findings, suppressed = lint_file(
+        path, textwrap.dedent(src), config or Config()
+    )
+    return findings, suppressed
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+PREAMBLE = """\
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+
+    def pallas_mode():
+        return "off"
+
+"""
+
+
+# ---------------------------------------------------------------- GL020
+GL020_POSITIVE = PREAMBLE + """\
+
+    def build(x, starts, interpret=False):
+        def kernel(starts_ref, x_ref, o_ref, scratch, sem):
+            b = pl.program_id(0)
+            y0 = starts_ref[b, 0]
+            x0 = pl.multiple_of(starts_ref[b, 1], 128)
+            copy = pltpu.make_async_copy(x_ref.at[pl.ds(y0, 8), pl.ds(x0, 128)], scratch, sem)
+            copy.start()
+            copy.wait()
+            o_ref[...] = scratch[...]
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(4,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec((8, 128), lambda b, s: (0, 0))],
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            interpret=interpret,
+        )(starts, x)
+"""
+
+
+def test_gl020_detects_unhinted_dynamic_slice_corner():
+    findings, _ = run(GL020_POSITIVE)
+    assert codes(findings).count("GL020") == 1
+    hit = [f for f in findings if f.code == "GL020"][0]
+    assert "second-minor" in hit.message
+    assert "multiple_of" in hit.message
+
+
+def test_gl020_suppressed():
+    src = GL020_POSITIVE.replace(
+        "], scratch, sem)",
+        "], scratch, sem)  # graftlint: disable=GL020",
+    )
+    findings, suppressed = run(src)
+    assert "GL020" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl020_hinted_corner_is_clean():
+    src = GL020_POSITIVE.replace(
+        "y0 = starts_ref[b, 0]",
+        "y0 = pl.multiple_of(starts_ref[b, 0], 8)",
+    )
+    findings, _ = run(src)
+    assert "GL020" not in codes(findings)
+
+
+def test_gl020_accepts_unfoldable_hint_divisor():
+    # the gather kernel's pattern: the sublane divisor comes from
+    # _sublane(dtype) and cannot fold — the hint's PRESENCE is enforced
+    src = GL020_POSITIVE.replace(
+        "def build(x, starts, interpret=False):",
+        "def build(x, starts, interpret=False):\n"
+        "    sub = {1: 32, 2: 16}.get(x.dtype.itemsize, 8)",
+    ).replace(
+        "y0 = starts_ref[b, 0]",
+        "y0 = pl.multiple_of(starts_ref[b, 0], sub)",
+    )
+    findings, _ = run(src)
+    assert "GL020" not in codes(findings)
+
+
+def test_gl020_ignores_non_any_refs():
+    # dynamic indexing into a blocked (VMEM) ref carries no DMA-slice
+    # divisibility obligation
+    src = GL020_POSITIVE.replace(
+        "x_ref.at[pl.ds(y0, 8), pl.ds(x0, 128)]",
+        "x_ref.at[0, pl.ds(0, 128)]",
+    ).replace(
+        "o_ref[...] = scratch[...]",
+        "o_ref[pl.ds(y0, 8), pl.ds(x0, 128)] = scratch[...]",
+    )
+    findings, _ = run(src)
+    assert "GL020" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL021
+GL021_POSITIVE = PREAMBLE + """\
+
+    def build(x, interpret=False):
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((1024, 2048), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1024, 2048), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((8192, 2048), jnp.float32),
+            interpret=interpret,
+        )(x)
+"""
+
+
+def test_gl021_detects_vmem_overflow():
+    # 1024x2048 f32 blocks = 8 MiB each, x2 double-buffered (dynamic
+    # index map), in + out = 32 MiB against a 16 MiB budget
+    findings, _ = run(GL021_POSITIVE)
+    assert codes(findings).count("GL021") == 1
+    assert "VMEM" in findings[0].message
+
+
+def test_gl021_suppressed():
+    src = GL021_POSITIVE.replace(
+        "return pl.pallas_call(",
+        "return pl.pallas_call(  # graftlint: disable=GL021",
+    )
+    findings, suppressed = run(src)
+    assert "GL021" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl021_fitting_blocks_are_clean():
+    src = GL021_POSITIVE.replace("1024, 2048", "256, 512")
+    findings, _ = run(src)
+    assert "GL021" not in codes(findings)
+
+
+def test_gl021_constant_index_block_not_double_buffered():
+    # a constant-index (grid-resident) block counts once: 1024x2048 f32
+    # = 8 MiB in + 8 MiB out = 16 MiB, exactly at budget -> clean; the
+    # same blocks with dynamic index maps overflow (the positive case)
+    src = GL021_POSITIVE.replace("lambda i: (i, 0)", "lambda i: (0, 0)")
+    findings, _ = run(src)
+    assert "GL021" not in codes(findings)
+
+
+def test_gl021_env_budget_override(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_VMEM_BUDGET", str(64 * 2**20))
+    assert vmem_budget_bytes() == 64 * 2**20
+    findings, _ = run(GL021_POSITIVE)
+    assert "GL021" not in codes(findings)
+    monkeypatch.setenv("CHUNKFLOW_VMEM_BUDGET", "1024")
+    src = GL021_POSITIVE.replace("1024, 2048", "256, 512")
+    findings, _ = run(src)
+    assert codes(findings).count("GL021") == 1
+
+
+def test_gl021_symbolic_shapes_skip():
+    # unfoldable block dims (the shipping kernels' py/px arguments) make
+    # the block unaccountable: under-count, never guess
+    src = GL021_POSITIVE.replace("(1024, 2048)", "(py, px)").replace(
+        "def build(x, interpret=False):",
+        "def build(x, py, px, interpret=False):",
+    )
+    findings, _ = run(src)
+    assert "GL021" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL022
+GL022_POSITIVE = PREAMBLE + """\
+
+    def build(x, interpret=False):
+        def kernel(x_ref, o_ref):
+            o_ref[...] = o_ref[...] + x_ref[...]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+            interpret=interpret,
+        )(x)
+"""
+
+
+def test_gl022_detects_unaliased_rmw_output():
+    findings, _ = run(GL022_POSITIVE)
+    assert codes(findings).count("GL022") == 1
+    assert "input_output_aliases" in findings[0].message
+
+
+def test_gl022_suppressed():
+    src = GL022_POSITIVE.replace(
+        "o_ref[...] = o_ref[...] + x_ref[...]",
+        "o_ref[...] = o_ref[...] + x_ref[...]"
+        "  # graftlint: disable=GL022",
+    )
+    findings, suppressed = run(src)
+    assert "GL022" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl022_aliased_rmw_is_clean():
+    src = GL022_POSITIVE.replace(
+        "interpret=interpret,",
+        "interpret=interpret,\n        input_output_aliases={0: 0},",
+    )
+    findings, _ = run(src)
+    assert "GL022" not in codes(findings)
+
+
+def test_gl022_write_only_output_is_clean():
+    src = GL022_POSITIVE.replace(
+        "o_ref[...] = o_ref[...] + x_ref[...]",
+        "o_ref[...] = x_ref[...] * 2.0",
+    )
+    findings, _ = run(src)
+    assert "GL022" not in codes(findings)
+
+
+def test_gl022_async_copy_source_through_at_binding():
+    # the blend kernel's shape: tile = out_ref.at[...] used as a copy
+    # SOURCE is a read of the output
+    src = PREAMBLE + """\
+
+        def build(x, interpret=False):
+            def kernel(x_ref, o_ref, scratch, sem):
+                tile = o_ref.at[pl.ds(0, 8), pl.ds(0, 128)]
+                load = pltpu.make_async_copy(tile, scratch, sem)
+                load.start()
+                load.wait()
+                scratch[...] = scratch[...] + x_ref[...]
+                store = pltpu.make_async_copy(scratch, tile, sem)
+                store.start()
+                store.wait()
+
+            return pl.pallas_call(
+                kernel,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+                out_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                scratch_shapes=[
+                    pltpu.VMEM((8, 128), jnp.float32),
+                    pltpu.SemaphoreType.DMA(()),
+                ],
+                interpret=interpret,
+            )(x)
+    """
+    findings, _ = run(src)
+    assert codes(findings).count("GL022") == 1
+    aliased = src.replace(
+        "interpret=interpret,",
+        "interpret=interpret,\n            input_output_aliases={0: 0},",
+    )
+    findings, _ = run(aliased)
+    assert "GL022" not in codes(findings)
+
+
+# ---------------------------------------------------------------- GL023
+GL023_UNWAITED = PREAMBLE + """\
+
+    def build(x, interpret=False):
+        def kernel(x_ref, o_ref, scratch, sem):
+            copy = pltpu.make_async_copy(x_ref, scratch, sem)
+            copy.start()
+            o_ref[...] = scratch[...]
+
+        return pl.pallas_call(
+            kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            interpret=interpret,
+        )(x)
+"""
+
+
+def test_gl023_detects_started_unwaited_copy():
+    findings, _ = run(GL023_UNWAITED)
+    assert codes(findings).count("GL023") == 1
+    assert "never waited" in [
+        f for f in findings if f.code == "GL023"][0].message
+
+
+def test_gl023_suppressed():
+    src = GL023_UNWAITED.replace(
+        "copy.start()",
+        "copy.start()  # graftlint: disable=GL023",
+    )
+    findings, suppressed = run(src)
+    assert "GL023" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl023_waited_copy_is_clean():
+    src = GL023_UNWAITED.replace(
+        "copy.start()",
+        "copy.start()\n        copy.wait()",
+    )
+    findings, _ = run(src)
+    assert "GL023" not in codes(findings)
+
+
+def _when_arm_kernel(first_copy_completes: bool) -> str:
+    """A kernel where a when-arm starts a second copy on the same
+    semaphore — legal only if the first copy already completed."""
+    first = ("c1.start()\n            c1.wait()"
+             if first_copy_completes else "c1.start()")
+    return PREAMBLE + f"""\
+
+    def build(x, interpret=False):
+        def kernel(x_ref, o_ref, scratch, sem):
+            b = pl.program_id(0)
+            c1 = pltpu.make_async_copy(x_ref, scratch, sem)
+            {first}
+
+            @pl.when(b == 0)
+            def _():
+                c2 = pltpu.make_async_copy(x_ref, scratch, sem)
+                c2.start()
+                c2.wait()
+
+            {"o_ref[...] = scratch[...]" if first_copy_completes
+             else "c1.wait()"}
+            {"" if first_copy_completes
+             else "o_ref[...] = scratch[...]"}
+
+        return pl.pallas_call(
+            kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec((8, 128), lambda i: (0, 0))],
+            out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((8, 128), jnp.float32),
+                pltpu.SemaphoreType.DMA(()),
+            ],
+            interpret=interpret,
+        )(x)
+"""
+
+
+def test_gl023_detects_semaphore_reuse_across_when_arm():
+    findings, _ = run(_when_arm_kernel(first_copy_completes=False))
+    assert codes(findings).count("GL023") == 1
+    assert "reused" in [
+        f for f in findings if f.code == "GL023"][0].message
+
+
+def test_gl023_sequential_reuse_after_wait_is_clean():
+    # the blend kernel's when-arm pattern: the semaphore is reused only
+    # after the prior copy completed
+    findings, _ = run(_when_arm_kernel(first_copy_completes=True))
+    assert "GL023" not in codes(findings)
+
+
+def test_gl023_detects_inline_unwaitable_start():
+    src = GL023_UNWAITED.replace(
+        "copy = pltpu.make_async_copy(x_ref, scratch, sem)\n"
+        "        copy.start()",
+        "pltpu.make_async_copy(x_ref, scratch, sem).start()",
+    )
+    findings, _ = run(src)
+    assert codes(findings).count("GL023") == 1
+
+
+# ---------------------------------------------------------------- GL024
+GL024_POSITIVE = """\
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+
+    def build(x):
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(x)
+"""
+
+
+def test_gl024_detects_unguarded_pallas_call():
+    findings, _ = run(GL024_POSITIVE)
+    assert codes(findings).count("GL024") == 1
+    assert "selector" in findings[0].message
+
+
+def test_gl024_suppressed():
+    src = GL024_POSITIVE.replace(
+        "return pl.pallas_call(",
+        "return pl.pallas_call(  # graftlint: disable=GL024",
+    )
+    findings, suppressed = run(src)
+    assert "GL024" not in codes(findings)
+    assert suppressed == 1
+
+
+def test_gl024_mode_selector_def_is_clean():
+    src = GL024_POSITIVE.replace(
+        "def build(x):",
+        "def pallas_mode():\n"
+        "    return \"off\"\n"
+        "\n"
+        "\n"
+        "def build(x):",
+    )
+    findings, _ = run(src)
+    assert "GL024" not in codes(findings)
+
+
+def test_gl024_imported_mode_selector_is_clean():
+    src = GL024_POSITIVE.replace(
+        "import jax\n",
+        "import jax\n"
+        "from chunkflow_tpu.ops.pallas_blend import pallas_mode\n",
+    )
+    findings, _ = run(src)
+    assert "GL024" not in codes(findings)
+
+
+def test_gl024_dynamic_interpret_kwarg_is_clean():
+    src = GL024_POSITIVE.replace(
+        "def build(x):", "def build(x, interpret=False):"
+    ).replace(
+        "out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),",
+        "out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+        "        interpret=interpret,",
+    )
+    findings, _ = run(src)
+    assert "GL024" not in codes(findings)
+
+
+def test_gl024_literal_interpret_kwarg_still_fires():
+    # interpret=True hard-codes the interpreter: still no way to run
+    # the compiled kernel, still no selection seam
+    src = GL024_POSITIVE.replace(
+        "out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),",
+        "out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+        "        interpret=True,",
+    )
+    findings, _ = run(src)
+    assert codes(findings).count("GL024") == 1
+
+
+# ------------------------------------------------------------ the model
+def test_model_parses_shipping_blend_kernel():
+    path = REPO / "chunkflow_tpu" / "ops" / "pallas_blend.py"
+    ctx = FileContext(str(path), path.read_text())
+    model = get_pallas_model(ctx)
+    assert model.has_mode_selector
+    assert len(model.sites) == 1
+    site = model.sites[0]
+    assert site.num_scalar_prefetch == 3
+    assert [s.any_space for s in site.in_specs] == [
+        False, False, True, True]
+    assert [s.any_space for s in site.out_specs] == [True, True]
+    assert site.aliases == {5: 0, 6: 1}
+    assert [s.kind for s in site.scratch] == ["vmem", "sem", "sem"]
+    assert site.params["out_ref"] == ("out", 0)
+    assert site.params["starts_ref"] == ("scalar", 0)
+    assert site.params["scratch"] == ("scratch", 0)
+    # the bump block's index map is constant: grid-resident, no
+    # double-buffer charge
+    assert site.in_specs[1].constant_index
+    assert not site.in_specs[0].constant_index
+
+
+def test_model_parses_shipping_gather_kernel():
+    path = REPO / "chunkflow_tpu" / "ops" / "pallas_gather.py"
+    ctx = FileContext(str(path), path.read_text())
+    model = get_pallas_model(ctx)
+    assert model.has_mode_selector
+    assert len(model.sites) == 1
+    site = model.sites[0]
+    assert site.num_scalar_prefetch == 2
+    assert [s.any_space for s in site.in_specs] == [True]
+    assert site.aliases is None
+    assert site.params["chunk_ref"] == ("in", 0)
+
+
+def test_shipping_kernels_have_zero_pallas_findings():
+    for rel in ("chunkflow_tpu/ops/pallas_blend.py",
+                "chunkflow_tpu/ops/pallas_gather.py"):
+        path = REPO / rel
+        findings, suppressed = lint_file(
+            str(path), path.read_text(), Config()
+        )
+        gl02x = [f for f in findings if f.code.startswith("GL02")]
+        assert gl02x == [], f"{rel}: {gl02x}"
+        assert suppressed == 0, rel

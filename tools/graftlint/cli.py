@@ -46,7 +46,8 @@ def _print_human(new: List[Finding], grandfathered: int, stale: int,
 
 
 #: rule code -> family label for --stats (GL001-GL007 are the jit/tracer
-#: correctness rules, GL010+ the concurrency soundness plane)
+#: correctness rules, GL010-GL014 the concurrency soundness plane,
+#: GL020+ the Pallas/Mosaic kernel soundness plane)
 def rule_family(code: str) -> str:
     try:
         number = int(code[2:])
@@ -54,6 +55,8 @@ def rule_family(code: str) -> str:
         return "other"
     if number == 0:
         return "parse"
+    if number >= 20:
+        return "pallas"
     return "concurrency" if number >= 10 else "jit"
 
 
@@ -64,9 +67,9 @@ def _print_stats(all_findings: List[Finding], new: List[Finding],
     per_rule = Counter(f.code for f in all_findings)
     families = Counter(rule_family(f.code) for f in all_findings)
     print("graftlint stats:")
-    for family in ("parse", "jit", "concurrency", "other"):
-        if family not in families and family != "concurrency" \
-                and family != "jit":
+    for family in ("parse", "jit", "concurrency", "pallas", "other"):
+        if family not in families and family not in (
+                "concurrency", "jit", "pallas"):
             continue
         rules = ", ".join(
             f"{code}={per_rule[code]}"
@@ -81,8 +84,8 @@ def _print_stats(all_findings: List[Finding], new: List[Finding],
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="JAX/TPU correctness + concurrency linter for "
-                    "chunkflow-tpu (rules GL001..GL014; see "
+        description="JAX/TPU correctness + concurrency + Pallas kernel "
+                    "linter for chunkflow-tpu (rules GL001..GL024; see "
                     "docs/linting.md)",
     )
     parser.add_argument("paths", nargs="*",

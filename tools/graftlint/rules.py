@@ -440,4 +440,10 @@ from tools.graftlint.concurrency import CONCURRENCY_RULES  # noqa: E402
 
 RULES.extend(CONCURRENCY_RULES)
 
+# The GL020-series Pallas/Mosaic kernel soundness rules likewise live in
+# their own module, resting on the pallas_call site model.
+from tools.graftlint.pallas import PALLAS_RULES  # noqa: E402
+
+RULES.extend(PALLAS_RULES)
+
 RULES_BY_CODE = {r.code: r for r in RULES}

@@ -1,0 +1,166 @@
+"""Per-kernel analytic cost report: VMEM footprint vs device budget,
+bytes per grid step, whole-grid traffic and FLOPs — for the shipping
+Pallas kernels, at the geometry you ask for.
+
+The numbers are the BUILDERS' own arithmetic
+(``chunkflow_tpu.ops.pallas_blend.fused_kernel_cost`` /
+``chunkflow_tpu.ops.pallas_gather.gather_kernel_cost``) — the same
+model the GL021 lint rule applies statically and the same stamps
+``profiling.stamp_cost`` folds into the programs.json catalog's
+``vmem_bytes`` column, so the three planes (lint, ledger, this report)
+can never drift apart: all read one formula that lives next to the
+kernel it describes.
+
+With ``--programs path/to/programs.json`` the report cross-checks the
+stamped catalog against the analytic model and flags any drift
+(a stamp site that fell behind a kernel change).
+
+Usage:
+  python tools/kernel_report.py [--patch Z,Y,X] [--batch N]
+      [--channels-in N] [--channels-out N]
+      [--dtypes uint8,uint16,float32] [--programs programs.json]
+      [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _triple(text: str):
+    parts = [int(p) for p in text.split(",")]
+    if len(parts) != 3:
+        raise argparse.ArgumentTypeError(f"want Z,Y,X — got {text!r}")
+    return tuple(parts)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for scale, suffix in ((2**30, "G"), (2**20, "M"), (2**10, "K")):
+        if n >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}B"
+
+
+def build_report(patch, batch: int, ci: int, co: int,
+                 dtypes) -> list:
+    """One row per kernel flavor: name, geometry, and the analytic cost
+    dict, plus the share of the device VMEM budget the footprint
+    claims (the GL021 denominator — ``CHUNKFLOW_VMEM_BUDGET`` /
+    ``CHUNKFLOW_VMEM_DEVICE`` aware)."""
+    from chunkflow_tpu.ops import pallas_blend, pallas_gather
+    from tools.graftlint.pallas import vmem_budget_bytes
+
+    budget = vmem_budget_bytes()
+    rows = []
+    for dtype in dtypes:
+        cost = pallas_gather.gather_kernel_cost(batch, ci, patch, dtype)
+        rows.append({
+            "kernel": "gather_patches",
+            "geometry": f"B={batch} ci={ci} pin={patch} {dtype}",
+            **cost,
+            "vmem_budget": budget,
+            "vmem_frac": cost["vmem_bytes"] / budget,
+        })
+    cost = pallas_blend.fused_kernel_cost(batch, co, patch)
+    rows.append({
+        "kernel": "fused_accumulate_patches",
+        "geometry": f"B={batch} co={co} pout={patch} float32",
+        **cost,
+        "vmem_budget": budget,
+        "vmem_frac": cost["vmem_bytes"] / budget,
+    })
+    return rows
+
+
+def check_programs(path: str, rows: list) -> list:
+    """Cross-check a programs.json catalog's stamped ``vmem_bytes``
+    against the analytic model: families whose stamp disagrees with any
+    reported row's kernel (same formula, so equality is exact when the
+    bench geometry matches) come back as drift notes; families without
+    a stamp are skipped — XLA reference legs carry no VMEM story."""
+    with open(path) as f:
+        payload = json.load(f)
+    notes = []
+    analytic = {r["kernel"]: r["vmem_bytes"] for r in rows}
+    stamped_families = {
+        "blend_fused": "fused_accumulate_patches",
+        "front_dev": "gather_patches",
+    }
+    for entry in payload.get("programs", []):
+        kernel = stamped_families.get(entry.get("family"))
+        vmem = entry.get("vmem_bytes")
+        if kernel is None or vmem is None:
+            continue
+        want = analytic.get(kernel)
+        if want is not None and float(vmem) != float(want):
+            notes.append(
+                f"{entry['family']}: stamped vmem {_fmt_bytes(vmem)} != "
+                f"analytic {_fmt_bytes(want)} at the reported geometry "
+                f"(bench geometry differs, or a stamp site fell behind "
+                f"a kernel change)"
+            )
+    return notes
+
+
+def print_report(rows: list) -> None:
+    print("kernel cost report (analytic — the GL021/stamp_cost model):")
+    print(
+        f"  {'kernel':<26} {'geometry':<34} {'vmem':>8} {'of budget':>9} "
+        f"{'B/step':>8} {'grid':>6} {'bytes':>9} {'flops':>9}"
+    )
+    for r in rows:
+        print(
+            f"  {r['kernel']:<26} {r['geometry']:<34} "
+            f"{_fmt_bytes(r['vmem_bytes']):>8} {r['vmem_frac']:>9.1%} "
+            f"{_fmt_bytes(r['bytes_per_step']):>8} "
+            f"{r['grid_steps']:>6} "
+            f"{_fmt_bytes(r['bytes_accessed']):>9} "
+            f"{r['flops'] / 1e9:>8.2f}G"
+        )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Analytic VMEM / traffic report for the shipping "
+                    "Pallas kernels")
+    parser.add_argument("--patch", type=_triple, default=(4, 64, 64),
+                        help="patch Z,Y,X (default 4,64,64)")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--channels-in", type=int, default=1)
+    parser.add_argument("--channels-out", type=int, default=3)
+    parser.add_argument("--dtypes", default="uint8,uint16,float32",
+                        help="gather chunk dtypes (comma-separated)")
+    parser.add_argument("--programs", default=None,
+                        help="programs.json to cross-check stamps against")
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    rows = build_report(args.patch, args.batch, args.channels_in,
+                        args.channels_out, args.dtypes.split(","))
+    notes = check_programs(args.programs, rows) if args.programs else []
+    if args.json:
+        json.dump({"rows": rows, "drift": notes}, sys.stdout, indent=2)
+        print()
+    else:
+        print_report(rows)
+        for note in notes:
+            print(f"  DRIFT: {note}")
+    over = [r for r in rows if r["vmem_frac"] > 1.0]
+    if over:
+        for r in over:
+            print(f"  OVER BUDGET: {r['kernel']} at {r['geometry']} — "
+                  f"{_fmt_bytes(r['vmem_bytes'])} of "
+                  f"{_fmt_bytes(r['vmem_budget'])}", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -213,7 +213,7 @@ def compile_split():
     args = (jnp.zeros(shape, jnp.float32), jnp.asarray(s_in),
             jnp.asarray(s_out), jnp.asarray(valid), engine.params)
     t0 = time.perf_counter()
-    lowered = jax.jit(program).lower(*args)
+    lowered = jax.jit(program, donate_argnums=(0,)).lower(*args)
     t1 = time.perf_counter()
     compiled = lowered.compile()
     t2 = time.perf_counter()
