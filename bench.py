@@ -975,7 +975,11 @@ def run_kernelcheck_overhead(
     cache-tag suffix means on/off builds can never share a compiled
     program — and the on leg cross-checks that the clean workload
     raises no violation (the same no-false-positives contract tier-1
-    enforces).
+    enforces). The 5% gate holds because observe_grid's per-grid-step
+    RMW-trace callback is gated at TRACE time on arm_grid_trace
+    (ISSUE 17): unarmed runs — this bench, all of tier-1 — carry only
+    the poison writes plus one bounds and one NaN callback per
+    invocation.
     """
     import jax
     import jax.numpy as jnp
@@ -2242,6 +2246,294 @@ def run_front_half(rounds: int = 5) -> dict:
     }
 
 
+def run_fused_pipeline(rounds: int = 5, n_batches: int = 4) -> dict:
+    """One fused patch pipeline vs the separate-programs structure it
+    replaces (ISSUE 17, CI gate): gather -> forward -> blend as one
+    device-resident chain, with no host round trip between the stages.
+
+    On chip, ``CHUNKFLOW_FUSED_PIPELINE`` selects both proven kernel
+    legs at once (ops/pallas_gather.py + ops/pallas_blend.py) and the
+    serving packer keeps the weighted-prediction stack DEVICE-resident
+    (serve/packer.py): forward rows are overlaid into a resident device
+    buffer instead of being downloaded per batch into a host stack that
+    is re-uploaded wholesale at blend time. Interpret mode executes the
+    kernels per grid step in Python (~30-50x slower than compiled XLA
+    on this box — not a throughput proxy), so the CPU gate times the
+    two SERVING STRUCTURES honestly over the same workload — identical
+    compiled stage programs (batched gather+forward, final scatter
+    blend), different residency for the stack between them:
+
+    - ``pipe_sep``: the pre-fusion structure — each batch's rows land
+      in a HOST numpy stack (``np.asarray`` download + host overlay
+      write) and the finished stack is re-uploaded (``jnp.asarray``, a
+      real staged copy on every backend — the ``front_half`` bench's
+      boundary convention) before the blend consumes it. On the host
+      backend the download side is zero-copy, so the CPU gate
+      UNDERCOUNTS this leg — conservative, in the fused leg's favor;
+    - ``pipe_fused``: the fused pipeline's structure — rows are written
+      into the resident device stack by the packer's overlay program
+      (``weighted.at[idx].set(rows)``, buffer donated), and the blend
+      consumes it in place. No download, no host write, no re-upload.
+
+    Bit-identity is asserted in-run between both proxy legs AND the
+    real kernels composed end to end in interpret mode (Pallas gather
+    -> the same forward -> weighting -> Pallas fused blend; untimed
+    correctness leg) — the composed kernels must reproduce the proxy
+    legs' blended volumes exactly. Both legs build through a
+    ProgramCache and stamp the SAME analytic byte model — the
+    pipeline's logical floor, sharing arithmetic with
+    ``ops.blend.pipeline_kernel_cost`` — so ``roofline_util`` directly
+    ranks the two structures on identical work: the separate leg moves
+    the weighted stack across the host boundary ON TOP of the floor
+    and scores lower; that surplus is itemized in its
+    ``hbm_intermediate_bytes`` stamp (the fused leg stamps 0). Gate:
+    >= 1.2x (``gate_pass``); the process only fails below the 1.1x
+    hard floor."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from chunkflow_tpu.core import profiling, telemetry
+    from chunkflow_tpu.core.compile_cache import ProgramCache
+    from chunkflow_tpu.inference.bump import bump_const
+    from chunkflow_tpu.inference.patching import (
+        enumerate_patches,
+        pad_to_batch,
+    )
+    from chunkflow_tpu.ops import blend as blend_ops
+    from chunkflow_tpu.ops import pallas_blend, pallas_gather
+
+    telemetry.configure(_bench_metrics_dir())
+
+    ci, co = 1, 3
+    pin = pout = (4, 32, 128)
+    shape = (16, 192, 384)
+    overlap = (2, 16, 64)
+    grid = enumerate_patches(shape, pin, pout, overlap)
+    in_starts, out_starts, valid = pad_to_batch(grid, n_batches)
+    n = len(valid)
+    assert n % n_batches == 0, (n, n_batches)
+    slots = n // n_batches
+    rng = np.random.default_rng(0)
+    raw = rng.integers(0, 256, (ci,) + shape, dtype=np.uint8)
+    scale = np.float32(1.0 / 255.0)
+    bump_j = bump_const(pout)
+    pz, py, px = pout
+    pad_y, pad_x = pallas_blend.buffer_padding(pout)
+    buf = (shape[0], shape[1] + pad_y, shape[2] + pad_x)
+    # the stand-in forward: a per-channel scaling — elementwise with NO
+    # mul+add chain, so every leg applies the exact same scalar IEEE
+    # ops per element and stays bitwise comparable to the
+    # eager/interpret kernel leg (an affine ``x*w+b`` compiles to an
+    # FMA inside the jitted programs — one rounding — while eager ops
+    # round the mul and add separately; a real convnet's reductions
+    # would likewise re-order under re-batching)
+    w_vec = np.asarray([0.5, -1.25, 2.0], np.float32)
+
+    def forward(patch_f32):
+        # [ci=1, pz, py, px] f32 -> [co, pz, py, px] f32
+        return patch_f32[0][None] * w_vec[:, None, None, None]
+
+    def fwd_program(chunk, s_in, valid_b):
+        # one serving batch: convert + gather from the resident chunk,
+        # forward, bump weighting — identical in BOTH legs (the legs
+        # differ only in where the rows go afterwards)
+        chunk_f = chunk.astype(jnp.float32) * scale
+        stack = jax.vmap(
+            lambda s: lax.dynamic_slice(
+                chunk_f, (0, s[0], s[1], s[2]), (ci,) + pin
+            )
+        )(s_in)
+        preds = stack[:, 0][:, None] * w_vec[None, :, None, None, None]
+        return preds * bump_j[None, None] \
+            * valid_b[:, None, None, None, None]
+
+    dnums4 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3, 4), inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(1, 2, 3))
+    dnums3 = lax.ScatterDimensionNumbers(
+        update_window_dims=(1, 2, 3), inserted_window_dims=(),
+        scatter_dims_to_operand_dims=(0, 1, 2))
+
+    def scatter_program(weighted, valid, starts):
+        # the production blend tail (pre-weighted scatter_add) —
+        # identical in both legs
+        wpatch = bump_j[None] * valid[:, None, None, None]
+        out = lax.scatter_add(
+            jnp.zeros((co,) + shape, jnp.float32), starts, weighted,
+            dnums4)
+        w = lax.scatter_add(
+            jnp.zeros(shape, jnp.float32), starts, wpatch, dnums3)
+        return out, w
+
+    # chunk deliberately NOT donated: both legs gather from the same
+    # resident buffer every batch of every round
+    fwd = jax.jit(fwd_program)  # graftlint: disable=GL005
+    scatter = jax.jit(scatter_program)
+    # the packer's overlay program (serve/packer.py): rows written into
+    # the resident stack in place (buffer donated)
+    overlay = jax.jit(
+        lambda stack, rows, idx: stack.at[idx].set(rows),
+        donate_argnums=(0,))
+
+    chunk_dev = jnp.asarray(raw)
+    valid_dev = jnp.asarray(valid)
+    starts_dev = jnp.asarray(out_starts)
+    groups = [np.arange(b * slots, (b + 1) * slots, dtype=np.int32)
+              for b in range(n_batches)]
+    starts_groups = [jnp.asarray(in_starts[g]) for g in groups]
+    valid_groups = [jnp.asarray(valid[g]) for g in groups]
+    idx_groups = [jnp.asarray(g) for g in groups]
+
+    def sep_leg():
+        # pre-fusion serving: rows -> host stack -> wholesale re-upload
+        weighted_np = np.zeros((n, co) + pout, np.float32)
+        for b in range(n_batches):
+            rows = fwd(chunk_dev, starts_groups[b], valid_groups[b])
+            weighted_np[groups[b]] = np.asarray(rows)
+        weighted_dev = jnp.asarray(weighted_np)
+        out, w = scatter(weighted_dev, valid_dev, starts_dev)
+        jax.block_until_ready((out, w))
+        return out, w
+
+    def fused_leg():
+        # fused serving: rows stay device-resident end to end
+        weighted_dev = jnp.zeros((n, co) + pout, jnp.float32)
+        for b in range(n_batches):
+            rows = fwd(chunk_dev, starts_groups[b], valid_groups[b])
+            weighted_dev = overlay(weighted_dev, rows, idx_groups[b])
+        out, w = scatter(weighted_dev, valid_dev, starts_dev)
+        jax.block_until_ready((out, w))
+        return out, w
+
+    # ANALYTIC byte model (profiling.stamp_cost): BOTH legs stamp the
+    # pipeline's logical floor — raw chunk read, one full-chunk f32
+    # materialization (the gather operand the XLA legs build either
+    # way), the weighted-stack write + the blend's read of it, and the
+    # scatter destination read-modify-write — so roofline_util ranks
+    # the two structures on identical work. The separate leg moves the
+    # weighted stack across the host boundary ON TOP of that floor
+    # (host overlay write + wholesale re-upload): that surplus is the
+    # prediction-stack term of ops.blend.pipeline_kernel_cost's
+    # hbm_intermediate_bytes (the gathered-stack term does not apply
+    # here — both legs fuse gather+forward inside one program; the
+    # REAL kernel pipeline deletes that one too) and is stamped on the
+    # sep row, the fused row stamping 0.
+    pipe_cost = blend_ops.pipeline_kernel_cost(
+        n, ci, co, pin, pout, dtype=raw.dtype)
+    chunk_raw = int(raw.nbytes)
+    chunk_f32 = chunk_raw * 4
+    pvox = int(np.prod(pout))
+    wstack_bytes = n * co * pvox * 4
+    patch_stack_bytes = n * ci * pvox * 4
+    hbm_sep = pipe_cost["hbm_intermediate_bytes"] - 2 * patch_stack_bytes
+    assert hbm_sep == 2 * wstack_bytes
+    scatter_bytes = 3 * n * (co + 1) * pvox * 4
+    fwd_flops = n * co * pvox
+    weight_flops = n * co * pvox * 2  # bump multiply + valid mask
+    flops = pipe_cost["flops"] + fwd_flops + weight_flops
+    bytes_floor = chunk_raw + 2 * chunk_f32 + 2 * wstack_bytes \
+        + scatter_bytes
+
+    # the legs are plain-python drivers around compiled programs;
+    # instrument_program keys on a ``.lower`` attribute to tell
+    # programs from cached sentinels, so give them one (its XLA cost
+    # analysis is best-effort and simply yields nothing here — the
+    # stamped analytic model above is the scored cost)
+    sep_leg.lower = None
+    fused_leg.lower = None
+
+    programs = ProgramCache(label="pipeline_bench")
+    sep = programs.get(
+        ("pipe_sep",),
+        lambda: profiling.stamp_cost(
+            sep_leg, flops=flops, bytes_accessed=bytes_floor,
+            hbm_intermediate_bytes=hbm_sep))
+    fused = programs.get(
+        ("pipe_fused",),
+        lambda: profiling.stamp_cost(
+            fused_leg, flops=flops, bytes_accessed=bytes_floor,
+            vmem_bytes=pipe_cost["vmem_bytes"],
+            hbm_intermediate_bytes=0))
+
+    so, sw = sep()
+    fo, fw = fused()
+    if not (np.array_equal(np.asarray(so), np.asarray(fo))
+            and np.array_equal(np.asarray(sw), np.asarray(fw))):
+        raise RuntimeError(
+            "fused_pipeline bench: proxy legs NOT bit-identical")
+
+    # correctness leg: the REAL kernels composed end to end in
+    # interpret mode — Pallas gather from the raw padded chunk, the
+    # same forward + weighting, then the Pallas fused blend — must
+    # reproduce the proxy legs' blended volumes bit-exactly (untimed:
+    # interpret wall is Python overhead, not kernel cost)
+    g_pad_y, g_pad_x = pallas_gather.gather_buffer_padding(
+        pin, raw.dtype)
+    padded = np.pad(raw, [(0, 0), (0, 0), (0, g_pad_y), (0, g_pad_x)])
+    stack_k = pallas_gather.gather_patches(
+        jnp.asarray(padded), jnp.asarray(in_starts), pin,
+        interpret=True)
+    preds_k = jax.vmap(forward)(stack_k)
+    ko, kw = pallas_blend.fused_accumulate_patches(
+        jnp.zeros((co,) + buf, jnp.float32),
+        jnp.zeros(buf, jnp.float32),
+        preds_k, valid_dev, bump_j, starts_dev, interpret=True,
+    )
+    ko = np.asarray(ko)[:, :, :shape[1], :shape[2]]
+    kw = np.asarray(kw)[:, :shape[1], :shape[2]]
+    if not (np.array_equal(ko, np.asarray(fo))
+            and np.array_equal(kw, np.asarray(fw))):
+        raise RuntimeError(
+            "fused_pipeline bench: the composed Pallas kernels "
+            "(interpret) are NOT bit-identical to the XLA proxy legs")
+
+    def best_of(leg):
+        best = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            leg()
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    sep_s = best_of(sep)
+    fused_s = best_of(fused)
+
+    entries = {e["family"]: e for e in profiling.catalog()}
+    util_sep = (entries.get("pipe_sep") or {}).get("roofline_util")
+    util_fused = (entries.get("pipe_fused") or {}).get("roofline_util")
+    telemetry.flush()
+    telemetry.configure(None)
+    if util_sep is None or util_fused is None:
+        raise RuntimeError(
+            "fused_pipeline bench: proxy legs missing from the "
+            "roofline ledger (programs.json)")
+
+    speedup = sep_s / fused_s if fused_s else 0.0
+    return {
+        "metric": "fused_pipeline",
+        "value": round(speedup, 2),
+        "unit": "x_fused_vs_separate_programs",
+        "sep_s": round(sep_s, 4),
+        "fused_s": round(fused_s, 4),
+        "patches": n,
+        "batches": n_batches,
+        "patch": list(pout),
+        "chunk": list(shape),
+        "hbm_intermediate_sep": int(hbm_sep),
+        "hbm_intermediate_fused": 0,
+        "roofline_util_fused": util_fused,
+        "roofline_util_sep": util_sep,
+        "roofline_ok": bool(util_fused >= util_sep),
+        "interpret_kernel_checked": True,
+        "gate_x": 1.2,
+        "gate_pass": speedup >= 1.2,
+        "bit_identical": True,
+    }
+
+
+
 def run_storage_throughput(
     volume_shape=(64, 256, 256),
     block=(16, 64, 64),
@@ -2595,8 +2887,11 @@ def _cached_hardware_result():
                 "double-buffered pipeline rework (PR 2) AND the fused "
                 "Pallas blend rework (ISSUE 14) — not a current-code "
                 "number. Re-measure with tools/tpu_validation.py when "
-                "the tunnel returns; its bench_blend_fused step stamps "
-                "the fused-vs-scatter row that retires this headline",
+                "the tunnel returns; four on-chip rows are pending "
+                "there: bench_multichip (ISSUE 13), bench_blend_fused "
+                "(ISSUE 14, the fused-vs-scatter row that retires this "
+                "headline), bench_front_half (ISSUE 15), and "
+                "bench_fused_pipeline (ISSUE 17)",
     }
     if meta.get("blend_default"):
         result["measured_config"] = meta["blend_default"]
@@ -2838,7 +3133,7 @@ def main() -> int:
         "resilience_overhead", "export_overhead", "fleet_smoke",
         "serving_throughput", "locksmith_overhead", "storage_throughput",
         "slo_overhead", "multichip_overlap", "blend_fused", "front_half",
-        "kernelcheck_overhead",
+        "fused_pipeline", "kernelcheck_overhead",
     ):
         # CPU-safe micro-benchmarks: no backend probe, no child process —
         # they must produce their JSON line even with the tunnel down.
@@ -2888,6 +3183,17 @@ def main() -> int:
             # the host gather+convert+re-upload structure outright
             # (bit-identity across both legs AND the real interpret-mode
             # gather kernel is asserted inside, raising on divergence)
+            return 0 if result["value"] >= 1.1 else 4
+        if sys.argv[1] == "fused_pipeline":
+            result = run_fused_pipeline()
+            _emit(result)
+            # soft gate at the 1.2x target (reported as gate_pass,
+            # asserted slow-marked in tests/test_bench.py); hard floor
+            # at 1.1x — below that the one-program pipeline lost to the
+            # separate-programs structure outright (bit-identity across
+            # both proxies AND the real gather->forward->blend kernels
+            # composed in interpret mode is asserted inside, raising on
+            # any divergence)
             return 0 if result["value"] >= 1.1 else 4
         if sys.argv[1] == "pipeline_overlap":
             return _emit(run_pipeline_overlap())

@@ -66,6 +66,7 @@ from chunkflow_tpu.core import telemetry
 __all__ = [
     "instrument_program", "stamp_cost", "catalog", "write_catalog",
     "device_peaks", "note_h2d", "h2d_by_family",
+    "note_hbm_intermediate", "hbm_intermediate_by_family",
     "capture", "maybe_capture", "note_retrace", "note_stall",
     "note_slo_page", "start_task_window", "note_task_done",
     "wait_for_captures", "capture_base_dir",
@@ -140,8 +141,8 @@ class _ProgramRecord:
 
     __slots__ = (
         "family", "key", "label", "build_s", "compile_s", "flops",
-        "bytes_accessed", "vmem_bytes", "optimal_s", "calls",
-        "dispatch_s", "platform", "device_kind", "lock",
+        "bytes_accessed", "vmem_bytes", "hbm_intermediate", "optimal_s",
+        "calls", "dispatch_s", "platform", "device_kind", "lock",
     )
 
     def __init__(self, family: str, key: str, label: str, build_s: float):
@@ -153,6 +154,7 @@ class _ProgramRecord:
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
         self.vmem_bytes: Optional[float] = None
+        self.hbm_intermediate: Optional[float] = None
         self.optimal_s: Optional[float] = None
         self.calls = 0
         self.dispatch_s = 0.0  # post-compile dispatch wall, cumulative
@@ -234,12 +236,16 @@ class _InstrumentedProgram:
                 flops = cost.get("flops")
                 nbytes = cost.get("bytes accessed")
                 vmem = cost.get("vmem_bytes")
+                hbm_i = cost.get("hbm_intermediate_bytes")
                 optimal = cost.get("optimal_seconds")
                 rec.flops = float(flops) if flops is not None else None
                 rec.bytes_accessed = (
                     float(nbytes) if nbytes is not None else None
                 )
                 rec.vmem_bytes = float(vmem) if vmem is not None else None
+                rec.hbm_intermediate = (
+                    float(hbm_i) if hbm_i is not None else None
+                )
                 rec.optimal_s = (
                     float(optimal) if optimal is not None else None
                 )
@@ -287,7 +293,8 @@ class _CostStamped:
 
 def stamp_cost(program, flops: Optional[float] = None,
                bytes_accessed: Optional[float] = None,
-               vmem_bytes: Optional[float] = None):
+               vmem_bytes: Optional[float] = None,
+               hbm_intermediate_bytes: Optional[float] = None):
     """Attach an ANALYTIC cost model to a program before it enters a
     ProgramCache: the ledger then scores its roofline against these
     numbers instead of XLA's ``cost_analysis()``. Use for programs the
@@ -300,7 +307,13 @@ def stamp_cost(program, flops: Optional[float] = None,
     ``ops/pallas_blend.fused_kernel_cost`` /
     ``ops/pallas_gather.gather_kernel_cost``), surfaced as the catalog's
     ``vmem_bytes`` column so a budget regression shows up in the DEVICE
-    PROGRAMS table before it shows up as a Mosaic OOM."""
+    PROGRAMS table before it shows up as a Mosaic OOM.
+    ``hbm_intermediate_bytes`` is the inter-stage stack traffic this
+    program's composition materializes in HBM between pipeline stages
+    per call (ISSUE 17): the separate gather/forward/blend legs stamp
+    the stacks they write+re-read, the fused pipeline stamps ~0 — the
+    fusion's prize, surfaced as the catalog's
+    ``hbm_intermediate_bytes`` / log-summary ``hbm_i`` column."""
     cost: dict = {}
     if flops is not None:
         cost["flops"] = float(flops)
@@ -308,6 +321,8 @@ def stamp_cost(program, flops: Optional[float] = None,
         cost["bytes accessed"] = float(bytes_accessed)
     if vmem_bytes is not None:
         cost["vmem_bytes"] = float(vmem_bytes)
+    if hbm_intermediate_bytes is not None:
+        cost["hbm_intermediate_bytes"] = float(hbm_intermediate_bytes)
     return _CostStamped(program, cost)
 
 
@@ -337,6 +352,36 @@ def h2d_by_family() -> dict:
     """Staged H2D bytes per program family (a copy)."""
     with _H2D_LOCK:
         return dict(_H2D)
+
+
+_HBM_I_LOCK = threading.Lock()
+_HBM_I: dict = {}  # program family -> inter-stage stack bytes
+
+
+def note_hbm_intermediate(nbytes, key=None, label: str = "") -> None:
+    """Count inter-stage stack traffic the SEPARATE-programs composition
+    pays between pipeline stages (ISSUE 17): the gathered-patch /
+    weighted-prediction stacks one program materializes and the next
+    re-reads (including the serving packer's D2H+H2D round trip of the
+    weighted stack). The fused pipeline leg notes ~nothing here — the
+    ``transfer/hbm_intermediate_bytes`` counter and the per-family
+    bucket (the catalog's ``hbm_intermediate_bytes`` fallback when no
+    stamp carries it) make the fusion win visible in byte terms, the
+    same shape as :func:`note_h2d`. No-op under the telemetry kill
+    switch."""
+    if not telemetry.enabled():
+        return
+    telemetry.inc("transfer/hbm_intermediate_bytes", float(nbytes))
+    if key is not None:
+        family, _ = _family_of(key, label)
+        with _HBM_I_LOCK:
+            _HBM_I[family] = _HBM_I.get(family, 0.0) + float(nbytes)
+
+
+def hbm_intermediate_by_family() -> dict:
+    """Inter-stage stack bytes per program family (a copy)."""
+    with _HBM_I_LOCK:
+        return dict(_HBM_I)
 
 
 def _family_of(key, label: str) -> Tuple[str, str]:
@@ -380,6 +425,7 @@ def catalog() -> list:
     with _LEDGER_LOCK:
         records = list(_LEDGER.values())
     h2d = h2d_by_family()
+    hbm_i = hbm_intermediate_by_family()
     out = []
     for rec in records:
         with rec.lock:
@@ -436,6 +482,15 @@ def catalog() -> list:
         # staged H2D bytes attributed to this family (note_h2d): the
         # front-half "what does this program cost the PCIe link" column
         entry["h2d_bytes"] = h2d.get(rec.family)
+        # inter-stage stack traffic (ISSUE 17): a stamp on the program
+        # wins (the builder's analytic per-call figure); otherwise the
+        # note_hbm_intermediate family bucket (measured counters, e.g.
+        # the serving round trip) — ~0 / absent on the fused pipeline
+        entry["hbm_intermediate_bytes"] = (
+            rec.hbm_intermediate
+            if rec.hbm_intermediate is not None
+            else hbm_i.get(rec.family)
+        )
         out.append(entry)
     out.sort(key=lambda e: -(e["compile_s"] or 0.0))
     return out
@@ -765,6 +820,8 @@ def _on_reset() -> None:
         _LEDGER.clear()
     with _H2D_LOCK:
         _H2D.clear()
+    with _HBM_I_LOCK:
+        _HBM_I.clear()
     with _STATE_LOCK:
         _LAST_CAPTURE_T = None
         _STALL_PHASE, _STALL_TICKS = None, 0

@@ -396,7 +396,7 @@ def print_program_summary(programs: List[dict], top: int = 10) -> None:
     print(
         f"  {'family':<14} {'key':<12} {'lost_s':>8} {'compile_s':>9} "
         f"{'flops':>9} {'bytes':>9} {'vmem':>8} {'h2d':>9} "
-        f"{'exec_ms':>8} {'roofline':>8}"
+        f"{'hbm_i':>8} {'exec_ms':>8} {'roofline':>8}"
     )
     for entry in programs[:top]:
         exec_s = entry.get("exec_mean_s")
@@ -411,6 +411,10 @@ def print_program_summary(programs: List[dict], top: int = 10) -> None:
             f"{_fmt_quantity(entry.get('bytes_accessed'), 2**20, 'M'):>9} "
             f"{_fmt_quantity(entry.get('vmem_bytes'), 2**20, 'M'):>8} "
             f"{_fmt_quantity(entry.get('h2d_bytes'), 2**20, 'M'):>9} "
+            # inter-stage stack traffic (ISSUE 17): the separate-programs
+            # legs' gathered/weighted stack bytes; ~0/- for the fused
+            # pipeline — the fusion's prize, in bytes
+            f"{_fmt_quantity(entry.get('hbm_intermediate_bytes'), 2**20, 'M'):>8} "
             f"{exec_s * 1e3 if exec_s else 0.0:>8.2f} "
             f"{(f'{util:.1%}' if util is not None else '-'):>8}"
         )

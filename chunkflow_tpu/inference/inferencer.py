@@ -228,13 +228,16 @@ class Inferencer:
         the default device leg) are part of the key, so flipping either
         env mid-stream builds the right program instead of reusing a
         stale one — the same re-read-per-chunk convention as
-        ``CHUNKFLOW_MESH``."""
-        from chunkflow_tpu.ops.blend import kernel_tag
+        ``CHUNKFLOW_MESH``. ``CHUNKFLOW_FUSED_PIPELINE`` joins too
+        (ops/blend.pipeline_key): the pipeline forces both kernel legs,
+        so a user already running PALLAS=interpret + GATHER=interpret
+        would otherwise flip the pipeline without changing the key."""
+        from chunkflow_tpu.ops.blend import kernel_tag, pipeline_key
         from chunkflow_tpu.ops.pallas_gather import gather_key
 
         tag = kernel_tag()
         base = ("scatter",) if tag == "scatter" else ("scatter_fused", tag)
-        return base + gather_key()
+        return base + gather_key() + pipeline_key()
 
     @property
     def _program(self):

@@ -17,15 +17,26 @@ trade is made:
   their matmuls/convs natively narrow, and float32-dtype engines still
   see bfloat16-rounded values (the quantization-error model the test
   suite bounds). The result is cast back to float32.
-- ``int8``: symmetric fake quantization (round-to-nearest-even onto a
-  255-level [-127, 127] grid) of the patch batch and every
-  floating-point parameter leaf, computed in float32 — the standard W8A8
-  simulation. Parameters quantize per-tensor; activations quantize
-  PER-ROW (one scale per patch), which keeps quantization independent of
+- ``int8``: W8A8 in two legs behind ``CHUNKFLOW_INT8`` (ISSUE 17).
+  ``fake`` (the default — the reference/kill-switch leg): symmetric fake
+  quantization (round-to-nearest-even onto a 255-level [-127, 127]
+  grid) of the patch batch and every floating-point parameter leaf at
+  the engine boundary, computed in float32 — the standard W8A8
+  simulation running f32 matmuls. ``real``: the engine's jaxpr is
+  re-evaluated with every ``dot_general``/``conv_general_dilated``
+  replaced by a REAL integer MXU op — int8 operands,
+  ``preferred_element_type=jnp.int32`` accumulation — with weights
+  quantized per-tensor and activations per-row at each matmul, then
+  dequantized ``prod_f32 * (s_act * s_w)``. ``fakeint`` is the real
+  leg's f32 twin (same interpreter, same integer-grid operands, f32
+  arithmetic): where the integer dot's accumulator sums stay below
+  2^24 the f32 products are exact, so ``real`` and ``fakeint`` agree
+  BITWISE — the agreement oracle tests/inference/test_precision.py
+  pins on the identity and small-conv engines. In every leg parameters
+  quantize per-tensor and activations PER-ROW (one scale per
+  leading-axis/batch entry), which keeps quantization independent of
   batch composition — the property the packed-serve and mesh bitwise
-  parity contracts rest on. Real int8 matmul kernels are an engine-level
-  concern; this wrapper is supported wherever the engine's parameters
-  are ordinary float arrays, which is every in-repo engine.
+  parity contracts rest on.
 
 What precision does NOT touch: the blend. Accumulation and weight
 buffers stay float32 (``ops/blend.py``), ``normalize_blend``'s uint8
@@ -53,7 +64,7 @@ from typing import Callable, Optional
 
 from chunkflow_tpu.core import envmode
 
-__all__ = ["PRECISIONS", "resolve_precision", "wrap_apply"]
+__all__ = ["PRECISIONS", "resolve_precision", "wrap_apply", "int8_mode"]
 
 PRECISIONS = ("float32", "bfloat16", "int8")
 
@@ -141,6 +152,244 @@ def _quant_float_leaves(tree):
     return jax.tree_util.tree_map(quant, tree)
 
 
+_INT8_CHOICES = {
+    "fake": ("", "fake", "0", "off"),
+    "real": ("real", "1", "on"),
+    "fakeint": ("fakeint",),
+}
+_INT8_WARNED: set = set()
+
+
+def int8_mode() -> str:
+    """'fake' | 'real' | 'fakeint' — the ``CHUNKFLOW_INT8`` leg of the
+    int8 precision (resolved at :func:`wrap_apply` time, i.e. once per
+    Inferencer, like ``CHUNKFLOW_PRECISION`` itself — a per-chunk
+    re-read would retrace every program on a flip). ``fake`` is the
+    measured default (boundary fake-quant, f32 matmuls — the
+    reference/kill-switch leg); ``real`` runs integer-accumulating MXU
+    matmuls (``preferred_element_type=jnp.int32``); ``fakeint`` is the
+    real leg's exact-f32 twin for the bitwise agreement oracle."""
+    return envmode.resolve(
+        "CHUNKFLOW_INT8", _INT8_CHOICES, default="fake",
+        note="running the fake-quant reference leg — a typo must not "
+             "silently select the real integer matmul path",
+        warned=_INT8_WARNED,
+    )
+
+
+def _quant_rows_axis(x, axis: int):
+    """Integer grid + scale for a tainted (activation) operand: one
+    scale per index along ``axis``, reduced over every other axis —
+    the same 255-level grid expression as :func:`_fake_quant_int8`
+    (identical rounding, identical eps floor), factored so the real
+    and fake legs quantize onto IDENTICAL integer values. Returns
+    ``(q, scale)`` with ``q`` float32-valued integers in [-127, 127]
+    and ``scale`` keeping ``keepdims`` shape."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    if axes:
+        amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    else:
+        amax = jnp.abs(x)
+    scale = jnp.maximum(amax, jnp.float32(1e-12)) / jnp.float32(127.0)
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0)
+    return q, scale
+
+
+def _quant_tensor(x):
+    """Per-tensor integer grid + scalar scale (the weight-side rule)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x))
+    scale = jnp.maximum(amax, jnp.float32(1e-12)) / jnp.float32(127.0)
+    q = jnp.clip(jnp.round(x / scale), -127.0, 127.0)
+    return q, scale
+
+
+def _scale_to_out(scale, out_ndim: int, out_axis: int):
+    """Reshape a per-row scale (keepdims shape) to broadcast along the
+    output's ``out_axis``; scalars pass through."""
+    import jax.numpy as jnp
+
+    s = jnp.asarray(scale)
+    if s.size == 1:
+        return s.reshape(())
+    shape = [1] * out_ndim
+    shape[out_axis] = s.size
+    return s.reshape(shape)
+
+
+def _int8_dot(params, lhs, rhs, lhs_tainted, rhs_tainted, integer):
+    """One ``dot_general`` at W8A8: tainted (activation) operands
+    quantize per-row over their leading axis when it is a free
+    (non-contracting, non-batch) dim — the batch-composition-safe rule
+    — otherwise per-tensor; untainted (weight) operands per-tensor.
+    ``integer=True`` runs int8 operands with int32 accumulation (the
+    real MXU op); ``integer=False`` is the exact-f32 twin on the same
+    integer grid. Dequant is ``prod_f32 * (s_lhs * s_rhs)`` — one
+    expression, one order, so the two legs agree bitwise wherever the
+    integer sums stay below 2^24 (exact in f32)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = params["dimension_numbers"]
+    (lc, rc), (lb, rb) = dn
+    free_l = sorted(set(range(jnp.ndim(lhs))) - set(lc) - set(lb))
+    free_r = sorted(set(range(jnp.ndim(rhs))) - set(rc) - set(rb))
+
+    def quant(x, tainted, free):
+        if tainted and jnp.ndim(x) > 1 and 0 in free:
+            return _quant_rows_axis(x, 0)
+        return _quant_tensor(x)
+
+    ql, sl = quant(lhs, lhs_tainted, free_l)
+    qr, sr = quant(rhs, rhs_tainted, free_r)
+    if integer:
+        prod = lax.dot_general(
+            ql.astype(jnp.int8), qr.astype(jnp.int8),
+            dimension_numbers=dn,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+    else:
+        prod = lax.dot_general(
+            ql, qr, dimension_numbers=dn,
+            preferred_element_type=jnp.float32,
+        )
+    # output layout: batch dims, then lhs free dims, then rhs free dims
+    sl_b = _scale_to_out(sl, prod.ndim,
+                         len(lb) + (free_l.index(0) if 0 in free_l else 0))
+    sr_b = _scale_to_out(
+        sr, prod.ndim,
+        len(lb) + len(free_l) + (free_r.index(0) if 0 in free_r else 0))
+    return prod * (sl_b * sr_b)
+
+
+def _int8_conv(params, lhs, rhs, lhs_tainted, integer):
+    """One ``conv_general_dilated`` at W8A8: the image (lhs) quantizes
+    per-row over its batch axis (``dimension_numbers.lhs_spec[0]``)
+    when tainted, the kernel (rhs) per-tensor; same integer/f32-twin
+    and dequant contract as :func:`_int8_dot`."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    dn = params["dimension_numbers"]
+    if lhs_tainted:
+        ql, sl = _quant_rows_axis(lhs, dn.lhs_spec[0])
+    else:
+        ql, sl = _quant_tensor(lhs)
+    qr, sr = _quant_tensor(rhs)
+    kwargs = dict(
+        window_strides=params["window_strides"],
+        padding=params["padding"],
+        lhs_dilation=params["lhs_dilation"],
+        rhs_dilation=params["rhs_dilation"],
+        dimension_numbers=dn,
+        feature_group_count=params["feature_group_count"],
+        batch_group_count=params.get("batch_group_count", 1),
+    )
+    if integer:
+        prod = lax.conv_general_dilated(
+            ql.astype(jnp.int8), qr.astype(jnp.int8),
+            preferred_element_type=jnp.int32, **kwargs,
+        ).astype(jnp.float32)
+    else:
+        prod = lax.conv_general_dilated(
+            ql, qr, preferred_element_type=jnp.float32, **kwargs,
+        )
+    sl_b = _scale_to_out(sl, prod.ndim, dn.out_spec[0])
+    return prod * (sl_b * sr)
+
+
+def _eval_int8_jaxpr(jaxpr, consts, in_pairs, integer, Literal):
+    """Evaluate a jaxpr with every matmul/conv touched by activation
+    data replaced by its W8A8 form. ``in_pairs`` is ``[(value, taint)]``
+    per invar; taint marks values derived from the patch batch (the
+    activations) — untainted values are parameters and their derived
+    tensors (the weights). Every other primitive binds unchanged (f32
+    math on the dequantized values, exactly like the fake leg's body).
+    ``pjit`` and ``custom_jvp/vjp`` bodies are evaluated recursively so
+    matmuls inside jitted/custom-gradient engine blocks are still
+    intercepted; other higher-order primitives (scan, while) bind
+    as-is — none of the in-repo engines put matmuls inside them."""
+    env = {}
+
+    def read(v):
+        if isinstance(v, Literal):
+            return v.val, False
+        return env[v]
+
+    for var, val in zip(jaxpr.constvars, consts):
+        env[var] = (val, False)
+    for var, pair in zip(jaxpr.invars, in_pairs):
+        env[var] = pair
+
+    for eqn in jaxpr.eqns:
+        pairs = [read(v) for v in eqn.invars]
+        vals = [p[0] for p in pairs]
+        taints = [p[1] for p in pairs]
+        out_taint = any(taints)
+        name = eqn.primitive.name
+        if name == "dot_general" and out_taint:
+            outs = [_int8_dot(eqn.params, vals[0], vals[1],
+                              taints[0], taints[1], integer)]
+        elif name == "conv_general_dilated" and out_taint:
+            outs = [_int8_conv(eqn.params, vals[0], vals[1],
+                               taints[0], integer)]
+        elif name == "pjit" and out_taint:
+            inner = eqn.params["jaxpr"]
+            results = _eval_int8_jaxpr(inner.jaxpr, inner.consts,
+                                       pairs, integer, Literal)
+            outs = [val for val, _ in results]
+        elif (name in ("custom_jvp_call", "custom_vjp_call")
+              and out_taint
+              and "call_jaxpr" in eqn.params
+              and len(eqn.params["call_jaxpr"].jaxpr.invars)
+              == len(pairs)):
+            inner = eqn.params["call_jaxpr"]
+            results = _eval_int8_jaxpr(inner.jaxpr, inner.consts,
+                                       pairs, integer, Literal)
+            outs = [val for val, _ in results]
+        else:
+            subfuns, bind_params = eqn.primitive.get_bind_params(
+                eqn.params)
+            result = eqn.primitive.bind(*subfuns, *vals, **bind_params)
+            outs = (list(result) if eqn.primitive.multiple_results
+                    else [result])
+        for var, out in zip(eqn.outvars, outs):
+            env[var] = (out, out_taint)
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _int8_graph_apply(apply: Callable, params, batch, integer: bool):
+    """The real-int8 forward: trace ``apply`` to a jaxpr, then replay
+    it with activation-touched matmuls in W8A8 (``integer=True`` for
+    int32-accumulating int8 ops, ``False`` for the exact-f32 twin).
+    Runs under the caller's jit — the integer ops land in the outer
+    program's jaxpr, where the test suite probes
+    ``preferred_element_type=int32``."""
+    import jax
+
+    try:
+        from jax.extend.core import Literal
+    except ImportError:  # older jax layouts
+        from jax.core import Literal
+
+    closed, out_shape = jax.make_jaxpr(apply, return_shape=True)(
+        params, batch)
+    n_params = len(jax.tree_util.tree_leaves(params))
+    flat = jax.tree_util.tree_leaves((params, batch))
+    pairs = [(v, i >= n_params) for i, v in enumerate(flat)]
+    out_pairs = _eval_int8_jaxpr(closed.jaxpr, closed.consts, pairs,
+                                 integer, Literal)
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(out_shape),
+        [val for val, _ in out_pairs])
+
+
 def wrap_apply(apply: Callable, precision: str) -> Callable:
     """Wrap an engine ``apply(params, batch)`` for the given precision.
     ``float32`` returns ``apply`` ITSELF (same object — the bitwise
@@ -159,12 +408,24 @@ def wrap_apply(apply: Callable, precision: str) -> Callable:
 
         return bf16_apply
     if precision == "int8":
-        def int8_apply(params, batch):
+        mode = int8_mode()  # resolved once, at wrap time
+        if mode == "fake":
+            def int8_apply(params, batch):
+                import jax.numpy as jnp
+
+                p = _quant_float_leaves(params)
+                out = apply(p, _fake_quant_int8(batch, per_row=True))
+                return jnp.asarray(out, jnp.float32)
+
+            return int8_apply
+
+        integer = mode == "real"
+
+        def int8_real_apply(params, batch):
             import jax.numpy as jnp
 
-            p = _quant_float_leaves(params)
-            out = apply(p, _fake_quant_int8(batch, per_row=True))
+            out = _int8_graph_apply(apply, params, batch, integer)
             return jnp.asarray(out, jnp.float32)
 
-        return int8_apply
+        return int8_real_apply
     raise ValueError(f"unknown precision {precision!r}")

@@ -46,6 +46,114 @@ def stacked_scatter_enabled() -> bool:
     )
 
 
+_PIPELINE_CHOICES = {
+    "off": ("", "0", "off", "false", "no"),
+    "on": ("1", "on", "true", "force"),
+    "interpret": ("interpret",),
+}
+_PIPELINE_WARNED: set = set()
+
+
+def fused_pipeline_mode() -> str:
+    """'off' | 'on' | 'interpret' — the ``CHUNKFLOW_FUSED_PIPELINE``
+    knob (ISSUE 17): one device pipeline for the whole per-bucket patch
+    step. The mode does not select a new mega-kernel; it FORCES the two
+    proven kernel legs on at once — the Pallas gather front
+    (``ops/pallas_gather.py``, ISSUE 15) and the fused bump-weighted
+    accumulate (``ops/pallas_blend.py``, ISSUE 14) — and moves the
+    serving packer's weighted-prediction stack device-resident
+    (serve/packer.py), so the gathered-patch stack, the f32 activation
+    stack and the weighted-prediction stack never round-trip HBM/host
+    between stages. ``on`` compiles both Mosaic kernels (hardware);
+    ``interpret`` runs them under the Pallas interpreter (+kernelcheck)
+    on CPU — it IS the parity leg, not a throughput proxy. Default OFF
+    per the measured-winner rule (docs/performance.md): the pending
+    on-chip row is ``tools/tpu_validation.py bench_fused_pipeline``;
+    the CPU structure gate is ``bench.py fused_pipeline``.
+
+    Resolution shares :func:`core.envmode.resolve` (warn-once; a typo
+    must not force-select Mosaic kernels on a CPU box)."""
+    from chunkflow_tpu.core import envmode
+
+    return envmode.resolve(
+        "CHUNKFLOW_FUSED_PIPELINE", _PIPELINE_CHOICES, default="off",
+        note="treating it as OFF — the separately-selected gather/"
+             "forward/blend programs run, not the fused patch pipeline",
+        warned=_PIPELINE_WARNED,
+    )
+
+
+def pipeline_tag() -> str:
+    """The fused-pipeline selection as a ProgramCache key component:
+    ``""`` when off (keeps every historical key string byte-identical),
+    else ``"pipe-on"`` / ``"pipe-interpret[+kc]"``. Joined — via
+    :func:`pipeline_key` — into every program family the pipeline
+    restructures (the per-chunk scatter program, all four serving
+    programs, the sharded-engine programs), so a mid-stream
+    ``CHUNKFLOW_FUSED_PIPELINE`` flip rebuilds instead of reusing a
+    stale structure. The interpret tag carries the kernelcheck ``+kc``
+    suffix while the sanitizer is live (its hooks are program
+    identity), same convention as :func:`kernel_tag`."""
+    mode = fused_pipeline_mode()
+    if mode == "off":
+        return ""
+    if mode == "interpret":
+        from chunkflow_tpu.testing import kernelcheck
+
+        return f"pipe-interpret{kernelcheck.key_suffix()}"
+    return f"pipe-{mode}"
+
+
+def pipeline_key() -> tuple:
+    """``()`` when the fused pipeline is off, else ``(pipeline_tag(),)``
+    — the tuple callers concatenate onto ProgramCache keys (the same
+    no-suffix-for-the-default convention as ``gather_key()``)."""
+    tag = pipeline_tag()
+    return (tag,) if tag else ()
+
+
+def pipeline_kernel_cost(B: int, ci: int, co: int, pin, pout,
+                         dtype="uint8") -> dict:
+    """Analytic cost of one fused-pipeline patch step over a batch of
+    ``B`` patches — the builders' own arithmetic composed
+    (``pallas_gather.gather_kernel_cost`` +
+    ``pallas_blend.fused_kernel_cost``), for ``profiling.stamp_cost``,
+    ``tools/kernel_report.py`` and the ``bench.py fused_pipeline``
+    stamps. The kernels run as sequential stages of one program, so
+    VMEM is the max stage footprint, not the sum; ``bytes_accessed`` is
+    the traffic the pipeline fundamentally moves (gather reads + the
+    aligned-window RMW).
+
+    ``hbm_intermediate_bytes`` is the inter-stage stack traffic the
+    SEPARATE-programs composition pays and the pipeline does not: the
+    gathered f32 patch stack and the weighted f32 prediction stack each
+    written by one program and re-read by the next (x2 per stack). The
+    fused pipeline's figure for the same workload is ~0 — patches and
+    predictions stream through VMEM/registers between stages
+    (docs/performance.md "The fused patch pipeline").
+    """
+    from chunkflow_tpu.ops import pallas_blend, pallas_gather
+
+    pin = tuple(pin)
+    pout = tuple(pout)
+    gather = pallas_gather.gather_kernel_cost(B, ci, pin, dtype)
+    blend = pallas_blend.fused_kernel_cost(B, co, pout)
+    patch_stack_f32 = B * ci * pin[0] * pin[1] * pin[2] * 4
+    pred_stack_f32 = B * co * pout[0] * pout[1] * pout[2] * 4
+    return {
+        "grid_steps": gather["grid_steps"] + blend["grid_steps"],
+        "vmem_bytes": max(gather["vmem_bytes"], blend["vmem_bytes"]),
+        "bytes_per_step": max(gather["bytes_per_step"],
+                              blend["bytes_per_step"]),
+        "bytes_accessed": gather["bytes_accessed"]
+        + blend["bytes_accessed"],
+        "flops": gather["flops"] + blend["flops"],
+        # write + read of each inter-stage stack the separate-programs
+        # composition materializes (the fusion's prize; ~0 fused)
+        "hbm_intermediate_bytes": 2 * (patch_stack_f32 + pred_stack_f32),
+    }
+
+
 def kernel_tag() -> str:
     """The selected accumulation kernel as a ProgramCache key component:
     ``"scatter"`` (the XLA default) or ``"fused-on"`` /
@@ -201,7 +309,10 @@ def build_local_blend(
     # predicted stack size so jumbo chunks (e.g. 108x2048x2048 production
     # tasks) cannot OOM HBM even when opted in.
     stack_max_bytes = stack_budget_bytes()
-    use_stacked = stacked_scatter_enabled()
+    # the fused pipeline's whole point is that no whole-chunk prediction
+    # stack exists between stages, so the stacked experiment cannot
+    # compose with it — pipeline mode wins over CHUNKFLOW_BLEND_STACKED
+    use_stacked = stacked_scatter_enabled() and fused_pipeline_mode() == "off"
 
     # Per-patch f32 bytes the stacked path keeps alive: the raw
     # prediction stack, plus (XLA leg only) the weighted copy and the

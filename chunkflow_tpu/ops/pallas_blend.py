@@ -75,7 +75,19 @@ def pallas_mode() -> str:
     (core/envmode.py holds the shared contract): a mistyped opt-in
     (``CHUNKFLOW_PALLAS=ture``) must not silently run the slow path
     either.
+
+    ``CHUNKFLOW_FUSED_PIPELINE`` (ops/blend.py, ISSUE 17) outranks this
+    knob: the fused patch pipeline IS the Pallas blend leg plus the
+    Pallas gather leg composed, so pipeline 'on'/'interpret' force the
+    matching mode here regardless of CHUNKFLOW_PALLAS — one knob flips
+    the whole pipeline consistently instead of asking users to keep
+    three envs in sync.
     """
+    from chunkflow_tpu.ops import blend
+
+    pipe = blend.fused_pipeline_mode()
+    if pipe != "off":
+        return "interpret" if pipe == "interpret" else "on"
     return envmode.resolve(
         "CHUNKFLOW_PALLAS", _MODE_CHOICES, default="off",
         note="treating it as OFF — the XLA scatter path runs, not the "

@@ -81,7 +81,18 @@ def gather_mode() -> str:
     ``CHUNKFLOW_GATHER`` (re-read per call so tests and long-lived
     workers can flip it; the cache-key tag makes the flip rebuild).
     Unrecognized values warn once and fall to the device leg
-    (core/envmode.py holds the shared warn-once contract)."""
+    (core/envmode.py holds the shared warn-once contract).
+
+    ``CHUNKFLOW_FUSED_PIPELINE`` (ops/blend.py, ISSUE 17) outranks this
+    knob: the fused patch pipeline gathers through the Pallas leg by
+    definition, so pipeline 'on'/'interpret' force the matching mode
+    here regardless of CHUNKFLOW_GATHER — one knob flips the whole
+    pipeline consistently."""
+    from chunkflow_tpu.ops import blend
+
+    pipe = blend.fused_pipeline_mode()
+    if pipe != "off":
+        return "interpret" if pipe == "interpret" else "pallas"
     return envmode.resolve(
         "CHUNKFLOW_GATHER", _MODE_CHOICES, default="device",
         note="using the default device-resident XLA gather — not the "

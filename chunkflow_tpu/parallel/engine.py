@@ -677,7 +677,12 @@ class ShardedEngine:
             # call (GL005): donate it into the program
             return jax.jit(sharded, donate_argnums=(0,))
 
-        return self.programs.get(("serve_forward", n_chips), build)
+        from chunkflow_tpu.ops.blend import pipeline_key
+
+        # pipeline-independent math, but the tag joins anyway (the
+        # every-serving-key convention — see serve/packer.py)
+        return self.programs.get(
+            ("serve_forward", n_chips) + pipeline_key(), build)
 
     # ------------------------------------------------------------------
     def _spatial_geometry(self, y: int, x: int):
@@ -720,15 +725,16 @@ class ShardedEngine:
     def _run_local(self, arr, grid: PatchGrid, params):
         import jax.numpy as jnp
 
-        from chunkflow_tpu.ops.blend import kernel_tag
+        from chunkflow_tpu.ops.blend import kernel_tag, pipeline_key
         from chunkflow_tpu.ops.pallas_gather import gather_key
 
-        # the accumulation-kernel AND gather-front selections are part
-        # of the program key (the CHUNKFLOW_PALLAS / CHUNKFLOW_GATHER
-        # flip convention; no suffix for the defaults keeps the
-        # historical key strings)
+        # the accumulation-kernel, gather-front AND fused-pipeline
+        # selections are part of the program key (the CHUNKFLOW_PALLAS /
+        # CHUNKFLOW_GATHER / CHUNKFLOW_FUSED_PIPELINE flip convention;
+        # no suffix for the defaults keeps the historical key strings)
         tag = kernel_tag()
-        kernel_key = (() if tag == "scatter" else (tag,)) + gather_key()
+        kernel_key = ((() if tag == "scatter" else (tag,)) + gather_key()
+                      + pipeline_key())
         B = self.batch_size
         chunk_shape = tuple(arr.shape)
         if self.spec.kind == "data":

@@ -368,8 +368,22 @@ class PatchPacker:
                 for s in in_starts[:req.n]
             ]
         # padding rows stay exact zeros: bitwise what the fused program's
-        # validity-0 entries contribute to the scatter-add
-        req.weighted = np.zeros((req.n_pad, co) + pout, dtype=np.float32)
+        # validity-0 entries contribute to the scatter-add. Under the
+        # fused pipeline (ops/blend.fused_pipeline_mode, ISSUE 17) a
+        # device-front request keeps this stack DEVICE-resident: forward
+        # rows overlay it in place (_overlay_program) and the scatter
+        # program consumes it directly, so the weighted stack never
+        # crosses the PCIe link between forward and blend. The
+        # separate-programs leg's D2H+H2D round trip of the same stack
+        # is scored as hbm_intermediate bytes (core/profiling.py).
+        from chunkflow_tpu.ops import blend as blend_ops
+
+        if device_front and blend_ops.fused_pipeline_mode() != "off":
+            req.weighted = jnp.zeros((req.n_pad, co) + pout,
+                                     dtype=jnp.float32)
+        else:
+            req.weighted = np.zeros((req.n_pad, co) + pout,
+                                    dtype=np.float32)
         req.remaining = req.n
 
     # -- dispatcher -----------------------------------------------------
@@ -429,7 +443,13 @@ class PatchPacker:
             # call (GL005): donate it into the program
             return jax.jit(program, donate_argnums=(0,))
 
-        return inf._programs.get(("serve_forward",), build)
+        from chunkflow_tpu.ops.blend import pipeline_key
+
+        # the forward math itself is pipeline-independent, but the tag
+        # joins anyway (the every-key convention): a flip must never
+        # leave ANY serving program keyed as if nothing changed
+        return inf._programs.get(("serve_forward",) + pipeline_key(),
+                                 build)
 
     def _gather_program(self):
         """The device-front batch assembler: gathers one packed batch's
@@ -459,9 +479,42 @@ class PatchPacker:
             # resident chunk is NOT donated — later batches gather from it
             return jax.jit(program, donate_argnums=(3,))
 
+        from chunkflow_tpu.ops.blend import pipeline_key
         from chunkflow_tpu.ops.pallas_gather import gather_key
 
-        return inf._programs.get(("serve_gather",) + gather_key(), build)
+        return inf._programs.get(
+            ("serve_gather",) + gather_key() + pipeline_key(), build)
+
+    def _overlay_program(self):
+        """The fused-pipeline row writeback: scatters one packed batch's
+        forward rows into ONE request's DEVICE-resident weighted stack
+        (``weighted.at[idx].set(rows)``), so the stack never rides
+        D2H+H2D between the forward and the blend. Rows this request
+        does not own carry an out-of-bounds index (the ``n_pad``
+        sentinel) and are dropped by the scatter's default FILL_OR_DROP
+        mode; owned indices are unique and SET (not added), so every
+        row keeps its exact bits — including signed zeros — which is
+        what keeps packed fused-pipeline output bitwise equal to the
+        round-trip leg. Keyed by the pipeline selection so a
+        ``CHUNKFLOW_FUSED_PIPELINE`` flip rebuilds; jit handles
+        (n_pad, slots) shape polymorphism."""
+        inf = self.inferencer
+
+        def build():
+            import jax
+
+            def program(weighted, rows, idx):
+                return weighted.at[idx].set(rows)
+
+            # the stack is packer-owned and replaced in place across
+            # batches (GL005): donate it into each overlay. ``rows`` is
+            # NOT donated — one batch may overlay several requests.
+            return jax.jit(program, donate_argnums=(0,))
+
+        from chunkflow_tpu.ops.blend import pipeline_key
+
+        return inf._programs.get(("serve_overlay",) + pipeline_key(),
+                                 build)
 
     def _scatter_program(self, run_zyx, n_pad):
         inf = self.inferencer
@@ -518,12 +571,12 @@ class PatchPacker:
             # after the call (GL005): donate it
             return jax.jit(program, donate_argnums=(0,))
 
-        from chunkflow_tpu.ops.blend import kernel_tag
+        from chunkflow_tpu.ops.blend import kernel_tag, pipeline_key
 
         tag = kernel_tag()
         key = (("serve_scatter", tuple(run_zyx)) if tag == "scatter"
                else ("serve_scatter_fused", tuple(run_zyx), tag))
-        return inf._programs.get(key, build)
+        return inf._programs.get(key + pipeline_key(), build)
 
     def _loop(self) -> None:
         while True:
@@ -629,17 +682,46 @@ class PatchPacker:
 
         program = (engine.serve_forward_program() if engine is not None
                    else self._forward_program())
+        host_stack_rows = sum(
+            isinstance(req.weighted, np.ndarray) for req, _, _ in live
+        )
         with telemetry.span("serving/forward", occupancy=round(occupancy, 3)):
             out = program(
                 batch_dev, jnp.asarray(valid_np),
                 inf._device_params,
             )
-            out_np = np.asarray(out)
+            # the separate-programs leg materializes the forward rows on
+            # the host (the inter-stage weighted-stack round trip the
+            # fused pipeline deletes); fused-pipeline requests keep
+            # everything on device and skip the D2H entirely
+            out_np = np.asarray(out) if host_stack_rows else None
+
+        if host_stack_rows:
+            row_bytes = int(np.prod(out.shape[1:])) * out.dtype.itemsize
+            profiling.note_hbm_intermediate(
+                host_stack_rows * row_bytes, key=("serve_forward",))
+
+        # fused-pipeline requests: overlay forward rows onto each
+        # request's DEVICE-resident weighted stack in place
+        dev_stack: dict = {}
+        for row, (req, idx, _) in enumerate(live):
+            if not isinstance(req.weighted, np.ndarray):
+                dev_stack.setdefault(id(req), (req, []))[1].append(
+                    (row, idx))
+        for req, pairs in dev_stack.values():
+            idx_np = np.full((slots,), req.n_pad, dtype=np.int32)
+            for row, idx in pairs:
+                idx_np[row] = idx
+            overlay = self._overlay_program()
+            with req.lock:
+                req.weighted = overlay(req.weighted, out,
+                                       jnp.asarray(idx_np))
 
         done = []
         for row, (req, idx, _) in enumerate(live):
             with req.lock:
-                req.weighted[idx] = out_np[row]
+                if isinstance(req.weighted, np.ndarray):
+                    req.weighted[idx] = out_np[row]
                 if req.patches is not None:
                     req.patches[idx] = None  # free the gathered input early
                 req.remaining -= 1
@@ -663,6 +745,14 @@ class PatchPacker:
             req.handle._fail(RequestExpired("deadline passed at finalize"))
             return
         program = self._scatter_program(req.run_zyx, req.n_pad)
+        if isinstance(req.weighted, np.ndarray):
+            # the separate-programs leg re-uploads the stack the forward
+            # just downloaded — the second half of the inter-stage round
+            # trip the fused pipeline deletes (~0 bytes on that leg)
+            from chunkflow_tpu.core import profiling
+
+            profiling.note_hbm_intermediate(
+                req.weighted.nbytes, key=("serve_scatter",))
         with telemetry.span("serving/scatter"):
             result = program(
                 jnp.asarray(req.weighted), jnp.asarray(req.valid),

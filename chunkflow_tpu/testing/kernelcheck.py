@@ -58,7 +58,8 @@ from typing import Dict, List, Sequence, Tuple
 __all__ = [
     "KernelCheckError", "enabled", "active", "key_suffix",
     "check_bounds", "check_result", "poison_scratch", "observe_grid",
-    "arm_grid_trace", "report", "reset_state", "publish",
+    "arm_grid_trace", "grid_trace_armed", "report", "reset_state",
+    "publish",
 ]
 
 _OFF_VALUES = ("", "0", "off", "false", "no")
@@ -154,10 +155,24 @@ def arm_grid_trace(label: str) -> None:
     """Start recording the grid walk for ``label``. Only armed labels
     record (and are verified by :func:`check_result`); arming is for
     tests that drive ONE kernel invocation at a time — interleaved
-    invocations (a batch scan) would mix their walks."""
+    invocations (a batch scan) would mix their walks.
+
+    Arm BEFORE the kernel invocation is traced: :func:`observe_grid`
+    checks the armed set at TRACE time, so an unarmed build carries no
+    per-step callback at all (a program traced unarmed records nothing
+    even if armed later — the dedicated kernelcheck tests drive
+    un-jitted invocations, which re-trace per call, so arm-then-invoke
+    does the right thing)."""
     with _registry._lock:
         _registry.armed.add(label)
         _registry.grid_traces.pop(label, None)
+
+
+def grid_trace_armed(label: str) -> bool:
+    """Whether ``label``'s grid walk is being recorded (see
+    :func:`arm_grid_trace`)."""
+    with _registry._lock:
+        return label in _registry.armed
 
 
 def report() -> dict:
@@ -289,9 +304,17 @@ def poison_scratch(ref) -> None:
 def observe_grid(label: str, idx) -> None:
     """Record one grid step's patch index for the RMW-order verifier
     (best-effort: interpret mode executes callbacks synchronously in
-    grid order; :func:`check_result` consumes and clears the trace)."""
+    grid order; :func:`check_result` consumes and clears the trace).
+
+    Gated on :func:`grid_trace_armed` at TRACE time: the per-step
+    ``jax.debug.callback`` is the sanitizer's dominant interpret-mode
+    cost, and only the dedicated kernelcheck tests (which arm first)
+    consume the walk — every other interpret run skips the callback
+    entirely (ISSUE 17's kernelcheck_overhead trim)."""
     import jax
 
+    if not grid_trace_armed(label):
+        return
     jax.debug.callback(_record_visit, idx, label=label)
 
 

@@ -227,6 +227,21 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py front_half --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "front half gate"
 
+# Fused patch pipeline (ISSUE 17): the per-bucket serving structure with
+# device-resident weighted stacks (one upload, donated on-device overlay,
+# one scatter) vs the host round-trip structure it replaced (per-batch
+# download, host stack, wholesale re-upload), as compiled proxies of both
+# structures (docs/performance.md "The fused patch pipeline"). The run
+# asserts bit-identity across both proxies AND the composed real Pallas
+# kernels (gather -> forward -> fused blend) in interpret mode, and that
+# both legs carry roofline rows in programs.json; reports the >=1.2x
+# target as gate_pass (asserted slow-marked in tests/test_bench.py); the
+# process only fails below 1.1x.
+echo "== fused pipeline gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py fused_pipeline --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "fused pipeline gate"
+
 # --- bench regression ledger ------------------------------------------------
 # Every gate above appended its measurement (commit-stamped) to
 # telemetry/bench_ledger.jsonl; compare diffs this run against the

@@ -1,0 +1,258 @@
+"""The fused patch pipeline's contracts (CHUNKFLOW_FUSED_PIPELINE,
+ISSUE 17): the f32 output of the one-program pipeline (interpret leg —
+Pallas gather front + fused Pallas blend + device-resident serving
+stacks, under the kernelcheck sanitizer) is BITWISE identical to the
+default separate-programs path across plain/ragged/uint8/crop-margin
+traffic, every mesh shape, and packed serve; the knob outranks the
+per-leg selectors; the pipeline tag keys every restructured program
+family; and the analytic pipeline cost composes the builders' own
+arithmetic (docs/performance.md "The fused patch pipeline")."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from chunkflow_tpu.chunk.base import Chunk
+from chunkflow_tpu.inference import engines
+from chunkflow_tpu.inference.inferencer import Inferencer
+from chunkflow_tpu.ops import blend
+
+PIN = (4, 16, 16)
+OVERLAP = (2, 8, 8)
+
+
+@pytest.fixture(scope="module")
+def id_engine():
+    return engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=PIN,
+        num_input_channels=1, num_output_channels=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def crop_engine():
+    return engines.create_identity_engine(
+        input_patch_size=PIN, output_patch_size=(2, 8, 8),
+        num_input_channels=1, num_output_channels=3,
+    )
+
+
+def _inferencer(engine, crop=False, **kw):
+    if crop:
+        return Inferencer(
+            input_patch_size=PIN,
+            output_patch_size=(2, 8, 8),
+            output_patch_overlap=(1, 4, 4),
+            num_output_channels=3,
+            framework="prebuilt",
+            batch_size=2,
+            engine=engine,
+            crop_output_margin=True,
+            **kw,
+        )
+    return Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=OVERLAP,
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=engine,
+        crop_output_margin=False,
+        **kw,
+    )
+
+
+def _traffic(kind: str):
+    rng = np.random.default_rng(17)
+    if kind == "ragged":
+        # non-divisible extents: edge snapping, batch padding rows
+        return Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    if kind == "uint8":
+        # raw integer chunk: the pipeline's gather front converts
+        # in-kernel by 1/iinfo.max (IEEE-exact)
+        return Chunk(
+            (rng.random((8, 40, 48)) * 255).astype(np.uint8))
+    return Chunk(rng.random((8, 40, 48)).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + key structure
+# ---------------------------------------------------------------------------
+def test_pipeline_mode_off_is_invisible(monkeypatch):
+    """Default OFF keeps every historical cache key byte-identical:
+    empty tag, empty key tuple."""
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    assert blend.fused_pipeline_mode() == "off"
+    assert blend.pipeline_tag() == ""
+    assert blend.pipeline_key() == ()
+
+
+def test_pipeline_mode_tags(monkeypatch):
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "on")
+    assert blend.fused_pipeline_mode() == "on"
+    assert blend.pipeline_key() == ("pipe-on",)
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    monkeypatch.delenv("CHUNKFLOW_KERNELCHECK", raising=False)
+    assert blend.pipeline_key() == ("pipe-interpret",)
+    monkeypatch.setenv("CHUNKFLOW_KERNELCHECK", "1")
+    # the sanitizer's hooks are program identity on interpret legs
+    assert blend.pipeline_key() == ("pipe-interpret+kc",)
+
+
+def test_pipeline_typo_warns_once_and_stays_off(monkeypatch, capsys):
+    """A mistyped opt-in must not force-select Mosaic kernels on a CPU
+    box: warn once on stderr, resolve OFF."""
+    monkeypatch.setattr(blend, "_PIPELINE_WARNED", set())
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpert")
+    assert blend.fused_pipeline_mode() == "off"
+    err = capsys.readouterr().err
+    assert "interpert" in err
+    assert blend.fused_pipeline_mode() == "off"
+    assert capsys.readouterr().err == ""
+
+
+def test_pipeline_outranks_per_leg_knobs(monkeypatch):
+    """One knob flips the whole pipeline consistently: with the
+    pipeline live, the gather and blend selectors report the pipeline's
+    leg regardless of their own envs — a half-fused program (Pallas
+    gather feeding an XLA scatter it was never measured against) must
+    be unconstructible."""
+    from chunkflow_tpu.ops import pallas_blend, pallas_gather
+
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "off")
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    assert pallas_gather.gather_mode() == "interpret"
+    assert pallas_blend.pallas_mode() == "interpret"
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "on")
+    assert pallas_gather.gather_mode() == "pallas"
+    assert pallas_blend.pallas_mode() == "on"
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    assert pallas_gather.gather_mode() == "host"
+    assert pallas_blend.pallas_mode() == "off"
+
+
+def test_pipeline_kernel_cost_composes_the_builders(monkeypatch):
+    """The analytic pipeline cost is the two stage models composed:
+    VMEM is the max stage footprint (sequential stages of ONE program),
+    traffic and FLOPs sum, and hbm_intermediate_bytes is write+read of
+    both inter-stage stacks — the exact bytes the separate-programs
+    composition pays and the pipeline deletes."""
+    from chunkflow_tpu.ops import pallas_blend, pallas_gather
+
+    B, ci, co, pin, pout = 8, 1, 3, (4, 32, 64), (4, 32, 64)
+    gather = pallas_gather.gather_kernel_cost(B, ci, pin, "uint8")
+    fused = pallas_blend.fused_kernel_cost(B, co, pout)
+    pipe = blend.pipeline_kernel_cost(B, ci, co, pin, pout, "uint8")
+    assert pipe["vmem_bytes"] == max(gather["vmem_bytes"],
+                                     fused["vmem_bytes"])
+    assert pipe["flops"] == gather["flops"] + fused["flops"]
+    assert pipe["bytes_accessed"] == (gather["bytes_accessed"]
+                                      + fused["bytes_accessed"])
+    pvox = int(np.prod(pin))
+    assert pipe["hbm_intermediate_bytes"] == 2 * (
+        B * ci * pvox * 4 + B * co * pvox * 4)
+
+
+# ---------------------------------------------------------------------------
+# the f32 bitwise parity matrix
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("traffic", ["plain", "ragged", "uint8"])
+def test_pipeline_parity_single_device(id_engine, traffic, monkeypatch):
+    """interpret pipeline == default separate-programs path, bitwise,
+    on plain/ragged/uint8 traffic (f32 contract: the pipeline is a
+    restructuring, not a re-rounding)."""
+    chunk = _traffic(traffic)
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    ref = np.asarray(_inferencer(id_engine)(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    got = np.asarray(_inferencer(id_engine)(chunk).array)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    assert np.array_equal(got, ref)
+
+
+def test_pipeline_parity_crop_margin(crop_engine, monkeypatch):
+    """Bitwise through the crop-margin path (pout < pin, real margin
+    crop after the blend)."""
+    chunk = _traffic("ragged")
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    ref = np.asarray(_inferencer(crop_engine, crop=True)(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    got = np.asarray(_inferencer(crop_engine, crop=True)(chunk).array)
+    assert np.array_equal(got, ref)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices (tests/conftest.py)")
+@pytest.mark.parametrize("traffic", ["plain", "ragged", "uint8"])
+@pytest.mark.parametrize("mesh", ["data=2", "y=2,x=2"])
+def test_pipeline_parity_mesh(id_engine, mesh, traffic, monkeypatch):
+    """The pipeline composes with the unified mesh engine bitwise: both
+    kernel legs run inside each chip's shard program (the pipeline tag
+    is part of the shard key), and mesh x pipeline equals the plain
+    single-device default on every traffic kind."""
+    chunk = _traffic(traffic)
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    ref = np.asarray(_inferencer(id_engine)(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    got = np.asarray(_inferencer(id_engine, mesh=mesh)(chunk).array)
+    assert np.array_equal(got, ref)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "uint8"])
+def test_pipeline_parity_packed_serve(id_engine, dtype, monkeypatch):
+    """Packed serve with the pipeline live (device-resident weighted
+    stacks, donated overlay writeback) equals the per-chunk DEFAULT
+    path bitwise — the strongest serving contract: restructured
+    batching AND restructured memory residency change nothing."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    rng = np.random.default_rng(3)
+    if dtype == "uint8":
+        chunks = [
+            Chunk((rng.random((4, 16, 48)) * 255).astype(np.uint8),
+                  voxel_offset=(8 * i, 0, 0))
+            for i in range(3)
+        ]
+    else:
+        chunks = [
+            Chunk(rng.random((4, 16, 48), dtype=np.float32),
+                  voxel_offset=(8 * i, 0, 0))
+            for i in range(3)
+        ]
+    monkeypatch.delenv("CHUNKFLOW_FUSED_PIPELINE", raising=False)
+    ref_inf = Inferencer(
+        input_patch_size=PIN, num_output_channels=3,
+        framework="prebuilt", engine=id_engine, batch_size=4,
+        crop_output_margin=False,
+    )
+    refs = [np.asarray(ref_inf(c).array) for c in chunks]
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    inf = Inferencer(
+        input_patch_size=PIN, num_output_channels=3,
+        framework="prebuilt", engine=id_engine, batch_size=4,
+        crop_output_margin=False,
+    )
+    packer = PatchPacker(inf, max_wait_ms=2.0)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=60).array) for h in handles]
+    finally:
+        packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+
+
+def test_pipeline_interpret_runs_sanitized(id_engine, monkeypatch):
+    """The interpret leg IS a kernelcheck run: both kernels record
+    checks and zero violations on clean traffic — every pipeline parity
+    test above doubles as a kernel soundness run (docs/linting.md)."""
+    from chunkflow_tpu.testing import kernelcheck
+
+    monkeypatch.setenv("CHUNKFLOW_KERNELCHECK", "1")
+    monkeypatch.setenv("CHUNKFLOW_FUSED_PIPELINE", "interpret")
+    kernelcheck.reset_state()
+    _inferencer(id_engine)(_traffic("plain"))
+    snap = kernelcheck.report()
+    assert snap["checks"] > 0, snap
+    assert snap["violations"] == [], snap

@@ -261,3 +261,144 @@ def test_precision_composes_with_fused_kernel(id_engine, monkeypatch):
     monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
     got = np.asarray(_inferencer(id_engine, "bfloat16")(chunk).array)
     assert np.array_equal(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# the real int8 leg (CHUNKFLOW_INT8, ISSUE 17)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("engine_kind", ["identity", "conv"])
+def test_int8_real_vs_fakeint_bitwise(id_engine, conv_engine,
+                                      engine_kind, monkeypatch):
+    """The agreement oracle: the real integer-accumulating leg and its
+    exact-f32 twin (``fakeint``) quantize onto IDENTICAL integer grids
+    and dequantize with one shared expression, so their outputs are
+    BITWISE equal wherever the int32 sums stay below 2^24 — true by
+    construction for [0,1) activations at these patch sizes. Any
+    divergence means the two legs' grids or dequant orders drifted."""
+    engine = id_engine if engine_kind == "identity" else conv_engine
+    chunk = _traffic("ragged")
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    real = np.asarray(_inferencer(engine, "int8")(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_INT8", "fakeint")
+    twin = np.asarray(_inferencer(engine, "int8")(chunk).array)
+    assert np.array_equal(real, twin)
+
+
+def test_int8_real_runs_int32_matmuls(conv_engine, monkeypatch):
+    """The real leg is REAL: tracing the wrapped forward shows int8
+    operands feeding ``preferred_element_type=int32`` matmuls (the MXU
+    integer op), while the fake leg's jaxpr carries no int8 compute at
+    all — the acceptance probe that fake-quant emulation did not ship
+    under the ``real`` name."""
+    from chunkflow_tpu.inference import precision as precision_mod
+
+    batch = np.linspace(
+        0.0, 1.0, int(np.prod((2, 1) + PIN)), dtype=np.float32,
+    ).reshape((2, 1) + PIN)
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    monkeypatch.setattr(precision_mod, "_INT8_WARNED", set())
+    wrapped = wrap_apply(conv_engine.apply, "int8")
+    text = str(jax.make_jaxpr(wrapped)(conv_engine.params, batch))
+    assert "preferred_element_type=int32" in text, text[-2000:]
+    assert "i8[" in text, text[-2000:]  # jaxpr spelling of int8 operands
+    monkeypatch.setenv("CHUNKFLOW_INT8", "fake")
+    fake = wrap_apply(conv_engine.apply, "int8")
+    fake_text = str(jax.make_jaxpr(fake)(conv_engine.params, batch))
+    assert "i8[" not in fake_text
+    assert "preferred_element_type=int32" not in fake_text
+
+
+@pytest.mark.parametrize("engine_kind", ["identity", "conv"])
+@pytest.mark.parametrize("traffic", ["plain", "ragged"])
+def test_int8_real_error_bounds(id_engine, conv_engine, engine_kind,
+                                traffic, monkeypatch):
+    """The real integer leg obeys the SAME stated int8 bounds as the
+    fake-quant reference (ISSUE 17 acceptance: real int8 lands inside
+    the established gates, no new error budget). The interception is
+    taint-targeted — matmuls/convs touched by activation data — so the
+    conv engine must actually move (err > 0) while the matmul-free
+    identity engine passes through EXACTLY (the real leg quantizes
+    compute, not boundaries)."""
+    engine = id_engine if engine_kind == "identity" else conv_engine
+    chunk = _traffic(traffic)
+    ref = np.asarray(_inferencer(engine, "float32")(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    got = np.asarray(_inferencer(engine, "int8")(chunk).array)
+    err = np.abs(got.astype(np.float64) - ref.astype(np.float64))
+    scale = max(float(np.abs(ref).max()), 1.0)
+    assert err.max() <= MAX_ABS_ERR["int8"] * scale, err.max()
+    assert err.mean() <= MEAN_ERR["int8"] * scale, err.mean()
+    if engine_kind == "conv":
+        assert err.max() > 0.0
+    else:
+        assert err.max() == 0.0
+
+
+def test_packed_serve_parity_survives_real_int8(id_engine, monkeypatch):
+    """Packed-vs-per-chunk bitwise identity holds with the real integer
+    matmul leg live — the packer inherits the same wrapped forward, so
+    the int8 grid cannot diverge the two serving paths."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    rng = np.random.default_rng(3)
+    chunks = [
+        Chunk(rng.random((4, 16, 48), dtype=np.float32),
+              voxel_offset=(8 * i, 0, 0))
+        for i in range(3)
+    ]
+    inf = Inferencer(
+        input_patch_size=PIN,
+        num_output_channels=3,
+        framework="prebuilt",
+        engine=id_engine,
+        batch_size=4,
+        precision="int8",
+        crop_output_margin=False,
+    )
+    refs = [np.asarray(inf(c).array) for c in chunks]
+    packer = PatchPacker(inf, max_wait_ms=2.0)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=60).array) for h in handles]
+    finally:
+        packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs 8 virtual devices (tests/conftest.py)")
+def test_mesh_parity_survives_real_int8(id_engine, monkeypatch):
+    """Mesh-vs-single bitwise identity holds with the real integer
+    matmul leg live — the sharded engine shards the same wrapped
+    forward."""
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    chunk = _traffic("ragged")
+    ref = np.asarray(_inferencer(id_engine, "int8")(chunk).array)
+    out = np.asarray(
+        _inferencer(id_engine, "int8", mesh="data=2")(chunk).array)
+    assert np.array_equal(out, ref)
+
+
+def test_int8_real_composes_with_kernels_clean(id_engine, monkeypatch):
+    """Real int8 forward + both interpret Pallas kernels + kernelcheck:
+    the composition matches the XLA path bitwise AND the sanitizer
+    records checks but zero violations — the clean pin that the int8
+    rewrite did not perturb the kernels' soundness contracts."""
+    from chunkflow_tpu.testing import kernelcheck
+
+    monkeypatch.setenv("CHUNKFLOW_INT8", "real")
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "0")
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "on")
+    chunk = _traffic("ragged")
+    ref = np.asarray(_inferencer(id_engine, "int8")(chunk).array)
+    monkeypatch.setenv("CHUNKFLOW_KERNELCHECK", "1")
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    monkeypatch.setenv("CHUNKFLOW_GATHER", "interpret")
+    kernelcheck.reset_state()
+    got = np.asarray(_inferencer(id_engine, "int8")(chunk).array)
+    snap = kernelcheck.report()
+    assert np.array_equal(got, ref)
+    assert snap["checks"] > 0, snap
+    assert snap["violations"] == [], snap

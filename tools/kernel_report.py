@@ -76,6 +76,22 @@ def build_report(patch, batch: int, ci: int, co: int,
         "vmem_budget": budget,
         "vmem_frac": cost["vmem_bytes"] / budget,
     })
+    # the composed fused-pipeline step (ISSUE 17): gather + blend as
+    # sequential stages of one program — VMEM is the max stage
+    # footprint; hbm_intermediate is what the SEPARATE-programs
+    # composition would pay in inter-stage stack traffic (~0 fused)
+    from chunkflow_tpu.ops import blend
+
+    for dtype in dtypes:
+        cost = blend.pipeline_kernel_cost(batch, ci, co, patch, patch,
+                                          dtype)
+        rows.append({
+            "kernel": "patch_pipeline",
+            "geometry": f"B={batch} ci={ci} co={co} p={patch} {dtype}",
+            **cost,
+            "vmem_budget": budget,
+            "vmem_frac": cost["vmem_bytes"] / budget,
+        })
     return rows
 
 
@@ -92,6 +108,7 @@ def check_programs(path: str, rows: list) -> list:
     stamped_families = {
         "blend_fused": "fused_accumulate_patches",
         "front_dev": "gather_patches",
+        "pipe_fused": "patch_pipeline",
     }
     for entry in payload.get("programs", []):
         kernel = stamped_families.get(entry.get("family"))
@@ -113,16 +130,21 @@ def print_report(rows: list) -> None:
     print("kernel cost report (analytic — the GL021/stamp_cost model):")
     print(
         f"  {'kernel':<26} {'geometry':<34} {'vmem':>8} {'of budget':>9} "
-        f"{'B/step':>8} {'grid':>6} {'bytes':>9} {'flops':>9}"
+        f"{'B/step':>8} {'grid':>6} {'bytes':>9} {'flops':>9} "
+        f"{'sep hbm_i':>9}"
     )
     for r in rows:
+        # sep hbm_i: the inter-stage stack traffic a SEPARATE-programs
+        # composition of this row's stages would pay ('-' for single
+        # kernels — only the composed pipeline row carries it)
         print(
             f"  {r['kernel']:<26} {r['geometry']:<34} "
             f"{_fmt_bytes(r['vmem_bytes']):>8} {r['vmem_frac']:>9.1%} "
             f"{_fmt_bytes(r['bytes_per_step']):>8} "
             f"{r['grid_steps']:>6} "
             f"{_fmt_bytes(r['bytes_accessed']):>9} "
-            f"{r['flops'] / 1e9:>8.2f}G"
+            f"{r['flops'] / 1e9:>8.2f}G "
+            f"{_fmt_bytes(r.get('hbm_intermediate_bytes')):>9}"
         )
 
 

@@ -523,6 +523,60 @@ def bench_front_half():
     }
 
 
+@step("bench_fused_pipeline")
+def bench_fused_pipeline():
+    """Fused patch pipeline ON-CHIP A/B (ISSUE 17): the whole per-bucket
+    step as one device program chain (CHUNKFLOW_FUSED_PIPELINE=on —
+    device gather + fused blend + device-resident weighted stacks, no
+    host round-trip between stages) against the default separate-stage
+    path, flagship config, both legs in ONE row. On a real tunnel the
+    delta is the inter-stage HBM/PCIe traffic the fusion deletes —
+    profiling's hbm_intermediate_bytes column itemizes it. A CPU-only
+    window records an honest skip — the structural win is gated on CPU
+    by ``bench.py fused_pipeline`` and f32 bit-identity by the fused
+    pipeline parity matrix in tier-1, but neither is an on-chip
+    number."""
+    plat = _platform()
+    if plat not in ("tpu", "axon"):
+        return {
+            "skipped": True,
+            "platform": plat,
+            "note": (
+                "CPU-only window: the fused-pipeline-vs-separate A/B "
+                "needs a chip; bench.py fused_pipeline gates the "
+                "serving structure (device-resident stacks vs host "
+                "round-trip) on CPU and "
+                "tests/inference/test_fused_pipeline.py pins f32 "
+                "bitwise parity in tier-1 — re-run when the tunnel "
+                "has a chip"
+            ),
+        }
+    prev = os.environ.get("CHUNKFLOW_FUSED_PIPELINE")
+    try:
+        os.environ.pop("CHUNKFLOW_FUSED_PIPELINE", None)
+        separate = _bench("0", "tpu", "bfloat16", 4)
+        os.environ["CHUNKFLOW_FUSED_PIPELINE"] = "on"
+        fused = _bench("1", "tpu", "bfloat16", 4)
+    finally:
+        if prev is None:
+            os.environ.pop("CHUNKFLOW_FUSED_PIPELINE", None)
+        else:
+            os.environ["CHUNKFLOW_FUSED_PIPELINE"] = prev
+    speedup = (fused["mvox_s"] / separate["mvox_s"]
+               if separate.get("mvox_s") else None)
+    return {
+        "mvox_s": fused.get("mvox_s"),
+        "separate_mvox_s": separate.get("mvox_s"),
+        "speedup": round(speedup, 3) if speedup else None,
+        "note": (
+            "one fused patch program (CHUNKFLOW_FUSED_PIPELINE=on: "
+            "device gather + fused blend + device-resident weighted "
+            "stacks) vs the default separate-stage path, same flagship "
+            "config, one atomic row"
+        ),
+    }
+
+
 @step("e2e_split")
 def e2e_split():
     """Where does the flagship config's wall time go? Separate H2D,
@@ -1068,6 +1122,9 @@ def main():
              bench_front_half,  # device-vs-host front-half A/B in ONE
              # row (ISSUE 15): the PCIe-bytes measurement; cheap skip
              # on a CPU-only window
+             bench_fused_pipeline,  # fused-vs-separate patch pipeline
+             # A/B in ONE row (ISSUE 17): the inter-stage-HBM
+             # measurement; cheap skip on a CPU-only window
              bench_multichip,  # unified-engine slice row (ISSUE 13):
              # cheap skip on a single-chip tunnel, the first real
              # multi-chip throughput number when a slice window opens
