@@ -65,8 +65,10 @@ from chunkflow_tpu.core import telemetry
 
 __all__ = [
     "instrument_program", "stamp_cost", "catalog", "write_catalog",
-    "device_peaks", "note_h2d", "h2d_by_family",
+    "device_peaks", "estimate_collective_split", "note_h2d",
+    "h2d_by_family",
     "note_hbm_intermediate", "hbm_intermediate_by_family",
+    "note_collective", "collective_by_family",
     "capture", "maybe_capture", "note_retrace", "note_stall",
     "note_slo_page", "start_task_window", "note_task_done",
     "wait_for_captures", "capture_base_dir",
@@ -130,6 +132,32 @@ def device_peaks(device_kind: str) -> dict:
     if env_bw > 0:
         bw, source = env_bw, "env"
     return {"flops_per_s": flops, "bytes_per_s": bw, "source": source}
+
+
+def estimate_collective_split(flops: float, collective_bytes: float,
+                              device_kind: Optional[str] = None) -> dict:
+    """Analytic collective-vs-compute split of one sharded dispatch
+    against the roofline peak table: ``compute_s = flops / peak_flops``
+    and ``collective_s = collective_bytes / peak_bytes`` for the mesh's
+    device kind. The bytes/s figure is the chip's HBM row — a proxy that
+    flatters the interconnect (ICI/DCN are slower than HBM), so the
+    returned ``collective_share`` is a *lower bound* on how
+    communication-dominated the mesh shape is; a shape that already
+    looks collective-bound here is definitely not worth scaling.
+    ``device_kind=None`` probes ``jax.devices()[0]``."""
+    if device_kind is None:
+        _, device_kind = _device_identity()
+    peaks = device_peaks(device_kind)
+    compute_s = max(0.0, float(flops)) / peaks["flops_per_s"]
+    collective_s = max(0.0, float(collective_bytes)) / peaks["bytes_per_s"]
+    total = compute_s + collective_s
+    return {
+        "compute_s": compute_s,
+        "collective_s": collective_s,
+        "collective_share": (collective_s / total) if total > 0 else 0.0,
+        "device_kind": device_kind,
+        "peak_source": peaks["source"],
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -384,6 +412,36 @@ def hbm_intermediate_by_family() -> dict:
         return dict(_HBM_I)
 
 
+_COLLECTIVE_LOCK = threading.Lock()
+_COLLECTIVE: dict = {}  # program family -> analytic collective bytes
+
+
+def note_collective(nbytes, key=None, label: str = "") -> None:
+    """Count ANALYTIC cross-chip collective traffic for one sharded
+    dispatch (ISSUE 18): halo ``ppermute`` exchanges plus the weighted-
+    stack ``all_gather``, computed by the engine from halo widths,
+    shard shapes and dtypes — the same stamped-arithmetic discipline as
+    :func:`stamp_cost`, because XLA's cost analysis does not price
+    inter-chip links. Feeds the ``shard/collective_bytes`` counter and
+    a per-family bucket (the catalog's ``collective_bytes`` column), so
+    the MESH block can show collective-vs-compute per mesh shape.
+    No-op under the telemetry kill switch."""
+    if not telemetry.enabled():
+        return
+    telemetry.inc("shard/collective_bytes", float(nbytes))
+    if key is not None:
+        family, _ = _family_of(key, label)
+        with _COLLECTIVE_LOCK:
+            _COLLECTIVE[family] = _COLLECTIVE.get(family, 0.0) \
+                + float(nbytes)
+
+
+def collective_by_family() -> dict:
+    """Analytic collective bytes per program family (a copy)."""
+    with _COLLECTIVE_LOCK:
+        return dict(_COLLECTIVE)
+
+
 def _family_of(key, label: str) -> Tuple[str, str]:
     """(family, shape-ish remainder) from a ProgramCache key. Keys are
     tuples like ``("scatter",)`` / ``("fold", (8, 32, 32))``; anything
@@ -426,6 +484,7 @@ def catalog() -> list:
         records = list(_LEDGER.values())
     h2d = h2d_by_family()
     hbm_i = hbm_intermediate_by_family()
+    coll = collective_by_family()
     out = []
     for rec in records:
         with rec.lock:
@@ -491,6 +550,10 @@ def catalog() -> list:
             if rec.hbm_intermediate is not None
             else hbm_i.get(rec.family)
         )
+        # analytic cross-chip traffic attributed to this family
+        # (note_collective): the "what does this program cost the
+        # interconnect" column — absent on single-device programs
+        entry["collective_bytes"] = coll.get(rec.family)
         out.append(entry)
     out.sort(key=lambda e: -(e["compile_s"] or 0.0))
     return out
@@ -822,6 +885,8 @@ def _on_reset() -> None:
         _H2D.clear()
     with _HBM_I_LOCK:
         _HBM_I.clear()
+    with _COLLECTIVE_LOCK:
+        _COLLECTIVE.clear()
     with _STATE_LOCK:
         _LAST_CAPTURE_T = None
         _STALL_PHASE, _STALL_TICKS = None, 0

@@ -79,6 +79,7 @@ from __future__ import annotations
 import contextvars
 import json
 import os
+import re
 import socket
 import threading
 import time
@@ -94,7 +95,17 @@ __all__ = [
     "QUANTILE_BOUNDS", "timeseries", "start_timeseries",
     "stop_timeseries", "timeseries_running", "add_tick_hook",
     "remove_tick_hook", "ts_interval", "ts_points",
+    "chip_gauge", "CHIP_METRIC_RE",
 ]
+
+#: Per-chip metric naming convention: ``<plane>/chip/<i>/<metric>``
+#: (``device/chip/0/bytes_in_use``, ``shard/chip/3/voxels``). Every
+#: consumer that wants to fold the chip index back out of the name —
+#: the ``/metrics`` renderer turns it into a ``chip`` label, the
+#: log-summary MESH block groups by it — matches against this one
+#: regex so the convention cannot drift between emitters and readers.
+CHIP_METRIC_RE = re.compile(
+    r"^(?P<plane>[^/]+(?:/[^/]+)*)/chip/(?P<chip>\d+)/(?P<metric>.+)$")
 
 _OFF_VALUES = ("0", "off", "false", "no")
 
@@ -440,6 +451,16 @@ def gauge(name: str, value: float) -> None:
     if _REG.sink is not None:
         _REG.emit(_stamp({"kind": "gauge", "name": name, "t": time.time(),
                           "value": value}))
+
+
+def chip_gauge(plane: str, chip: int, metric: str, value: float) -> None:
+    """Record a per-chip instantaneous level under the
+    ``<plane>/chip/<i>/<metric>`` convention (:data:`CHIP_METRIC_RE`).
+    A thin veneer over :func:`gauge`, so per-chip values get everything
+    plain gauges get — last-value registry entry, occupancy histogram,
+    one JSONL event, and a ``gauge:<name>`` timeseries ring — while
+    keeping the name shape readers can fold into a ``chip`` label."""
+    gauge(f"{plane}/chip/{int(chip)}/{metric}", value)
 
 
 def observe(name: str, value: float) -> None:

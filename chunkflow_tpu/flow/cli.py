@@ -1775,9 +1775,17 @@ def save_precomputed_cmd(op_name, volume_path, mip, upload_log, create_thumbnail
                    "sparkline timelines fleet-merged from the JSONL "
                    "timeseries events (docs/observability.md \"SLO "
                    "view\") — reconstructable after every worker died")
+@click.option("--export-trace", "export_trace", type=str, default=None,
+              metavar="OUT.JSON",
+              help="convert the merged telemetry JSONL into a Chrome/"
+                   "Perfetto trace-event file: workers as processes, "
+                   "spans as slices, gauges/counters as counter tracks, "
+                   "cross-worker task hops as trace_id flow arrows — "
+                   "load it at ui.perfetto.dev (docs/observability.md "
+                   "\"Timeline view\")")
 @cartesian_option("--output-size", default=None)
 def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
-                    slo_view, output_size):
+                    slo_view, export_trace, output_size):
     """Aggregate per-task timing logs and/or telemetry JSONL into a
     throughput + stall-attribution report."""
     from chunkflow_tpu.flow.log_summary import (
@@ -1791,9 +1799,11 @@ def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
         raise click.UsageError(
             "log-summary needs --log-dir and/or --metrics-dir"
         )
-    if (fleet or trace_id or slo_view) and summary_metrics_dir is None:
+    if (fleet or trace_id or slo_view or export_trace) \
+            and summary_metrics_dir is None:
         raise click.UsageError(
-            "log-summary --fleet/--trace-id/--slo needs --metrics-dir"
+            "log-summary --fleet/--trace-id/--slo/--export-trace needs "
+            "--metrics-dir"
         )
 
     @generator
@@ -1807,10 +1817,28 @@ def log_summary_cmd(log_dir, summary_metrics_dir, fleet, trace_id,
         if summary_metrics_dir is not None:
             if fleet or trace_id:
                 print_fleet_summary(summary_metrics_dir, trace_id=trace_id)
-            elif not slo_view:
+            elif not slo_view and not export_trace:
                 print_telemetry_summary(summary_metrics_dir)
             if slo_view:
                 print_slo_summary(summary_metrics_dir)
+            if export_trace:
+                try:
+                    from tools.trace_export import export_metrics_dir
+                except ImportError:
+                    raise click.UsageError(
+                        "--export-trace needs the repo's tools/ package "
+                        "on sys.path (run from the repository root)"
+                    )
+                stats = export_metrics_dir(summary_metrics_dir,
+                                           export_trace)
+                print(
+                    f"exported {stats['trace_events']} trace event(s) "
+                    f"({stats['workers']} worker process(es), "
+                    f"{stats['flow_pairs']} cross-worker flow(s)) to "
+                    f"{export_trace}"
+                )
+                for problem in stats["problems"]:
+                    print(f"trace validation: {problem}")
         return
         yield  # pragma: no cover
 

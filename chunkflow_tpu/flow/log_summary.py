@@ -384,15 +384,23 @@ def _fmt_quantity(value, scale: float, suffix: str) -> str:
     return f"{value / scale:.2f}{suffix}"
 
 
-def print_program_summary(programs: List[dict], top: int = 10) -> None:
+def print_program_summary(programs: List[dict], top: int = 10,
+                          headroom_bytes: Optional[float] = None) -> None:
     """The DEVICE PROGRAMS table: top program families by LOST SECONDS
     ((dispatch_wall − roofline) × calls — the fusion-target ranking),
     with XLA cost analysis and the achieved-vs-roofline figure when the
-    catalog carried one (docs/observability.md "Device program view")."""
+    catalog carried one (docs/observability.md "Device program view").
+    ``headroom_bytes`` (the live ``device/hbm_headroom`` gauge — the
+    worst chip's free HBM) prints next to the table so the ``vmem`` /
+    ``hbm_i`` budget columns read against what is actually left."""
     if not programs:
         return
     print("device programs (top by lost seconds = (dispatch − roofline) "
           "× calls; util is an upper bound under async dispatch):")
+    if headroom_bytes is not None:
+        print(f"  live hbm headroom: {headroom_bytes / 2**20:.1f} MiB "
+              f"(min across chips) — the budget the vmem/hbm_i columns "
+              f"spend from")
     print(
         f"  {'family':<14} {'key':<12} {'lost_s':>8} {'compile_s':>9} "
         f"{'flops':>9} {'bytes':>9} {'vmem':>8} {'h2d':>9} "
@@ -418,6 +426,73 @@ def print_program_summary(programs: List[dict], top: int = 10) -> None:
             f"{exec_s * 1e3 if exec_s else 0.0:>8.2f} "
             f"{(f'{util:.1%}' if util is not None else '-'):>8}"
         )
+
+
+def print_mesh_block(agg: dict, indent: str = "") -> bool:
+    """The MESH block (docs/multichip.md "Reading chip skew",
+    docs/observability.md "Timeline view"): mesh shape, a per-chip table
+    folding the ``shard/chip/<i>/*`` load/readiness gauges with the
+    ``device/chip/<i>/*`` HBM watermarks, the dispatch skew, the
+    analytic halo/gather byte planes, and the collective-vs-compute
+    split estimate — the evidence for choosing a scaling shape. Quiet
+    (returns False) for runs that never built a sharded engine."""
+    from chunkflow_tpu.core import telemetry as _telemetry
+
+    gauges = agg["gauges"]
+    devices = gauges.get("shard/mesh_devices")
+    if not devices or devices.get("last", 0) <= 0:
+        return False
+    # fold <plane>/chip/<i>/<metric> gauges into {chip: {metric: stats}}
+    chips: dict = {}
+    for name, g in gauges.items():
+        m = _telemetry.CHIP_METRIC_RE.match(name)
+        if m and m.group("plane") in ("shard", "device"):
+            chips.setdefault(int(m.group("chip")), {})[
+                m.group("metric")] = g
+    ny = gauges.get("shard/mesh_y", {}).get("last", 1)
+    nx = gauges.get("shard/mesh_x", {}).get("last", 1)
+    shape = (f"y={ny:g},x={nx:g}" if ny > 1 or nx > 1
+             else f"data={devices['last']:g}")
+    chunks = agg["counters"].get("shard/chunks", 0)
+    print(f"{indent}mesh (docs/multichip.md):")
+    print(f"{indent}  shape {shape} ({devices['last']:g} chip(s)), "
+          f"{chunks:g} sharded dispatch(es)")
+    if chips:
+        print(f"{indent}  {'chip':<5} {'voxels':>10} {'ready_s':>10} "
+              f"{'hbm_mib':>9} {'headroom_mib':>13}")
+        for chip in sorted(chips):
+            metrics = chips[chip]
+            vox = metrics.get("voxels")
+            ready = metrics.get("ready_s")
+            hbm = metrics.get("bytes_in_use")
+            head = metrics.get("hbm_headroom")
+            vox_s = f"{vox['last']:g}" if vox else "-"
+            ready_s = f"{ready['last']:.6f}" if ready else "-"
+            hbm_s = f"{hbm['last'] / 2**20:.1f}" if hbm else "-"
+            head_s = f"{head['last'] / 2**20:.1f}" if head else "-"
+            print(f"{indent}  {chip:<5} {vox_s:>10} {ready_s:>10} "
+                  f"{hbm_s:>9} {head_s:>13}")
+    skew = gauges.get("shard/chip_skew_s")
+    if skew:
+        print(f"{indent}  chip skew (last ready − first ready): last "
+              f"{skew['last']:.6f}s mean {skew['mean']:.6f}s")
+    halo = agg["counters"].get("shard/halo_bytes", 0)
+    gather = agg["counters"].get("shard/gather_bytes", 0)
+    if halo or gather:
+        print(f"{indent}  analytic collective traffic: halo "
+              f"{halo / 2**20:.2f} MiB, gather {gather / 2**20:.2f} MiB "
+              f"(cumulative)")
+    share = gauges.get("shard/collective_share_est")
+    if share:
+        compute = gauges.get("shard/compute_s_est", {}).get("last", 0.0)
+        coll = gauges.get("shard/collective_s_est", {}).get("last", 0.0)
+        verdict = ("collective-bound" if share["last"] > 0.5
+                   else "compute-bound")
+        print(f"{indent}  split estimate per dispatch: compute "
+              f"{compute:.6f}s vs collective {coll:.6f}s "
+              f"(share {share['last']:.0%} — {verdict}; HBM-bandwidth "
+              f"proxy, a lower bound on interconnect pressure)")
+    return True
 
 
 def print_profile_summaries(metrics_dir: str, top: int = 3) -> None:
@@ -859,20 +934,30 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
             f"program cache: {builds or 0:g} build(s), {hits or 0:g} "
             f"hit(s)"
         )
-    print_program_summary(agg.get("programs") or [])
+    print_program_summary(
+        agg.get("programs") or [],
+        headroom_bytes=(agg["gauges"].get("device/hbm_headroom")
+                        or {}).get("last"),
+    )
     if agg["counters"].get("compile_cache/retrace_warnings"):
         print(
             f"RETRACE WARNINGS: "
             f"{agg['counters']['compile_cache/retrace_warnings']:g} "
             f"(builds exceeded the expected bucket count)"
         )
+    print_mesh_block(agg)
     if agg["gauges"].get("device/bytes_in_use"):
         mem = agg["gauges"]["device/bytes_in_use"]
         peak = agg["gauges"].get("device/peak_bytes", {})
-        print(
+        head = agg["gauges"].get("device/hbm_headroom")
+        line = (
             f"device memory: {mem['last'] / 2**20:.1f} MiB in use (last), "
             f"peak {peak.get('last', 0) / 2**20:.1f} MiB"
         )
+        if head:
+            line += (f", headroom {head['last'] / 2**20:.1f} MiB "
+                     f"(worst chip)")
+        print(line)
     if agg["spans"]:
         print(f"  {'span':<28} {'count':>7} {'total_s':>9} {'mean_s':>9}")
         for name in sorted(agg["spans"]):
@@ -946,16 +1031,62 @@ def summarize_fleet(events: List[dict]) -> dict:
     return fleet
 
 
+def worker_clock_offsets(events: List[dict]) -> dict:
+    """Per-worker clock corrections (seconds to ADD to that worker's
+    ``t`` stamps) from the queue send/receive pairs in a merged stream.
+
+    Two workers' ``time.time()`` bases can disagree, which makes a
+    cross-worker hop appear to be claimed *before* it was submitted —
+    and a trace flow that ends before it starts. But causality gives us
+    a bound per pair: for every ``queue/submit`` (submitter's clock) and
+    ``lifecycle/claimed`` (claimer's clock) sharing a ``trace_id``, the
+    claim physically happened after the submit. Whenever a claim's raw
+    stamp lands *earlier* than its submit, the gap is pure skew, and the
+    claimer's clock gets shifted forward by the largest such gap
+    observed (the minimal correction that makes every pair monotone;
+    workers with no evidence of skew keep offset 0). The submitter's
+    clock is the reference — offsets are never negative."""
+    submits: dict = {}  # trace_id -> (worker, t) of the FIRST submit
+    for record in events:
+        if record.get("name") == "queue/submit" and record.get("trace_id"):
+            submits.setdefault(
+                record["trace_id"],
+                (_event_worker(record), float(record.get("t", 0.0))),
+            )
+    offsets: dict = {}
+    for record in events:
+        if record.get("name") != "lifecycle/claimed":
+            continue
+        sub = submits.get(record.get("trace_id"))
+        if sub is None:
+            continue
+        sub_worker, sub_t = sub
+        claimer = _event_worker(record)
+        if claimer == sub_worker:
+            continue  # same clock: the pair carries no skew information
+        lag = sub_t - float(record.get("t", 0.0))
+        if lag > 0:
+            offsets[claimer] = max(offsets.get(claimer, 0.0), lag)
+    return offsets
+
+
 def trace_timeline(events: List[dict], trace_id: str) -> List[dict]:
     """Every event stamped with ``trace_id`` (plus the queue/submit
     event that minted it), in time order — one task's full history
     across submit, claim(s), retry/requeue hops between workers, and
-    commit or dead-letter, reconstructed from merged JSONL alone."""
+    commit or dead-letter, reconstructed from merged JSONL alone.
+    Ordering uses skew-normalized stamps (:func:`worker_clock_offsets`
+    over the WHOLE stream, so every hop pair contributes evidence): a
+    claimer whose clock runs behind its submitter no longer sorts the
+    claim before the submit."""
+    offsets = worker_clock_offsets(events)
     hits = [
         record for record in events
         if record.get("trace_id") == trace_id
     ]
-    hits.sort(key=lambda record: record.get("t", 0.0))
+    hits.sort(key=lambda record: (
+        record.get("t", 0.0) + offsets.get(_event_worker(record), 0.0)
+    ))
     return hits
 
 

@@ -444,46 +444,111 @@ def _chunk_nbytes(chunk) -> int:
 
 
 # ---------------------------------------------------------------------------
-# device-memory gauges (sampled at drain time)
+# device-memory gauges: the HBM watermark plane (sampled at drain time)
 # ---------------------------------------------------------------------------
-_DEVICE_MEM_UNSUPPORTED = False
+# A failed probe (no jax, or no local device reported memory_stats())
+# used to latch the plane off for the process lifetime — one transient
+# hiccup and device memory went dark forever (ISSUE 18 satellite).
+# Instead the probe now backs off: after a failure the next
+# ``_DEVICE_MEM_SKIPS_LEFT`` drains are free no-ops, then it re-probes,
+# doubling the skip window per consecutive failure up to
+# ``CHUNKFLOW_DEVICE_MEM_REPROBE`` drains (default 64) — a CPU backend
+# pays a cheap probe every ~64 tasks, a TPU whose runtime stuttered once
+# recovers within a few drains. Mutated without a lock on purpose: the
+# worst race outcome is one extra (idempotent) probe, and the existing
+# flag has always been lock-free.
+_DEVICE_MEM_UNSUPPORTED = False   # currently backing off
+_DEVICE_MEM_SKIPS_LEFT = 0        # drains to skip before the next re-probe
+_DEVICE_MEM_FAILURES = 0          # consecutive failed probes
+
+
+def _device_mem_reprobe_cap() -> int:
+    raw = os.environ.get("CHUNKFLOW_DEVICE_MEM_REPROBE", "")
+    try:
+        return max(1, int(raw)) if raw else 64
+    except ValueError:
+        return 64
+
+
+def _note_device_mem_failure() -> None:
+    global _DEVICE_MEM_UNSUPPORTED, _DEVICE_MEM_SKIPS_LEFT, \
+        _DEVICE_MEM_FAILURES
+    _DEVICE_MEM_FAILURES += 1
+    _DEVICE_MEM_SKIPS_LEFT = min(
+        8 * (2 ** (_DEVICE_MEM_FAILURES - 1)), _device_mem_reprobe_cap()
+    )
+    _DEVICE_MEM_UNSUPPORTED = True
 
 
 def sample_device_memory() -> None:
-    """Fold ``jax.Device.memory_stats()`` into ``device/bytes_in_use`` /
-    ``device/peak_bytes`` gauges (summed over local devices), sampled at
-    task drain time so memory pressure shows up in ``/metrics`` and
-    ``log-summary`` next to the scheduler's host watermark. Backends
-    without memory stats (the CPU simulator) mark themselves
-    unsupported after the first probe and the call becomes a no-op."""
-    global _DEVICE_MEM_UNSUPPORTED
-    if _DEVICE_MEM_UNSUPPORTED or not telemetry.enabled():
+    """Fold per-chip ``jax.Device.memory_stats()`` into the HBM
+    watermark plane, sampled at task drain time so memory pressure shows
+    up in ``/metrics`` and ``log-summary`` next to the scheduler's host
+    watermark:
+
+    - ``device/chip/<i>/bytes_in_use`` / ``device/chip/<i>/peak_bytes``
+      per reporting chip (rendered with a ``chip`` label on /metrics and
+      sparklined by the timeseries ring — gauges ride the sampler for
+      free);
+    - ``device/chip/<i>/hbm_headroom`` = ``bytes_limit − bytes_in_use``
+      when the backend reports a limit;
+    - the historical ``device/bytes_in_use`` / ``device/peak_bytes``
+      aggregates (summed over reporting chips), plus
+      ``device/hbm_headroom`` — the WORST chip's headroom, the number
+      that says how close the next allocation is to an OOM.
+
+    Chips that fail to report are skipped (partial results stand);
+    a probe where NO chip reports backs off per the module note above
+    instead of latching the plane off forever."""
+    global _DEVICE_MEM_UNSUPPORTED, _DEVICE_MEM_SKIPS_LEFT, \
+        _DEVICE_MEM_FAILURES
+    if not telemetry.enabled():
         return
+    if _DEVICE_MEM_UNSUPPORTED:
+        if _DEVICE_MEM_SKIPS_LEFT > 0:
+            _DEVICE_MEM_SKIPS_LEFT -= 1
+            return
+        # skip window drained: fall through and re-probe
     try:
         import jax
 
         devices = jax.local_devices()
     except Exception:
-        _DEVICE_MEM_UNSUPPORTED = True
+        _note_device_mem_failure()
         return
-    in_use = peak = 0
+    in_use_total = peak_total = 0
+    headrooms = []
     sampled = False
-    for device in devices:
+    for i, device in enumerate(devices):
         try:
             stats = device.memory_stats()
         except Exception:
             stats = None
         if not stats:
-            continue
+            continue  # partial results: the other chips still report
         sampled = True
-        in_use += int(stats.get("bytes_in_use", 0) or 0)
-        peak += int(stats.get("peak_bytes_in_use",
-                              stats.get("bytes_in_use", 0)) or 0)
+        in_use = int(stats.get("bytes_in_use", 0) or 0)
+        peak = int(stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0)) or 0)
+        in_use_total += in_use
+        peak_total += peak
+        telemetry.chip_gauge("device", i, "bytes_in_use", in_use)
+        telemetry.chip_gauge("device", i, "peak_bytes", peak)
+        limit = int(stats.get("bytes_limit", 0) or 0)
+        if limit > 0:
+            headroom = max(0, limit - in_use)
+            headrooms.append(headroom)
+            telemetry.chip_gauge("device", i, "hbm_headroom", headroom)
     if not sampled:
-        _DEVICE_MEM_UNSUPPORTED = True
+        _note_device_mem_failure()
         return
-    telemetry.gauge("device/bytes_in_use", in_use)
-    telemetry.gauge("device/peak_bytes", peak)
+    _DEVICE_MEM_UNSUPPORTED = False
+    _DEVICE_MEM_FAILURES = 0
+    _DEVICE_MEM_SKIPS_LEFT = 0
+    telemetry.gauge("device/bytes_in_use", in_use_total)
+    telemetry.gauge("device/peak_bytes", peak_total)
+    if headrooms:
+        telemetry.gauge("device/hbm_headroom", min(headrooms))
 
 
 # ---------------------------------------------------------------------------

@@ -52,6 +52,32 @@ Telemetry (host-side only, GL007): ``shard/mesh_devices`` /
 mesh around every sharded dispatch (the collective span — under async
 dispatch it measures enqueue, not device wall; docs/multichip.md).
 
+Per-chip attribution (ISSUE 18, docs/observability.md "Timeline view"):
+
+* ``shard/chip/<i>/voxels`` — output voxels each chip actually computed
+  this dispatch (its share of valid patches × output-patch voxels), the
+  load-balance gauge for a mesh shape;
+* a sampled readiness probe (first dispatch, then every
+  ``CHUNKFLOW_CHIP_PROBE_EVERY``-th, default 8) blocks on each output
+  shard in device order and records ``shard/chip/<i>/ready_s`` plus the
+  headline ``shard/chip_skew_s`` (last ready − first ready). Per-chip
+  ready stamps are probe-ordered lower bounds — chip ``i+1``'s wait
+  overlaps chip ``i``'s — but the skew survives that caveat: it is
+  exactly the straggler wall the probe observed;
+* analytic collective byte counters, stamped from halo widths / shard
+  shapes / dtypes the way ``profiling.stamp_cost`` stamps HBM bytes
+  (XLA's cost analysis does not price inter-chip links):
+  ``shard/halo_bytes`` (``ppermute`` halo exchange, spatial kinds),
+  ``shard/gather_bytes`` (the weighted-stack ``all_gather``), both also
+  folded per program family via ``profiling.note_collective``; and the
+  derived ``shard/compute_s_est`` / ``shard/collective_s_est`` /
+  ``shard/collective_share_est`` split per mesh shape
+  (``profiling.estimate_collective_split`` against the roofline peaks).
+
+Everything above is gated on the telemetry kill switch: under
+``CHUNKFLOW_TELEMETRY=0`` no gauge, counter, or readiness probe exists
+(the probe would otherwise cost a sampled device sync).
+
 Multi-process runtimes: the ``data`` kind keeps the cross-host global-
 array recipe (``multihost.run_global``: psum program + consistency
 guard) on backends whose collectives span processes; on backends that
@@ -65,12 +91,13 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core import profiling, telemetry
 from chunkflow_tpu.core.compile_cache import ProgramCache
 from chunkflow_tpu.inference.patching import (
     PatchGrid,
@@ -217,6 +244,15 @@ def _pad_chunk(arr, padded_y: int, padded_x: int):
     return jnp.pad(arr, pad)
 
 
+def _program_flops(program):
+    """The dispatch's cost-analysis FLOPs, read back from the profiling
+    ledger record the ProgramCache wrapper attached (None when telemetry
+    is off, the program is uninstrumented, or XLA exposed no figure) —
+    the compute side of the collective-vs-compute split."""
+    rec = getattr(program, "_rec", None)
+    return getattr(rec, "flops", None)
+
+
 class _Partition(NamedTuple):
     """Host-side patch partition for one (grid, mesh) pair."""
 
@@ -317,6 +353,7 @@ class ShardedEngine:
         )
         self._devices = devices
         self._mesh = None
+        self._dispatches = 0  # readiness-probe sampling clock
 
     # ------------------------------------------------------------------
     @classmethod
@@ -693,7 +730,8 @@ class ShardedEngine:
         gx = axis_geometry(x, nx, pin[2], pout[2])
         return gy, gx
 
-    def _gauges(self, arr_shape, per_chip_voxels: int) -> None:
+    def _gauges(self, arr_shape, per_chip_voxels: int,
+                chip_patches=None) -> None:
         spec = self.spec
         telemetry.gauge("shard/mesh_devices", float(spec.n_devices))
         if spec.kind == "data":
@@ -703,7 +741,81 @@ class ShardedEngine:
             telemetry.gauge("shard/mesh_y", float(spec.shape[0]))
             telemetry.gauge("shard/mesh_x", float(spec.shape[1]))
         telemetry.gauge("shard/per_chip_voxels", float(per_chip_voxels))
+        if chip_patches is not None:
+            # per-chip OUTPUT voxels actually computed this dispatch:
+            # that chip's share of valid patches × output-patch voxels —
+            # the load-balance signal per mesh shape (padding rows carry
+            # valid 0 and so contribute nothing)
+            pvox = float(np.prod(self.output_patch_size))
+            for i, npatches in enumerate(chip_patches):
+                telemetry.chip_gauge("shard", i, "voxels",
+                                     float(npatches) * pvox)
         telemetry.inc("shard/chunks")
+
+    def _note_collectives(self, key, halo_bytes: float,
+                          gather_bytes: float, flops=None) -> None:
+        """Stamp this dispatch's analytic cross-chip traffic (see module
+        docstring): counters + per-family ledger bucket + the derived
+        collective-vs-compute split gauges. ``flops`` is the program's
+        cost-analysis figure when the ledger has one — without it the
+        split is meaningless and only the byte planes are emitted."""
+        if not telemetry.enabled():
+            return
+        if halo_bytes > 0:
+            telemetry.inc("shard/halo_bytes", float(halo_bytes))
+            telemetry.gauge("shard/halo_bytes_per_chunk",
+                            float(halo_bytes))
+        if gather_bytes > 0:
+            telemetry.inc("shard/gather_bytes", float(gather_bytes))
+            telemetry.gauge("shard/gather_bytes_per_chunk",
+                            float(gather_bytes))
+        total = float(halo_bytes) + float(gather_bytes)
+        if total > 0:
+            profiling.note_collective(total, key=key, label="sharded")
+        if flops:
+            split = profiling.estimate_collective_split(flops, total)
+            telemetry.gauge("shard/compute_s_est", split["compute_s"])
+            telemetry.gauge("shard/collective_s_est",
+                            split["collective_s"])
+            telemetry.gauge("shard/collective_share_est",
+                            split["collective_share"])
+
+    def _chip_probe_every(self) -> int:
+        raw = os.environ.get("CHUNKFLOW_CHIP_PROBE_EVERY", "")
+        try:
+            return max(1, int(raw)) if raw else 8
+        except ValueError:
+            return 8
+
+    def _probe_chip_readiness(self, result) -> None:
+        """Sampled per-chip readiness probe: block on each output shard
+        in device order, recording cumulative wall until that chip's
+        buffer is ready. Runs on the first dispatch and then every
+        ``CHUNKFLOW_CHIP_PROBE_EVERY``-th (default 8) — the probe syncs
+        the device, so sampling keeps it off the steady-state dispatch
+        path. Never under the telemetry kill switch."""
+        n = self._dispatches
+        self._dispatches = n + 1
+        if not telemetry.enabled() or n % self._chip_probe_every():
+            return
+        try:
+            shards = sorted(result.addressable_shards,
+                            key=lambda s: getattr(s.device, "id", 0))
+        except Exception:
+            return
+        if not shards:
+            return
+        t0 = time.perf_counter()
+        readies = []
+        for shard in shards:
+            try:
+                shard.data.block_until_ready()
+            except Exception:
+                return
+            readies.append(time.perf_counter() - t0)
+        for i, ready_s in enumerate(readies):
+            telemetry.chip_gauge("shard", i, "ready_s", ready_s)
+        telemetry.gauge("shard/chip_skew_s", readies[-1] - readies[0])
 
     # ------------------------------------------------------------------
     def run(self, arr, grid: PatchGrid, params, host_params=None):
@@ -742,22 +854,38 @@ class ShardedEngine:
             in_starts, out_starts, valid = pad_to_batch(grid, B * n_dev)
             n_pad_g = len(valid)
             n_ref = grid.num_patches + (-grid.num_patches % B)
+            program_key = ("shard", "data", n_dev, chunk_shape, n_pad_g) \
+                + kernel_key
             program = self.programs.get(
-                ("shard", "data", n_dev, chunk_shape, n_pad_g)
-                + kernel_key,
+                program_key,
                 lambda: self._build_data_program(chunk_shape, n_pad_g,
                                                  n_ref),
             )
-            self._gauges(chunk_shape, int(np.prod(chunk_shape[1:])))
+            self._gauges(
+                chunk_shape, int(np.prod(chunk_shape[1:])),
+                chip_patches=np.asarray(valid).reshape(n_dev, -1)
+                .sum(axis=1),
+            )
             with telemetry.span("shard/dispatch",
                                 mesh=self.spec.describe()):
-                return program(
+                result = program(
                     arr,
                     jnp.asarray(in_starts),
                     jnp.asarray(out_starts),
                     jnp.asarray(valid),
                     params,
                 )
+            # weighted-prediction stack all_gather: each chip's
+            # [rows, co, *pout] float32 shard reaches the n-1 others
+            rows = n_pad_g // n_dev
+            shard_bytes = (rows * self.num_output_channels
+                           * int(np.prod(self.output_patch_size)) * 4)
+            self._note_collectives(
+                program_key, 0.0, float(n_dev * (n_dev - 1) * shard_bytes),
+                flops=_program_flops(program),
+            )
+            self._probe_chip_readiness(result)
+            return result
 
         # spatial kinds: shard the chunk itself
         ny, nx = self.spec.shape
@@ -769,14 +897,19 @@ class ShardedEngine:
         )
         arr = _pad_chunk(arr, padded_y, padded_x)
         padded_shape = tuple(arr.shape)
+        program_key = ("shard", "spatial", (ny, nx), padded_shape,
+                       part.per_dev, len(part.valid)) + kernel_key
         program = self.programs.get(
-            ("shard", "spatial", (ny, nx), padded_shape, part.per_dev,
-             len(part.valid)) + kernel_key,
+            program_key,
             lambda: self._build_spatial_program(
                 padded_shape, geometry, part.per_dev, len(part.valid)
             ),
         )
-        self._gauges(chunk_shape, int(c * z * yslab * xslab))
+        self._gauges(
+            chunk_shape, int(c * z * yslab * xslab),
+            chip_patches=np.asarray(part.dev_valid).sum(axis=2)
+            .reshape(-1),
+        )
         with telemetry.span("shard/dispatch", mesh=self.spec.describe()):
             result = program(
                 arr,
@@ -787,6 +920,25 @@ class ShardedEngine:
                 jnp.asarray(part.valid),
                 params,
             )
+        # halo ppermute traffic: every chip exchanges its float32 halo
+        # rows/columns with neighbours (y at slab width, x at the
+        # y-extended height); plus the weighted-stack all_gather
+        n_chips = ny * nx
+        (_, hl_y2, hr_y2, _), (_, hl_x2, hr_x2, _) = geometry
+        halo_bytes = 0.0
+        if ny > 1:
+            halo_bytes += n_chips * c * z * (hl_y2 + hr_y2) * xslab * 4
+        if nx > 1:
+            halo_bytes += (n_chips * c * z * (yslab + hl_y2 + hr_y2)
+                           * (hl_x2 + hr_x2) * 4)
+        shard_bytes = (part.per_dev * self.num_output_channels
+                       * int(np.prod(self.output_patch_size)) * 4)
+        self._note_collectives(
+            program_key, halo_bytes,
+            float(n_chips * (n_chips - 1) * shard_bytes),
+            flops=_program_flops(program),
+        )
+        self._probe_chip_readiness(result)
         return result[:, :, :y, :x]
 
     # ------------------------------------------------------------------
@@ -834,8 +986,20 @@ class ShardedEngine:
                     out_dtype=self.out_dtype,
                 ),
             )
-            self._gauges(tuple(arr.shape),
-                         int(np.prod(tuple(arr.shape)[1:])))
+            n_glob = mesh.devices.size
+            self._gauges(
+                tuple(arr.shape), int(np.prod(tuple(arr.shape)[1:])),
+                chip_patches=np.asarray(valid).reshape(n_glob, -1)
+                .sum(axis=1),
+            )
+            # the cross-host recipe psums partial float32 blend buffers:
+            # a ring all-reduce moves ~2(n−1) output-buffer copies
+            out_bytes = (self.num_output_channels
+                         * int(np.prod(tuple(arr.shape)[1:])) * 4)
+            self._note_collectives(
+                ("shard", "global"), 0.0,
+                float(2 * (n_glob - 1) * out_bytes),
+            )
             with telemetry.span("shard/dispatch", mesh="global"):
                 out = multihost.run_global(
                     program, np.asarray(arr), in_starts, out_starts,

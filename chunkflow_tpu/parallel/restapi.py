@@ -74,27 +74,52 @@ def render_prometheus(snap: Optional[dict] = None,
     as gauges, histograms as ``summary`` count/sum pairs, plus derived
     per-phase stall-share gauges and the dominant share — the exact
     signal the future autoscaling supervisor polls. Every sample carries
-    a ``worker`` label so a fleet scrape stays attributable."""
+    a ``worker`` label so a fleet scrape stays attributable; per-chip
+    metrics (``<plane>/chip/<i>/<metric>``, telemetry.CHIP_METRIC_RE)
+    fold the chip index out of the name into a ``chip`` label, so one
+    PromQL selector sweeps a mesh (``chunkflow_device_chip_bytes_in_use``
+    by ``chip``) instead of N name-mangled series."""
     if snap is None:
         snap = telemetry.snapshot()
     if worker is None:
         worker = telemetry.worker_id()
     label = f'{{worker="{_escape_label(worker)}"}}'
+
+    def _folded(names):
+        """Ordered ``{prom_metric: [(label_str, registry_name)]}`` with
+        chip-indexed names folded onto one metric — grouping keeps every
+        sample of a metric contiguous under its single TYPE line, which
+        strict exposition parsers require."""
+        groups: Dict[str, list] = {}
+        for name in sorted(names):
+            m = telemetry.CHIP_METRIC_RE.match(name)
+            if m:
+                prom = prometheus_name(
+                    f"{m.group('plane')}/chip/{m.group('metric')}")
+                sample_label = (f'{{worker="{_escape_label(worker)}",'
+                                f'chip="{m.group("chip")}"}}')
+            else:
+                prom = prometheus_name(name)
+                sample_label = label
+            groups.setdefault(prom, []).append((sample_label, name))
+        return groups
+
     lines = []
-    for name in sorted(snap.get("counters", {})):
-        metric = prometheus_name(name) + "_total"
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric}{label} {snap['counters'][name]:g}")
-    for name in sorted(snap.get("gauges", {})):
-        metric = prometheus_name(name)
+    for metric, samples in _folded(snap.get("counters", {})).items():
+        lines.append(f"# TYPE {metric}_total counter")
+        for sample_label, name in samples:
+            lines.append(
+                f"{metric}_total{sample_label} {snap['counters'][name]:g}")
+    for metric, samples in _folded(snap.get("gauges", {})).items():
         lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric}{label} {snap['gauges'][name]:g}")
-    for name in sorted(snap.get("hists", {})):
-        h = snap["hists"][name]
-        metric = prometheus_name(name)
+        for sample_label, name in samples:
+            lines.append(f"{metric}{sample_label} {snap['gauges'][name]:g}")
+    for metric, samples in _folded(snap.get("hists", {})).items():
         lines.append(f"# TYPE {metric} summary")
-        lines.append(f"{metric}_count{label} {h['count']:g}")
-        lines.append(f"{metric}_sum{label} {h['total']:g}")
+        for sample_label, name in samples:
+            h = snap["hists"][name]
+            lines.append(f"{metric}_count{sample_label} {h['count']:g}")
+            lines.append(f"{metric}_sum{sample_label} {h['total']:g}")
     # quantile histograms (serving latency etc.) render as real
     # Prometheus histograms: cumulative le-labeled buckets, so any
     # scraper (or fleet-status via serving_stats) can compute p50/p99

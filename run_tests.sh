@@ -154,6 +154,20 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py fleet_smoke --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "fleet chaos smoke"
 
+# --- trace export overhead gate ----------------------------------------------
+# Perfetto/Chrome-trace exporter (tools/trace_export.py) pinned on a
+# large synthetic multi-worker stream with injected clock skew
+# (docs/observability.md "Timeline view"). The run raises unless the
+# exported trace validates clean and every cross-worker flow survives;
+# reports the >=50k events/s soft floor as gate_pass; the process only
+# fails below 5k events/s (an algorithmic regression, not box noise).
+# The fleet chaos smoke above already round-trips its REAL acceptance
+# JSONL through the same exporter + validator.
+echo "== trace export overhead gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py trace_export_overhead --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "trace export overhead gate"
+
 # --- serving throughput gate -------------------------------------------------
 # Packed cross-request batching vs sequential per-chunk execution on many
 # small concurrent requests (docs/serving.md). Reports the >=1.3x target

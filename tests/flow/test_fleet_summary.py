@@ -131,7 +131,8 @@ def test_cli_fleet_requires_metrics_dir(tmp_path):
         main, ["log-summary", "--log-dir", str(tmp_path), "--fleet"]
     )
     assert result.exit_code != 0
-    assert "--fleet/--trace-id/--slo needs --metrics-dir" in result.output
+    assert "--fleet/--trace-id/--slo/--export-trace needs " \
+        "--metrics-dir" in result.output
 
 
 # ---------------------------------------------------------------------------
@@ -230,10 +231,127 @@ def test_device_memory_unsupported_backend_is_noop(monkeypatch):
 
     monkeypatch.setattr(jax, "local_devices", lambda: [NoStats()])
     monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_FAILURES", 0)
     scheduler.sample_device_memory()
     assert "device/bytes_in_use" not in telemetry.snapshot()["gauges"]
     # the probe marked itself unsupported: later calls are free no-ops
     assert scheduler._DEVICE_MEM_UNSUPPORTED is True
+
+
+def test_device_memory_per_chip_watermarks_and_headroom(monkeypatch):
+    """ISSUE 18: per-chip bytes/peak/headroom gauges under the
+    device/chip/<i>/* convention, plus device/hbm_headroom = the WORST
+    chip's headroom (the distance to the next OOM)."""
+    import jax
+
+    from chunkflow_tpu.flow import scheduler
+
+    class FakeDevice:
+        def __init__(self, in_use, peak, limit):
+            self._stats = {"bytes_in_use": in_use,
+                           "peak_bytes_in_use": peak,
+                           "bytes_limit": limit}
+
+        def memory_stats(self):
+            return self._stats
+
+    monkeypatch.setattr(
+        jax, "local_devices",
+        lambda: [FakeDevice(100, 150, 1000), FakeDevice(700, 800, 1000)],
+    )
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    scheduler.sample_device_memory()
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["device/chip/0/bytes_in_use"] == 100
+    assert gauges["device/chip/1/bytes_in_use"] == 700
+    assert gauges["device/chip/0/peak_bytes"] == 150
+    assert gauges["device/chip/0/hbm_headroom"] == 900
+    assert gauges["device/chip/1/hbm_headroom"] == 300
+    # aggregates: sums, and headroom = the worst chip (chip 1)
+    assert gauges["device/bytes_in_use"] == 800
+    assert gauges["device/peak_bytes"] == 950
+    assert gauges["device/hbm_headroom"] == 300
+
+
+def test_device_memory_partial_results_stand(monkeypatch):
+    """One chip failing to report must not blank the others — and a
+    partial probe counts as a SUCCESS (no backoff latch)."""
+    import jax
+
+    from chunkflow_tpu.flow import scheduler
+
+    class Good:
+        def memory_stats(self):
+            return {"bytes_in_use": 64, "peak_bytes_in_use": 64}
+
+    class Flaky:
+        def memory_stats(self):
+            raise RuntimeError("transient runtime stutter")
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [Good(), Flaky()])
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_FAILURES", 3)
+    scheduler.sample_device_memory()
+    gauges = telemetry.snapshot()["gauges"]
+    assert gauges["device/chip/0/bytes_in_use"] == 64
+    assert "device/chip/1/bytes_in_use" not in gauges
+    assert gauges["device/bytes_in_use"] == 64
+    assert scheduler._DEVICE_MEM_UNSUPPORTED is False
+    assert scheduler._DEVICE_MEM_FAILURES == 0
+
+
+def test_device_memory_backoff_reprobes(monkeypatch):
+    """ISSUE 18 satellite: a failed probe no longer latches the plane
+    off for the process lifetime — it backs off (8 skips, doubling per
+    consecutive failure up to CHUNKFLOW_DEVICE_MEM_REPROBE) and then
+    re-probes, so a backend whose runtime stuttered once recovers."""
+    import jax
+
+    from chunkflow_tpu.flow import scheduler
+
+    probes = []
+
+    def failing_devices():
+        probes.append("probe")
+        raise RuntimeError("runtime not ready")
+
+    monkeypatch.setattr(jax, "local_devices", failing_devices)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_FAILURES", 0)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_SKIPS_LEFT", 0)
+    scheduler.sample_device_memory()  # fails -> back off 8 drains
+    assert len(probes) == 1
+    assert scheduler._DEVICE_MEM_UNSUPPORTED is True
+    for _ in range(8):
+        scheduler.sample_device_memory()  # free no-ops, no probe
+    assert len(probes) == 1
+    scheduler.sample_device_memory()  # window drained: re-probe
+    assert len(probes) == 2
+    # second consecutive failure doubles the window
+    assert scheduler._DEVICE_MEM_SKIPS_LEFT == 16
+
+    class Healthy:
+        def memory_stats(self):
+            return {"bytes_in_use": 7, "peak_bytes_in_use": 7}
+
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_SKIPS_LEFT", 0)
+    monkeypatch.setattr(jax, "local_devices", lambda: [Healthy()])
+    scheduler.sample_device_memory()  # recovery resets the backoff
+    assert scheduler._DEVICE_MEM_UNSUPPORTED is False
+    assert scheduler._DEVICE_MEM_FAILURES == 0
+    assert telemetry.snapshot()["gauges"]["device/bytes_in_use"] == 7
+
+
+def test_device_memory_backoff_window_is_capped(monkeypatch):
+    from chunkflow_tpu.flow import scheduler
+
+    monkeypatch.setenv("CHUNKFLOW_DEVICE_MEM_REPROBE", "10")
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_FAILURES", 6)
+    scheduler._note_device_mem_failure()
+    assert scheduler._DEVICE_MEM_SKIPS_LEFT == 10  # capped, not 512
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_UNSUPPORTED", False)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_FAILURES", 0)
+    monkeypatch.setattr(scheduler, "_DEVICE_MEM_SKIPS_LEFT", 0)
 
 
 # ---------------------------------------------------------------------------

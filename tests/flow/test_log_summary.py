@@ -154,6 +154,69 @@ def test_print_telemetry_summary(tmp_path, capsys):
     assert "1 build(s), 5 hit(s)" in out
 
 
+def test_print_mesh_block_renders_per_chip_table(tmp_path, capsys):
+    """ISSUE 18: the MESH block folds shard/chip and device/chip gauges
+    into one per-chip table with skew, analytic collective traffic, and
+    the compute-vs-collective split verdict."""
+    _write_events(tmp_path / "telemetry-1.jsonl", [
+        {"kind": "gauge", "name": "shard/mesh_devices", "value": 2},
+        {"kind": "gauge", "name": "shard/chip/0/voxels", "value": 2048.0},
+        {"kind": "gauge", "name": "shard/chip/1/voxels", "value": 1024.0},
+        {"kind": "gauge", "name": "shard/chip/0/ready_s",
+         "value": 0.000004},
+        {"kind": "gauge", "name": "shard/chip/1/ready_s",
+         "value": 0.000010},
+        {"kind": "gauge", "name": "shard/chip_skew_s", "value": 0.000006},
+        {"kind": "gauge", "name": "device/chip/0/bytes_in_use",
+         "value": 2.0 * 2**20},
+        {"kind": "gauge", "name": "device/chip/0/hbm_headroom",
+         "value": 14.0 * 2**20},
+        {"kind": "gauge", "name": "device/hbm_headroom",
+         "value": 14.0 * 2**20},
+        {"kind": "gauge", "name": "device/bytes_in_use",
+         "value": 2.0 * 2**20},
+        {"kind": "gauge", "name": "shard/collective_share_est",
+         "value": 0.93},
+        {"kind": "gauge", "name": "shard/compute_s_est", "value": 0.0001},
+        {"kind": "gauge", "name": "shard/collective_s_est",
+         "value": 0.0015},
+        {"kind": "snapshot", "pid": 1,
+         "counters": {"shard/chunks": 3, "shard/halo_bytes": 1048576.0,
+                      "shard/gather_bytes": 2097152.0}},
+    ])
+    agg = log_summary.print_telemetry_summary(str(tmp_path))
+    out = capsys.readouterr().out
+    assert "mesh (docs/multichip.md):" in out
+    assert "shape data=2 (2 chip(s)), 3 sharded dispatch(es)" in out
+    # per-chip rows: chip 0 carries load, HBM and headroom; chip 1 has
+    # no watermark samples and renders dashes instead of zeros
+    assert "0     " in out and "2048" in out and "1024" in out
+    assert "2.0" in out and "14.0" in out
+    assert "chip skew (last ready − first ready)" in out
+    assert "halo 1.00 MiB, gather 2.00 MiB" in out
+    assert "share 93% — collective-bound" in out
+    assert "headroom 14.0 MiB (worst chip)" in out
+    assert agg["counters"]["shard/gather_bytes"] == 2097152.0
+
+
+def test_print_mesh_block_spatial_shape_and_quiet_default(capsys):
+    from chunkflow_tpu.flow.log_summary import print_mesh_block
+
+    # no sharded engine ever built: quiet
+    assert print_mesh_block(
+        {"gauges": {}, "counters": {}}) is False
+    assert capsys.readouterr().out == ""
+    # a 2D spatial mesh renders its y/x shape, not data=N
+    agg = {"gauges": {
+        "shard/mesh_devices": {"last": 4.0, "mean": 4.0},
+        "shard/mesh_y": {"last": 2.0, "mean": 2.0},
+        "shard/mesh_x": {"last": 2.0, "mean": 2.0},
+    }, "counters": {"shard/chunks": 1}}
+    assert print_mesh_block(agg) is True
+    out = capsys.readouterr().out
+    assert "shape y=2,x=2 (4 chip(s)), 1 sharded dispatch(es)" in out
+
+
 def test_log_summary_sweeps_profile_captures(tmp_path, capsys):
     """ISSUE 8: log-summary summarizes every profile-* capture dir under
     the metrics dir through tools/analyze_trace.py."""

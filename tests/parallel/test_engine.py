@@ -413,6 +413,153 @@ def test_shard_telemetry_and_roofline_ledger(id_engine, tmp_path,
     assert shard_entries[0].get("compile_s") is not None
 
 
+def test_per_chip_attribution_gauges(id_engine, monkeypatch):
+    """ISSUE 18: every sharded dispatch attributes its work per chip —
+    shard/chip/<i>/voxels load gauges, a sampled readiness probe
+    (shard/chip/<i>/ready_s + shard/chip_skew_s), and analytic
+    collective byte counters with the compute-vs-collective split."""
+    from chunkflow_tpu.core import telemetry
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=8")
+    monkeypatch.setenv("CHUNKFLOW_CHIP_PROBE_EVERY", "1")
+    telemetry.reset()
+    try:
+        inf = make_inferencer(id_engine)
+        rng = np.random.default_rng(11)
+        np.asarray(inf(Chunk(rng.random((8, 40, 48)).astype(
+            np.float32))).array)
+        gauges = telemetry.snapshot()["gauges"]
+        counters = telemetry.snapshot()["counters"]
+    finally:
+        telemetry.reset()
+    chip_vox = {int(m.group("chip")): v for name, v in gauges.items()
+                for m in [telemetry.CHIP_METRIC_RE.match(name)]
+                if m and m.group("plane") == "shard"
+                and m.group("metric") == "voxels"}
+    assert sorted(chip_vox) == list(range(8))
+    # attribution is real, not degenerate: whole patches only, covering
+    # at least the chunk (overlap re-visits voxels), unevenly spread
+    # because the padded grid does not divide 8 ways
+    pvox = float(np.prod(PIN))
+    total = sum(chip_vox.values())
+    assert total % pvox == 0 and total >= 8 * 40 * 48
+    assert len(set(chip_vox.values())) > 1
+    # the readiness probe stamped every chip, cumulative hence monotone
+    readies = [gauges[f"shard/chip/{i}/ready_s"] for i in range(8)]
+    assert readies == sorted(readies)
+    assert gauges["shard/chip_skew_s"] == pytest.approx(
+        readies[-1] - readies[0])
+    # analytic collective plane: the data axis all-gathers the output
+    # rows, and the split estimate rides with it
+    assert counters["shard/gather_bytes"] > 0
+    assert gauges["shard/gather_bytes_per_chunk"] == pytest.approx(
+        counters["shard/gather_bytes"])
+    assert 0.0 < gauges["shard/collective_share_est"] <= 1.0
+
+
+def test_spatial_mesh_stamps_halo_bytes(id_engine, monkeypatch):
+    """A 2D spatial mesh exchanges halos on both axes: the analytic
+    halo counter is non-zero and separate from the gather plane."""
+    from chunkflow_tpu.core import telemetry
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "y=2,x=2")
+    telemetry.reset()
+    try:
+        inf = make_inferencer(id_engine)
+        rng = np.random.default_rng(12)
+        np.asarray(inf(Chunk(rng.random((8, 40, 48)).astype(
+            np.float32))).array)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+    assert snap["counters"]["shard/halo_bytes"] > 0
+    assert snap["counters"]["shard/gather_bytes"] > 0
+    chip_vox = [snap["gauges"].get(f"shard/chip/{i}/voxels")
+                for i in range(4)]
+    assert all(v is not None for v in chip_vox)
+
+
+def test_telemetry_off_means_no_chip_probes(id_engine, monkeypatch):
+    """CHUNKFLOW_TELEMETRY=0 acceptance: the sharded path emits no
+    per-chip gauges and never runs the readiness probe (no extra
+    block_until_ready on the dispatch path) — and stays bitwise
+    identical to the telemetry-on run."""
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.parallel import engine as engine_mod
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "data=8")
+    monkeypatch.setenv("CHUNKFLOW_CHIP_PROBE_EVERY", "1")
+    rng = np.random.default_rng(13)
+    chunk = rng.random((8, 40, 48)).astype(np.float32)
+    telemetry.reset()
+    inf_on = make_inferencer(id_engine)
+    out_on = np.asarray(inf_on(Chunk(chunk.copy())).array)
+    telemetry.reset()
+
+    monkeypatch.setenv("CHUNKFLOW_TELEMETRY", "0")
+    inf_off = make_inferencer(id_engine)
+    out_off = np.asarray(inf_off(Chunk(chunk.copy())).array)
+    snap = telemetry.snapshot()
+    telemetry.reset()
+    assert not any(telemetry.CHIP_METRIC_RE.match(name)
+                   for name in snap["gauges"])
+    assert "shard/gather_bytes" not in snap["counters"]
+    np.testing.assert_array_equal(out_on, out_off)
+
+    # and the probe itself is a free return: with telemetry off it must
+    # never touch the result (no block_until_ready on the dispatch path)
+    class Untouchable:
+        @property
+        def addressable_shards(self):
+            raise AssertionError("probe touched the result while off")
+
+    shard_engine = inf_off.shard_engine()
+    assert isinstance(shard_engine, engine_mod.ShardedEngine)
+    for _ in range(3):
+        shard_engine._probe_chip_readiness(Untouchable())
+
+
+def _bare_sharded_engine(spec):
+    from chunkflow_tpu.parallel.engine import ShardedEngine
+
+    return ShardedEngine(
+        forward=lambda x: x, num_input_channels=1, num_output_channels=3,
+        input_patch_size=PIN, output_patch_size=PIN, batch_size=2,
+        spec=spec,
+    )
+
+
+def test_probe_cadence_is_sampled(monkeypatch):
+    """The readiness probe fires on dispatch 0 and then every
+    CHUNKFLOW_CHIP_PROBE_EVERY dispatches, not per chunk."""
+    from chunkflow_tpu.core import telemetry
+
+    monkeypatch.setenv("CHUNKFLOW_CHIP_PROBE_EVERY", "4")
+    engine = _bare_sharded_engine(MeshSpec("data", (8,)))
+    probed = []
+
+    class FakeShard:
+        def __init__(self):
+            self.device = type("D", (), {"id": 0})()
+            self.data = type("A", (), {
+                "block_until_ready": lambda self: None})()
+
+    class FakeResult:
+        @property
+        def addressable_shards(self):
+            probed.append(True)
+            return [FakeShard()]
+
+    telemetry.reset()
+    try:
+        for _ in range(9):
+            engine._probe_chip_readiness(FakeResult())
+        assert len(probed) == 3  # dispatches 0, 4, 8
+        assert "shard/chip_skew_s" in telemetry.snapshot()["gauges"]
+    finally:
+        telemetry.reset()
+
+
 def test_program_reuse_across_same_shape_chunks(id_engine, monkeypatch):
     """Two same-shape chunks share ONE sharded program build (the
     compile-cache invariant every other family holds)."""

@@ -217,3 +217,46 @@ def test_fleet_trace_reconstruction(tmp_path, monkeypatch):
     assert "worker worker-b:" in result.output
     assert f"trace {submits[hopper]}:" in result.output
     assert "lifecycle/committed" in result.output
+
+    # -- ISSUE 18 acceptance: the same chaos run exports as a
+    #    schema-valid Chrome trace — one process per worker identity,
+    #    the hopper's worker hop as a paired cross-worker flow ----------
+    import json
+
+    from tools.trace_export import (
+        export_metrics_dir,
+        validate_chrome_trace,
+    )
+
+    trace_path = tmp_path / "fleet-trace.json"
+    stats = export_metrics_dir(str(metrics_dir), str(trace_path))
+    assert stats["problems"] == []
+    trace = json.loads(trace_path.read_text())
+    assert validate_chrome_trace(trace) == []
+    procs = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    # submitter + worker-a + worker-b
+    assert {"worker worker-a", "worker worker-b"} <= procs
+    assert len(procs) >= 2
+    assert stats["flow_pairs"] >= 1
+    # the hopper's flow specifically: its submit started a flow that
+    # finishes on a DIFFERENT process (the claim hopped workers)
+    flow_events = [e for e in trace["traceEvents"]
+                   if e.get("ph") in ("s", "t", "f")
+                   and e["args"]["trace_id"] == submits[hopper]]
+    assert {e["ph"] for e in flow_events} >= {"s", "f"}
+    start = next(e for e in flow_events if e["ph"] == "s")
+    finish = [e for e in flow_events if e["ph"] == "f"][-1]
+    assert start["pid"] != finish["pid"]
+    assert finish["ts"] >= start["ts"]
+
+    # the CLI flag drives the same exporter
+    result = CliRunner().invoke(
+        main,
+        ["log-summary", "--metrics-dir", str(metrics_dir),
+         "--export-trace", str(tmp_path / "cli-trace.json")],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    assert "cross-worker flow(s)" in result.output
+    assert "trace validation:" not in result.output

@@ -110,6 +110,40 @@ def test_render_prometheus_golden():
     )
 
 
+def test_render_prometheus_folds_chip_labels():
+    """ISSUE 18: device/chip/<i>/* and shard/chip/<i>/* gauges fold the
+    chip index out of the metric name into a ``chip`` label, with every
+    chip's sample contiguous under ONE TYPE line (strict exposition:
+    all samples of a metric must be grouped)."""
+    snap = {
+        "counters": {},
+        "gauges": {
+            "device/chip/0/bytes_in_use": 100.0,
+            "device/chip/1/bytes_in_use": 700.0,
+            "device/chip/0/hbm_headroom": 900.0,
+            "device/chip/1/hbm_headroom": 300.0,
+            "shard/chip/0/voxels": 2048.0,
+            "device/bytes_in_use": 800.0,
+        },
+        "hists": {},
+    }
+    text = render_prometheus(snap, worker="w1")
+    assert (
+        "# TYPE chunkflow_device_chip_bytes_in_use gauge\n"
+        'chunkflow_device_chip_bytes_in_use{worker="w1",chip="0"} 100\n'
+        'chunkflow_device_chip_bytes_in_use{worker="w1",chip="1"} 700\n'
+    ) in text
+    assert 'chunkflow_device_chip_hbm_headroom{worker="w1",chip="1"} 300' \
+        in text
+    assert 'chunkflow_shard_chip_voxels{worker="w1",chip="0"} 2048' in text
+    # the aggregate keeps its unlabeled name, and each folded metric
+    # declares its TYPE exactly once
+    assert 'chunkflow_device_bytes_in_use{worker="w1"} 800' in text
+    assert text.count("# TYPE chunkflow_device_chip_bytes_in_use") == 1
+    assert text.count("# TYPE chunkflow_device_chip_hbm_headroom") == 1
+    parse_prometheus(text)  # grammar holds with the extra label
+
+
 def test_rendered_exposition_parses(clean_telemetry):
     """Every sample line of a live-registry rendering must match the
     Prometheus exposition grammar (metric names, label syntax, float

@@ -242,6 +242,25 @@ def test_export_overhead_microbench(tmp_path):
     ), best.get("telemetry_jsonl")
 
 
+def test_trace_export_overhead_shape_and_invariants():
+    """The Perfetto exporter gate (ISSUE 18): run_trace_export_overhead
+    raises if the synthetic trace fails validation or drops a
+    cross-worker flow, so in-suite we only pin the measurement shape at
+    a tiny size — absolute throughput is the CI stage's business (soft
+    floor 50k events/s, hard floor 5k)."""
+    stats = bench.run_trace_export_overhead(
+        n_workers=3, n_tasks=40, n_spans=200, n_gauges=200,
+        n_snapshots=40, repeats=1)
+    assert stats["metric"] == "trace_export_overhead"
+    assert stats["unit"] == "events/s"
+    assert stats["value"] > 0 and stats["best_s"] > 0
+    assert stats["events"] == 40 * 3 + 200 + 200 + 40
+    assert stats["flow_pairs"] == 40  # every synthetic task hops
+    assert stats["trace_events"] >= stats["events"]
+    assert stats["gate_pct"] == 50000.0
+    assert isinstance(stats["gate_pass"], bool)
+
+
 def test_slo_overhead_microbench(tmp_path):
     """The SLO plane (time-series sampler + burn-rate evaluator,
     ISSUE 12) must be ~free over the e2e_overlap-style workload even at
