@@ -418,14 +418,20 @@ _COLLECTIVE: dict = {}  # program family -> analytic collective bytes
 
 def note_collective(nbytes, key=None, label: str = "") -> None:
     """Count ANALYTIC cross-chip collective traffic for one sharded
-    dispatch (ISSUE 18): halo ``ppermute`` exchanges plus the weighted-
-    stack ``all_gather``, computed by the engine from halo widths,
+    dispatch (ISSUE 18): halo ``ppermute`` exchanges, the weighted-
+    stack ``all_gather`` (replicated-replay legs only), the fringe
+    replay-strip ``ppermute`` exchanges of the sharded blend replay,
+    and the per-tick activation handoffs of the ``pipeline=N`` ring
+    (ISSUE 19) — each computed by the engine from halo/fringe widths,
     shard shapes and dtypes — the same stamped-arithmetic discipline as
     :func:`stamp_cost`, because XLA's cost analysis does not price
     inter-chip links. Feeds the ``shard/collective_bytes`` counter and
     a per-family bucket (the catalog's ``collective_bytes`` column), so
-    the MESH block can show collective-vs-compute per mesh shape.
-    No-op under the telemetry kill switch."""
+    the MESH block can show collective-vs-compute per mesh shape; the
+    engine additionally splits the total into ``shard/halo_bytes``,
+    ``shard/gather_bytes``, ``shard/replay_strip_bytes`` and
+    ``shard/handoff_bytes`` counters. No-op under the telemetry kill
+    switch."""
     if not telemetry.enabled():
         return
     telemetry.inc("shard/collective_bytes", float(nbytes))

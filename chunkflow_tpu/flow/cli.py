@@ -2425,10 +2425,11 @@ def copy_var_cmd(op_name, from_name, to_name):
     "--mesh", "mesh_spec", type=str, default=None,
     help="unified multi-chip mesh spec (docs/multichip.md): 1 (single "
          "device), auto, data=N (patch-parallel over N chips), y=A or "
-         "y=A,x=B (chunk sharded in slabs with halo exchange). Every "
-         "shape produces output bit-identical to the single-device "
-         "path. Overrides CHUNKFLOW_MESH; does not compose with the "
-         "legacy --sharding names",
+         "y=A,x=B (chunk sharded in slabs with halo exchange), "
+         "pipeline=N (layer-parallel stages over engines declaring the "
+         "stage protocol). Every shape produces output bit-identical "
+         "to the single-device path. Overrides CHUNKFLOW_MESH; does "
+         "not compose with the legacy --sharding names",
 )
 @cartesian_option(
     "--shape-bucket", default=None,

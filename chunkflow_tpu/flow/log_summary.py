@@ -451,7 +451,9 @@ def print_mesh_block(agg: dict, indent: str = "") -> bool:
                 m.group("metric")] = g
     ny = gauges.get("shard/mesh_y", {}).get("last", 1)
     nx = gauges.get("shard/mesh_x", {}).get("last", 1)
-    shape = (f"y={ny:g},x={nx:g}" if ny > 1 or nx > 1
+    npipe = gauges.get("shard/mesh_pipeline", {}).get("last", 0)
+    shape = (f"pipeline={npipe:g}" if npipe > 1
+             else f"y={ny:g},x={nx:g}" if ny > 1 or nx > 1
              else f"data={devices['last']:g}")
     chunks = agg["counters"].get("shard/chunks", 0)
     print(f"{indent}mesh (docs/multichip.md):")
@@ -478,10 +480,17 @@ def print_mesh_block(agg: dict, indent: str = "") -> bool:
               f"{skew['last']:.6f}s mean {skew['mean']:.6f}s")
     halo = agg["counters"].get("shard/halo_bytes", 0)
     gather = agg["counters"].get("shard/gather_bytes", 0)
-    if halo or gather:
-        print(f"{indent}  analytic collective traffic: halo "
-              f"{halo / 2**20:.2f} MiB, gather {gather / 2**20:.2f} MiB "
-              f"(cumulative)")
+    strips = agg["counters"].get("shard/replay_strip_bytes", 0)
+    handoff = agg["counters"].get("shard/handoff_bytes", 0)
+    if halo or gather or strips or handoff:
+        parts = [f"halo {halo / 2**20:.2f} MiB",
+                 f"gather {gather / 2**20:.2f} MiB"]
+        if strips:
+            parts.append(f"replay strips {strips / 2**20:.2f} MiB")
+        if handoff:
+            parts.append(f"stage handoffs {handoff / 2**20:.2f} MiB")
+        print(f"{indent}  analytic collective traffic: "
+              f"{', '.join(parts)} (cumulative)")
     share = gauges.get("shard/collective_share_est")
     if share:
         compute = gauges.get("shard/compute_s_est", {}).get("last", 0.0)
@@ -492,6 +501,32 @@ def print_mesh_block(agg: dict, indent: str = "") -> bool:
               f"{compute:.6f}s vs collective {coll:.6f}s "
               f"(share {share['last']:.0%} — {verdict}; HBM-bandwidth "
               f"proxy, a lower bound on interconnect pressure)")
+        # collective verdict -> shape hint (docs/multichip.md "Choosing
+        # a scaling shape"): collective-bound meshes should trade the
+        # interconnect plane that dominates; a compute-bound mesh is
+        # already using the right shape, scale it instead
+        if share["last"] > 0.5:
+            if gather and not strips:
+                hint = ("replicated replay dominates — flip "
+                        "CHUNKFLOW_SHARD_REPLAY=sharded (the default) "
+                        "to drop the weighted-stack all_gather")
+            elif handoff:
+                hint = ("stage handoffs dominate — fewer pipeline "
+                        "stages, or a data/spatial mesh if the model "
+                        "fits per chip")
+            else:
+                hint = ("halo/fringe exchange dominates — coarser "
+                        "slabs (fewer chips per axis) or a data mesh")
+            print(f"{indent}  shape hint: {hint}")
+        elif tight_chips := [
+            chip for chip, m in chips.items()
+            if m.get("hbm_headroom", {}).get("last", float("inf"))
+            < 2**30
+        ]:
+            print(f"{indent}  shape hint: compute-bound but chip(s) "
+                  f"{tight_chips} have <1 GiB HBM headroom — a spatial "
+                  f"mesh (sharded replay) shrinks per-chip blend "
+                  f"buffers; pipeline=N shrinks per-chip parameters")
     return True
 
 

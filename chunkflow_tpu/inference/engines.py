@@ -24,6 +24,15 @@ class Engine(NamedTuple):
     apply: Callable  # (params, [B, Cin, *pin]) -> [B, Cout, *pout]
     num_input_channels: int
     num_output_channels: int
+    # The stage protocol (parallel/pipeline.py, ISSUE 19): engines that
+    # can be staged across a ``pipeline=N`` mesh declare their layer
+    # stack as uniform-activation bodies plus a tail, with ``apply``
+    # being their LITERAL composition (bitwise — the pipelined and
+    # non-pipelined programs then run the same per-row expression).
+    # ``None`` (the default) means the forward is opaque and a pipeline
+    # mesh fails loudly instead of silently de-pipelining.
+    stage_bodies: Optional[Tuple[Callable, ...]] = None
+    stage_tail: Optional[Callable] = None
 
 
 def create_identity_engine(
@@ -40,7 +49,14 @@ def create_identity_engine(
     pout = tuple(output_patch_size)
     margin = tuple((i - o) // 2 for i, o in zip(pin, pout))
 
-    def apply(params, batch):
+    # stage protocol (parallel/pipeline.py): one identity body (the
+    # uniform-activation [B, ci, *pin] -> same shape/dtype rule) and the
+    # crop/broadcast tail; ``apply`` is their literal composition, so
+    # the pipelined program runs bitwise the same expression.
+    def stage_body(params, x):
+        return x
+
+    def stage_tail(params, batch):
         sl = (slice(None), slice(0, 1)) + tuple(
             slice(m, m + o) for m, o in zip(margin, pout)
         )
@@ -50,11 +66,16 @@ def create_identity_engine(
             (batch.shape[0], num_output_channels) + pout,
         )
 
+    def apply(params, batch):
+        return stage_tail(params, stage_body(params, batch))
+
     return Engine(
         params=(),
         apply=apply,
         num_input_channels=num_input_channels,
         num_output_channels=num_output_channels,
+        stage_bodies=(stage_body,),
+        stage_tail=stage_tail,
     )
 
 

@@ -64,7 +64,8 @@ from typing import Callable, Optional
 
 from chunkflow_tpu.core import envmode
 
-__all__ = ["PRECISIONS", "resolve_precision", "wrap_apply", "int8_mode"]
+__all__ = ["PRECISIONS", "resolve_precision", "wrap_apply", "int8_mode",
+           "wrap_stages", "precision_tag"]
 
 PRECISIONS = ("float32", "bfloat16", "int8")
 
@@ -428,4 +429,86 @@ def wrap_apply(apply: Callable, precision: str) -> Callable:
             return jnp.asarray(out, jnp.float32)
 
         return int8_real_apply
+    raise ValueError(f"unknown precision {precision!r}")
+
+
+def precision_tag(precision: str) -> str:
+    """The resolved forward precision as a ProgramCache key component:
+    ``""`` for the float32 default (the no-suffix-for-the-default
+    convention every knob shares), ``"prec-bfloat16"``, or
+    ``"prec-int8-<fake|real|fakeint>"`` with the ``CHUNKFLOW_INT8`` leg
+    folded in (the leg changes the traced program, so it is program
+    identity). Joined into the sharded-engine program keys (ISSUE 19:
+    precision tags compose with the pipeline/gather/kernel tags in
+    shard cache keys)."""
+    if precision == "float32":
+        return ""
+    if precision == "int8":
+        return f"prec-int8-{int8_mode()}"
+    return f"prec-{precision}"
+
+
+def wrap_stages(stage_bodies, stage_tail, precision: str):
+    """Precision-wrap a staged engine (the stage protocol,
+    parallel/pipeline.py) so that the composition of the wrapped pieces
+    is BITWISE :func:`wrap_apply` of the unwrapped composition — the
+    identity the pipeline mesh's parity contract rests on. Returns
+    ``(entry, bodies, tail)``:
+
+    - ``entry(x)`` — the one-time activation boundary cast, applied to
+      the gathered patch batch BEFORE it enters stage 0 (so the ring
+      activation dtype is uniform: the ``where(stage==0, ...)`` merge
+      of fresh patches and ``ppermute``-received activations sees one
+      dtype);
+    - ``bodies`` — per-stage wrapped bodies (parameter leaves cast at
+      each stage, activations untouched — they already carry the entry
+      cast);
+    - ``tail`` — the wrapped tail (parameter cast + the float32 result
+      cast the blend accumulation requires).
+
+    float32 returns everything UNTOUCHED (same objects — the bitwise
+    default-path rule). The int8 ``real``/``fakeint`` legs re-evaluate
+    the whole forward's jaxpr (:func:`_int8_graph_apply`) and cannot be
+    split at stage seams; they return ``(None, None, None)`` and a
+    pipeline mesh fails loudly naming the constraint."""
+    if stage_bodies is None or stage_tail is None:
+        return None, None, None
+    if precision == "float32":
+        return (lambda x: x), tuple(stage_bodies), stage_tail
+    if precision == "bfloat16":
+        import jax.numpy as jnp
+
+        def entry(x):
+            return jnp.asarray(x, jnp.bfloat16)
+
+        bodies = tuple(
+            (lambda params, x, _b=body:
+             _b(_cast_float_leaves(params, jnp.bfloat16), x))
+            for body in stage_bodies
+        )
+
+        def tail(params, x):
+            out = stage_tail(_cast_float_leaves(params, jnp.bfloat16), x)
+            return jnp.asarray(out, jnp.float32)
+
+        return entry, bodies, tail
+    if precision == "int8":
+        if int8_mode() != "fake":
+            # real/fakeint rewrite the whole jaxpr — not stage-splittable
+            return None, None, None
+        import jax.numpy as jnp
+
+        def entry(x):
+            return _fake_quant_int8(x, per_row=True)
+
+        bodies = tuple(
+            (lambda params, x, _b=body: _b(_quant_float_leaves(params), x))
+            for body in stage_bodies
+        )
+
+        def tail(params, x):
+            out = stage_tail(_quant_float_leaves(params), x)
+            return jnp.asarray(out, jnp.float32)
+
+        return entry, bodies, tail
     raise ValueError(f"unknown precision {precision!r}")

@@ -154,6 +154,52 @@ def pipeline_kernel_cost(B: int, ci: int, co: int, pin, pout,
     }
 
 
+_REPLAY_CHOICES = {
+    "sharded": ("", "1", "on", "sharded", "slab"),
+    "replicated": ("0", "off", "replicated", "full"),
+}
+_REPLAY_WARNED: set = set()
+
+
+def shard_replay_mode() -> str:
+    """'sharded' | 'replicated' — the ``CHUNKFLOW_SHARD_REPLAY`` knob
+    (ISSUE 19): how the mesh engine replays the reference blend
+    accumulation. ``sharded`` (the default) replays each chip ONLY the
+    windows that touch its output slab, into a slab+margin buffer —
+    per-chip blend HBM drops from full-chunk to slab-sized, the path to
+    chunks bigger than one chip (docs/multichip.md "Why every shape is
+    bit-identical"). ``replicated`` is the historical PR 13 behavior:
+    every chip ``all_gather``s the full weighted stack and replays every
+    window into a full-chunk buffer — kept as the bisection/kill-switch
+    leg and as the baseline leg of ``bench.py multichip_sharded_replay``.
+    Re-read per chunk, like ``CHUNKFLOW_MESH`` itself."""
+    from chunkflow_tpu.core import envmode
+
+    return envmode.resolve(
+        "CHUNKFLOW_SHARD_REPLAY", _REPLAY_CHOICES, default="sharded",
+        note="running the sharded (slab) replay default — a typo must "
+             "not silently select the full-chunk replicated replay",
+        warned=_REPLAY_WARNED,
+    )
+
+
+def replay_tag() -> str:
+    """The replay selection as a ProgramCache key component: ``""`` for
+    the sharded default (the no-suffix-for-the-default convention),
+    ``"replay-replicated"`` for the historical full-chunk replay."""
+    mode = shard_replay_mode()
+    return "" if mode == "sharded" else f"replay-{mode}"
+
+
+def replay_key() -> tuple:
+    """``()`` for the sharded-replay default, else ``(replay_tag(),)`` —
+    concatenated onto the sharded-engine program keys so a mid-stream
+    ``CHUNKFLOW_SHARD_REPLAY`` flip rebuilds instead of reusing a
+    program with the wrong replay structure."""
+    tag = replay_tag()
+    return (tag,) if tag else ()
+
+
 def kernel_tag() -> str:
     """The selected accumulation kernel as a ProgramCache key component:
     ``"scatter"`` (the XLA default) or ``"fused-on"`` /

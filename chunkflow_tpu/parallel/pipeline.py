@@ -1,0 +1,90 @@
+"""Patch-level pipeline parallelism: the stage protocol (ISSUE 19).
+
+PipeFusion (PAPERS.md) motivates the shape: stage the convnet's layer
+stack across the chips of a ``pipeline=N`` mesh and stream patch
+micro-batches through, so each chip holds only its stage's parameters
+and activations while micro-batches hide the inter-stage handoff. The
+engine (``parallel/engine.py``) drives the schedule; this module owns
+the CONTRACT an engine must satisfy to be stage-split:
+
+**The stage protocol.** An :class:`~chunkflow_tpu.inference.engines.
+Engine` opts in by carrying two extra fields:
+
+- ``stage_bodies`` — a tuple of jax-traceable ``(params, x) -> x``
+  callables, each mapping a ``[B, ci, *pin]`` float-typed activation to
+  the SAME shape and dtype (the uniform-activation rule: the pipeline's
+  ``ppermute`` ring carries one activation buffer, so every handoff
+  must be shape/dtype-uniform);
+- ``stage_tail`` — one ``(params, x) -> [B, co, *pout]`` callable
+  closing the stack,
+
+with the identity ``apply == stage_tail ∘ stage_bodies[-1] ∘ ... ∘
+stage_bodies[0]`` holding BITWISE — engines declare ``apply`` as that
+literal composition (inference/engines.py), so the pipelined and
+non-pipelined programs run the same floating-point expression per row
+and the mesh bit-identity contract extends to the pipeline axis for
+free. Engines whose forward is an opaque callable (user model files,
+TTA-augmented forwards) simply don't declare stages; a ``pipeline=N``
+mesh then fails loudly (:func:`require_stages`) instead of silently
+falling back.
+
+:func:`stage_groups` regroups the declared bodies onto ``n_stages``
+chips: contiguous balanced grouping (stages that get no body apply the
+identity), which preserves composition order — the property the
+bitwise argument needs. Precision wrapping of a staged engine lives in
+``inference/precision.wrap_stages`` (the boundary casts split across
+the entry/tail, the per-stage parameter casts ride each body).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+__all__ = ["stage_groups", "require_stages"]
+
+
+def stage_groups(stage_bodies: Sequence[Callable],
+                 n_stages: int) -> Tuple[Callable, ...]:
+    """Regroup ``stage_bodies`` onto ``n_stages`` pipeline stages:
+    contiguous balanced groups (later stages absorb the remainder so
+    stage 0 — which also pays the patch gather — is never the heaviest),
+    each returned as one ``(params, x) -> x`` callable. Stages with no
+    body are the identity. Order is preserved, so the composition of the
+    returned groups is bitwise the composition of the input bodies."""
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1 (got {n_stages})")
+    bodies = tuple(stage_bodies)
+    n = len(bodies)
+    base, extra = divmod(n, n_stages)
+    groups = []
+    start = 0
+    for s in range(n_stages):
+        # later stages absorb the remainder: stage s gets one extra body
+        # when s >= n_stages - extra
+        count = base + (1 if s >= n_stages - extra else 0)
+        group = bodies[start:start + count]
+        start += count
+
+        def run_group(params, x, _group=group):
+            for body in _group:
+                x = body(params, x)
+            return x
+
+        groups.append(run_group)
+    return tuple(groups)
+
+
+def require_stages(stage_bodies: Optional[Sequence[Callable]],
+                   stage_tail: Optional[Callable],
+                   context: str) -> None:
+    """Fail loudly when a pipeline mesh is requested over an engine that
+    never declared the stage protocol — a silent fallback to the
+    non-pipelined program would misreport the mesh shape the user asked
+    for."""
+    if stage_bodies is None or stage_tail is None:
+        raise ValueError(
+            f"{context} needs an engine declaring the stage protocol "
+            f"(stage_bodies + stage_tail with apply == tail ∘ bodies, "
+            f"parallel/pipeline.py); this engine's forward is opaque — "
+            f"use a data or spatial mesh instead (docs/multichip.md "
+            f"'Choosing a scaling shape')"
+        )

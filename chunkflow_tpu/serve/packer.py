@@ -47,9 +47,15 @@ mixed batch scatters back to the right task's accumulation buffers.
 Kill switch: ``CHUNKFLOW_SERVE=0`` — :meth:`PatchPacker.submit` routes
 every request through the untouched per-chunk path (``inferencer(...)``),
 bit-identically and without building any serve program. Requests that
-the packed path does not cover (sharded inferencers, fold blend,
-dry-run) take the same fallback automatically, loudly counted as
-``serving/fallbacks``.
+the packed path does not cover (legacy ``sharding=`` inferencers, fold
+blend, dry-run) take the same fallback automatically, loudly counted as
+``serving/fallbacks``. Unified-mesh inferencers stay eligible: the
+shared forward dispatches through ``engine.serve_forward_program()``,
+which builds the data-sharded batch program for ``data=N``/spatial
+meshes and — ``CHUNKFLOW_MESH=pipeline=N`` (ISSUE 19) — the micro-batch
+stage ring over the engine's stage protocol, with the micro-batch count
+derived from the packed batch's shape at trace time so the kill-switch
+slot widening re-traces instead of mis-slicing a stale count.
 
 Telemetry (docs/observability.md "Serving"): ``serving/occupancy`` gauge
 + histogram (real patches per dispatched batch slot), ``serving/

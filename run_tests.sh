@@ -215,6 +215,21 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py multichip_overlap --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "multichip overlap gate"
 
+# --- sharded replay gate ------------------------------------------------------
+# Sharded blend replay (per-slab rosters + ppermute fringe exchange)
+# vs replicated replay on the same 8-device spatial mesh, blend-
+# dominated identity proxy (docs/multichip.md "Sharded blend replay").
+# The run asserts bitwise identity of BOTH legs against the
+# single-device reference and that the sharded program landed in the
+# roofline ledger; reports the >=1.3x target as gate_pass (asserted
+# slow-marked in tests/test_bench.py); the process only fails below
+# 1.1x.
+echo "== sharded replay gate =="
+env -u PALLAS_AXON_POOL_IPS -u CHUNKFLOW_SHARD_REPLAY JAX_PLATFORMS=cpu \
+    python bench.py multichip_sharded_replay --ledger \
+    || rc=$((rc == 0 ? 1 : rc))
+stage_time "sharded replay gate"
+
 # --- fused blend gate ---------------------------------------------------------
 # Fused blend data movement (weighting + aligned-window placement + RMW in
 # one pass) vs the separate-leg structure it replaced, as compiled XLA

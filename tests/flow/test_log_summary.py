@@ -217,6 +217,62 @@ def test_print_mesh_block_spatial_shape_and_quiet_default(capsys):
     assert "shape y=2,x=2 (4 chip(s)), 1 sharded dispatch(es)" in out
 
 
+def test_print_mesh_block_pipeline_shape_and_traffic_planes(capsys):
+    """ISSUE 19: a pipeline mesh labels itself pipeline=N (not data=N),
+    the traffic line carries the replay-strip and stage-handoff planes,
+    and the collective verdict turns into a recommended-shape hint."""
+    from chunkflow_tpu.flow.log_summary import print_mesh_block
+
+    agg = {"gauges": {
+        "shard/mesh_devices": {"last": 4.0, "mean": 4.0},
+        "shard/mesh_y": {"last": 1.0, "mean": 1.0},
+        "shard/mesh_x": {"last": 1.0, "mean": 1.0},
+        "shard/mesh_pipeline": {"last": 4.0, "mean": 4.0},
+        "shard/collective_share_est": {"last": 0.8, "mean": 0.8},
+        "shard/compute_s_est": {"last": 0.0001, "mean": 0.0001},
+        "shard/collective_s_est": {"last": 0.0004, "mean": 0.0004},
+    }, "counters": {"shard/chunks": 2,
+                    "shard/halo_bytes": 1048576.0,
+                    "shard/replay_strip_bytes": 524288.0,
+                    "shard/handoff_bytes": 2097152.0}}
+    assert print_mesh_block(agg) is True
+    out = capsys.readouterr().out
+    assert "shape pipeline=4 (4 chip(s)), 2 sharded dispatch(es)" in out
+    assert "replay strips 0.50 MiB" in out
+    assert "stage handoffs 2.00 MiB" in out
+    # handoffs dominate a collective-bound pipeline: the hint says so
+    assert "shape hint: stage handoffs dominate" in out
+
+
+def test_print_mesh_block_hints_replicated_replay_and_tight_hbm(capsys):
+    """The two other hint arms: a collective-bound mesh whose gather
+    plane has no replay strips points at CHUNKFLOW_SHARD_REPLAY; a
+    compute-bound mesh with a tight chip points at the shapes that
+    shrink per-chip footprints."""
+    from chunkflow_tpu.flow.log_summary import print_mesh_block
+
+    agg = {"gauges": {
+        "shard/mesh_devices": {"last": 2.0, "mean": 2.0},
+        "shard/collective_share_est": {"last": 0.9, "mean": 0.9},
+    }, "counters": {"shard/chunks": 1,
+                    "shard/gather_bytes": 2097152.0}}
+    assert print_mesh_block(agg) is True
+    out = capsys.readouterr().out
+    assert ("shape hint: replicated replay dominates — flip "
+            "CHUNKFLOW_SHARD_REPLAY=sharded") in out
+
+    agg = {"gauges": {
+        "shard/mesh_devices": {"last": 2.0, "mean": 2.0},
+        "shard/collective_share_est": {"last": 0.1, "mean": 0.1},
+        "device/chip/1/hbm_headroom": {"last": 2.0 * 2**20,
+                                       "mean": 2.0 * 2**20},
+    }, "counters": {"shard/chunks": 1}}
+    assert print_mesh_block(agg) is True
+    out = capsys.readouterr().out
+    assert "compute-bound but chip(s) [1]" in out
+    assert "sharded replay" in out
+
+
 def test_log_summary_sweeps_profile_captures(tmp_path, capsys):
     """ISSUE 8: log-summary summarizes every profile-* capture dir under
     the metrics dir through tools/analyze_trace.py."""

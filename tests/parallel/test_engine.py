@@ -31,9 +31,13 @@ OVERLAP = (2, 8, 8)
 
 # the matrix: every engine kind and several shapes of each — mesh
 # shapes 1 (kill switch) / 2 / 4 / 8 on the data axis plus 1D and 2D
-# spatial layouts, per the ISSUE 13 acceptance grid
+# spatial layouts, per the ISSUE 13 acceptance grid; ISSUE 19 adds the
+# pipeline (stage-parallel) kind — the identity engines declare the
+# stage protocol, so the whole traffic grid covers it too. The
+# sharded (slab) blend replay is the DEFAULT, so every row below
+# exercises it; the replicated flip is pinned separately.
 MESHES = ["1", "data=2", "data=4", "data=8", "y=2", "y=4", "y=8",
-          "y=2,x=2", "y=4,x=2", "y=2,x=4"]
+          "y=2,x=2", "y=4,x=2", "y=2,x=4", "pipeline=4", "pipeline=8"]
 
 
 @pytest.fixture(scope="module")
@@ -122,10 +126,17 @@ def test_spec_grammar():
     assert parse_mesh_spec("y=1,x=1").kind == "single"
     assert parse_mesh_spec("data=8").describe() == "data=8"
     assert parse_mesh_spec("y=4,x=2").describe() == "y=4,x=2"
+    assert parse_mesh_spec("pipeline=4") == MeshSpec("pipeline", (4,))
+    assert parse_mesh_spec("pipeline=4").describe() == "pipeline=4"
+    assert parse_mesh_spec("pipeline=1").kind == "single"
     with pytest.raises(ValueError, match="bad mesh spec"):
         parse_mesh_spec("z=4")
     with pytest.raises(ValueError, match="does not compose"):
         parse_mesh_spec("data=4,y=2")
+    with pytest.raises(ValueError, match="does not compose"):
+        parse_mesh_spec("pipeline=2,y=2")
+    with pytest.raises(ValueError, match="devices"):
+        parse_mesh_spec("pipeline=16", 8)
     with pytest.raises(ValueError, match="duplicate"):
         parse_mesh_spec("y=2,y=4")
     with pytest.raises(ValueError, match="devices"):
@@ -459,7 +470,10 @@ def test_per_chip_attribution_gauges(id_engine, monkeypatch):
 
 def test_spatial_mesh_stamps_halo_bytes(id_engine, monkeypatch):
     """A 2D spatial mesh exchanges halos on both axes: the analytic
-    halo counter is non-zero and separate from the gather plane."""
+    halo counter is non-zero and separate from the replay planes. The
+    sharded replay default ships fringe windows (replay_strip_bytes)
+    instead of the full-stack all_gather; the replicated flip restores
+    the gather plane (ISSUE 19)."""
     from chunkflow_tpu.core import telemetry
 
     monkeypatch.setenv("CHUNKFLOW_MESH", "y=2,x=2")
@@ -473,10 +487,28 @@ def test_spatial_mesh_stamps_halo_bytes(id_engine, monkeypatch):
     finally:
         telemetry.reset()
     assert snap["counters"]["shard/halo_bytes"] > 0
-    assert snap["counters"]["shard/gather_bytes"] > 0
+    assert snap["counters"]["shard/replay_strip_bytes"] > 0
+    assert "shard/gather_bytes" not in snap["counters"]
+    # the analytic slab+margin blend-buffer plane, per chip too
+    assert snap["gauges"]["shard/replay_buffer_bytes"] > 0
+    assert all(snap["gauges"].get(f"shard/chip/{i}/replay_buffer_bytes")
+               for i in range(4))
     chip_vox = [snap["gauges"].get(f"shard/chip/{i}/voxels")
                 for i in range(4)]
     assert all(v is not None for v in chip_vox)
+
+    monkeypatch.setenv("CHUNKFLOW_SHARD_REPLAY", "replicated")
+    telemetry.reset()
+    try:
+        inf = make_inferencer(id_engine)
+        rng = np.random.default_rng(12)
+        np.asarray(inf(Chunk(rng.random((8, 40, 48)).astype(
+            np.float32))).array)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+    assert snap["counters"]["shard/gather_bytes"] > 0
+    assert "shard/replay_strip_bytes" not in snap["counters"]
 
 
 def test_telemetry_off_means_no_chip_probes(id_engine, monkeypatch):
@@ -591,14 +623,207 @@ def test_engine_is_graftlint_clean():
     findings, _ = lint_paths(
         [
             "chunkflow_tpu/parallel/engine.py",
+            "chunkflow_tpu/parallel/pipeline.py",
             "chunkflow_tpu/parallel/distributed.py",
             "chunkflow_tpu/parallel/spatial.py",
             "chunkflow_tpu/parallel/spatial2d.py",
             "chunkflow_tpu/parallel/multihost.py",
             "chunkflow_tpu/serve/packer.py",
+            "chunkflow_tpu/inference/precision.py",
+            "chunkflow_tpu/ops/blend.py",
         ],
         config, repo_root=repo_root,
     )
     assert not findings, [
         f"{f.path}:{f.line}: {f.code} {f.message}" for f in findings
     ]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: sharded blend replay + the pipeline kind
+# ---------------------------------------------------------------------------
+def test_replay_mode_flip_bitwise_and_distinct_keys(id_engine,
+                                                    monkeypatch):
+    """CHUNKFLOW_SHARD_REPLAY is re-read per chunk: flipping a live
+    inferencer between the sharded default and the replicated replay
+    rebuilds the program (distinct cache keys — the 'replay-replicated'
+    tag) and stays bit-identical."""
+    rng = np.random.default_rng(21)
+    chunk = rng.random((6, 37, 45)).astype(np.float32)
+    ref = np.asarray(make_inferencer(id_engine)(Chunk(chunk.copy()))
+                     .array)
+    monkeypatch.setenv("CHUNKFLOW_MESH", "y=2,x=2")
+    inf = make_inferencer(id_engine)
+    out_sharded = np.asarray(inf(Chunk(chunk.copy())).array)
+    monkeypatch.setenv("CHUNKFLOW_SHARD_REPLAY", "replicated")
+    out_replicated = np.asarray(inf(Chunk(chunk.copy())).array)
+    assert np.array_equal(out_sharded, ref)
+    assert np.array_equal(out_replicated, ref)
+    shard_keys = [k for k, _ in inf._programs.items() if k[0] == "shard"]
+    assert len(shard_keys) == 2, shard_keys
+    assert sum("replay-replicated" in k for k in shard_keys) == 1, \
+        shard_keys
+
+
+def test_pipeline_mesh_needs_staged_engine(conv_engine):
+    """A pipeline mesh over an engine that never declared the stage
+    protocol fails loudly (no silent fallback to an unpipelined
+    program): the flax conv engine is opaque."""
+    rng = np.random.default_rng(22)
+    chunk = Chunk(rng.random((6, 37, 45)).astype(np.float32))
+    inf = make_inferencer(conv_engine, mesh="pipeline=4")
+    with pytest.raises(ValueError, match="stage protocol"):
+        inf(chunk)
+
+
+def test_stage_groups_contiguous_and_balanced():
+    """parallel/pipeline.stage_groups: contiguous balanced groups,
+    later stages absorb the remainder, composition order preserved."""
+    from chunkflow_tpu.parallel.pipeline import (
+        require_stages,
+        stage_groups,
+    )
+
+    trace = []
+
+    def body(tag):
+        def run(params, x):
+            trace.append(tag)
+            return x + 1
+
+        return run
+
+    groups = stage_groups(tuple(body(i) for i in range(5)), 3)
+    assert len(groups) == 3
+    x = 0
+    for g in groups:
+        x = g(None, x)
+    assert x == 5
+    # contiguous order, remainder on the LATER stages: 1 + 2 + 2
+    assert trace == [0, 1, 2, 3, 4]
+    trace.clear()
+    groups[0](None, 0)
+    assert trace == [0]
+    trace.clear()
+    groups[2](None, 0)
+    assert trace == [3, 4]
+    # more stages than bodies: the extra stages are the identity
+    groups = stage_groups((body("only"),), 4)
+    assert len(groups) == 4 and groups[0](None, 7) == 7
+    with pytest.raises(ValueError, match="stage protocol"):
+        require_stages(None, None, "test context")
+
+
+def test_pipeline_packed_serving_bitwise(id_engine, monkeypatch):
+    """The serving seam over a pipeline mesh: packed batches stream
+    through the staged ring and stay bit-identical to the per-chunk
+    path (the serving acceptance row of ISSUE 19)."""
+    from chunkflow_tpu.serve.packer import PatchPacker
+
+    rng = np.random.default_rng(23)
+    inf = Inferencer(
+        input_patch_size=PIN,
+        output_patch_overlap=(0, 0, 0),
+        num_output_channels=3,
+        framework="prebuilt",
+        batch_size=2,
+        engine=id_engine,
+        crop_output_margin=False,
+    )
+    chunks = [
+        Chunk(rng.random((4, 16, 48)).astype(np.float32),
+              voxel_offset=(4 * i, 0, 0))
+        for i in range(6)
+    ]
+    monkeypatch.setenv("CHUNKFLOW_MESH", "1")
+    refs = [np.asarray(inf(c).array) for c in chunks]
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "pipeline=4")
+    packer = PatchPacker(inf, max_wait_ms=25.0)
+    try:
+        handles = [packer.submit(c) for c in chunks]
+        outs = [np.asarray(h.result(timeout=120).array)
+                for h in handles]
+    finally:
+        packer.close()
+    for ref, out in zip(refs, outs):
+        assert np.array_equal(out, ref)
+    serve_keys = [k for k, _ in inf._programs.items()
+                  if k[0] == "serve_forward"]
+    assert any("pipeline" in k for k in serve_keys), serve_keys
+
+
+def test_sharded_replay_under_pallas_interpret(id_engine, monkeypatch):
+    """The kernelcheck/interpret leg covers the sharded replay path:
+    with CHUNKFLOW_PALLAS=interpret the slab+margin replay runs the
+    fused Pallas accumulation kernel (interpreted) and still matches
+    the interpreted single-device program bitwise."""
+    monkeypatch.setenv("CHUNKFLOW_PALLAS", "interpret")
+    rng = np.random.default_rng(24)
+    chunk = rng.random((6, 37, 45)).astype(np.float32)
+    ref = np.asarray(make_inferencer(id_engine)(Chunk(chunk.copy()))
+                     .array)
+    for mesh in ("y=2,x=2", "pipeline=4"):
+        out = np.asarray(
+            make_inferencer(id_engine, mesh=mesh)(Chunk(chunk.copy()))
+            .array
+        )
+        assert np.array_equal(out, ref), mesh
+
+
+def test_replay_buffer_hbm_shrinks_to_slab_plus_halo(id_engine,
+                                                     monkeypatch):
+    """The HBM acceptance criterion: the sharded replay's per-chip
+    blend buffer is slab+margin, not full-chunk. The analytic plane
+    (shard/replay_buffer_bytes + the per-chip mirror) must match the
+    slab+margin formula exactly and undercut the full-chunk figure;
+    when the backend's memory_stats watermark plane reports (PR 18),
+    the measured per-chip peak must also stay under the replicated
+    run's peak-plus-full-buffer bound — guarded, since CPU backends
+    may not report."""
+    from chunkflow_tpu.core import telemetry
+    from chunkflow_tpu.parallel.engine import axis_geometry
+
+    monkeypatch.setenv("CHUNKFLOW_MESH", "y=4,x=2")
+    # big enough that slab+margin genuinely undercuts the full chunk
+    # (the margins are a fixed two output patches per sharded axis)
+    z, y, x = 8, 120, 96
+    telemetry.reset()
+    try:
+        inf = make_inferencer(id_engine)
+        rng = np.random.default_rng(25)
+        np.asarray(inf(Chunk(rng.random((z, y, x)).astype(
+            np.float32))).array)
+        gauges = telemetry.snapshot()["gauges"]
+    finally:
+        telemetry.reset()
+    co = 3
+    yslab = axis_geometry(y, 4, PIN[1], PIN[1])[0]
+    xslab = axis_geometry(x, 2, PIN[2], PIN[2])[0]
+    # margins are one output patch on each boundary-facing side
+    expected = (co + 1) * z * (yslab + 2 * PIN[1]) \
+        * (xslab + 2 * PIN[2]) * 4
+    full_chunk = (co + 1) * z * y * x * 4
+    assert gauges["shard/replay_buffer_bytes"] == float(expected)
+    assert expected < full_chunk
+    for i in range(8):
+        assert gauges[f"shard/chip/{i}/replay_buffer_bytes"] == float(
+            expected)
+    # guarded watermark cross-check: when the backend reports
+    # memory_stats, the per-chip measured peak exists alongside
+    try:
+        import jax as _jax
+
+        stats = _jax.local_devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("peak_bytes_in_use"):
+        from chunkflow_tpu.flow import scheduler
+
+        telemetry.reset()
+        try:
+            scheduler.sample_device_memory()
+            g = telemetry.snapshot()["gauges"]
+            assert g.get("device/chip/0/peak_bytes", 0) > 0
+        finally:
+            telemetry.reset()

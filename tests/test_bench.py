@@ -908,3 +908,58 @@ def test_multichip_overlap_microbench(tmp_path):
     with open(programs) as f:
         entries = json.load(f)["programs"]
     assert any(e.get("family") == "shard" for e in entries), entries
+
+
+@pytest.mark.bench
+@pytest.mark.slow
+def test_multichip_sharded_replay_microbench(tmp_path):
+    """Sharded blend replay must beat replicated replay on the same
+    8-device spatial mesh (ISSUE 19 acceptance: >= 1.3x soft, 1.1x
+    hard) and stay bit-identical — run_multichip_sharded_replay itself
+    raises on any divergence of either leg from the single-device
+    reference, and on the sharded program missing from the roofline
+    ledger.
+
+    The measured win is TOTAL replay work removed (replicated replays
+    every window on every chip; sharded replays each chip's slab roster
+    once), so it holds on the 1-core CI box without calibrated sleeps.
+    Fresh-subprocess + best-of-3 pattern shared with the other ratio
+    gates (bench.py forces its own 8-device XLA_FLAGS)."""
+    import os
+    import subprocess
+    import sys
+
+    bench_py = os.path.join(os.path.dirname(bench.__file__), "bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CHUNKFLOW_BENCH_METRICS_DIR=str(tmp_path))
+    env.pop("XLA_FLAGS", None)
+    env.pop("CHUNKFLOW_MESH", None)
+    env.pop("CHUNKFLOW_SHARD_REPLAY", None)
+    best = None
+    for _ in range(3):
+        proc = subprocess.run(
+            [sys.executable, bench_py, "multichip_sharded_replay"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        stats = json.loads(proc.stdout.strip().splitlines()[-1])
+        if best is None or stats["value"] > best["value"]:
+            best = stats
+        if best["value"] >= 1.3:
+            break
+    assert best["metric"] == "multichip_sharded_replay"
+    assert best["value"] >= 1.1, best  # hard floor
+    assert best["gate_pass"] is True, best  # soft 1.3x gate
+    assert best["bit_identical"] is True, best
+    assert best["in_roofline_ledger"] is True, best
+    assert best["n_devices"] == 8, best
+    # three program builds — single reference, replicated-replay shard,
+    # sharded-replay shard — each reused across every later dispatch
+    # (the compile-cache invariant: the replay mode is part of the key)
+    assert best["cache_builds"] == 3, best
+    # the sharded program catalog landed in programs.json (PR 8 ledger)
+    programs = os.path.join(tmp_path, "programs.json")
+    assert os.path.exists(programs), os.listdir(tmp_path)
+    with open(programs) as f:
+        entries = json.load(f)["programs"]
+    assert any(e.get("family") == "shard" for e in entries), entries

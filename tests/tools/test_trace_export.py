@@ -147,6 +147,63 @@ def test_validator_flags_broken_traces():
 
 
 # ---------------------------------------------------------------------------
+# per-chip counter tracks (ISSUE 19: shard/chip/<i>/* gauges)
+# ---------------------------------------------------------------------------
+def test_export_chip_gauges_render_as_per_chip_tracks():
+    """``<plane>/chip/<i>/<metric>`` gauges get their own ``chip <i>``
+    thread track per worker (so a mesh run shows replay-buffer bytes /
+    HBM watermarks side by side per chip), while plain gauges stay on
+    the global tid-0 track."""
+    events = [
+        {"kind": "gauge", "name": "shard/chip/0/replay_buffer_bytes",
+         "t": 10.0, "value": 4096.0, "worker": "wa"},
+        {"kind": "gauge", "name": "shard/chip/1/replay_buffer_bytes",
+         "t": 10.0, "value": 4096.0, "worker": "wa"},
+        {"kind": "gauge", "name": "device/chip/1/hbm_headroom",
+         "t": 10.5, "value": 1e9, "worker": "wa"},
+        {"kind": "gauge", "name": "shard/n_devices", "t": 10.0,
+         "value": 2.0, "worker": "wa"},
+    ]
+    trace = export_chrome_trace(events)
+    assert validate_chrome_trace(trace) == []
+    counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+    chip = [e for e in counters if e["cat"] == "chip_gauge"]
+    plain = [e for e in counters if e["cat"] == "gauge"]
+    # chip prefix stripped from the counter name, chip carried as an arg
+    assert {e["name"] for e in chip} == {"shard/replay_buffer_bytes",
+                                         "device/hbm_headroom"}
+    assert {e["args"]["chip"] for e in chip} == {0, 1}
+    # per-chip samples land on distinct non-global tracks...
+    assert all(e["tid"] != 0 for e in chip)
+    by_chip = {}
+    for e in chip:
+        by_chip.setdefault(e["args"]["chip"], set()).add(e["tid"])
+    assert by_chip[0].isdisjoint(by_chip[1])
+    # ...named "chip <i>" in the thread metadata
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"chip 0", "chip 1"} <= names
+    # the plain gauge stays on the global track
+    assert plain and all(e["tid"] == 0 for e in plain)
+
+
+def test_validator_flags_broken_chip_tracks():
+    problems = validate_chrome_trace({"traceEvents": [
+        {"ph": "C", "name": "shard/replay_buffer_bytes",
+         "cat": "chip_gauge", "pid": 1, "tid": 3, "ts": 0.0,
+         "args": {"value": 1.0}},  # no chip arg
+        {"ph": "C", "name": "shard/replay_buffer_bytes",
+         "cat": "chip_gauge", "pid": 1, "tid": 4, "ts": 0.0,
+         "args": {"value": 1.0, "chip": 0}},
+        {"ph": "C", "name": "shard/replay_buffer_bytes",
+         "cat": "chip_gauge", "pid": 1, "tid": 4, "ts": 1.0,
+         "args": {"value": 1.0, "chip": 1}},  # same track, other chip
+    ]})
+    assert any("integer chip arg" in p for p in problems)
+    assert any("mixes chips 0 and 1" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
 # loader round trip: rotated generations + torn tail (satellite)
 # ---------------------------------------------------------------------------
 def test_export_metrics_dir_rotations_and_torn_tail(tmp_path):

@@ -1000,6 +1000,84 @@ def bench_multichip():
     }
 
 
+@step("bench_sharded_replay")
+def bench_sharded_replay():
+    """Sharded blend replay (ISSUE 19) on a real slice: replicated vs
+    sharded replay Mvox/s on a spatial ``y=<n_dev>`` mesh through the
+    production Inferencer with the flagship config, plus a
+    bitwise-identity check of both legs against each other. On a
+    single-chip tunnel the row records the skip (an honest "needs a
+    slice"); bitwise parity is already covered on the 8-device virtual
+    mesh in tier-1 and bench.py multichip_sharded_replay measures the
+    replay-work ratio on the CPU proxy."""
+    import numpy as np
+
+    import jax
+
+    import bench
+    from chunkflow_tpu.chunk.base import Chunk
+    from chunkflow_tpu.inference import Inferencer
+
+    os.environ["CHUNKFLOW_PALLAS"] = "0"
+    os.environ.pop("CHUNKFLOW_BLEND_STACKED", None)
+    n_dev = jax.local_device_count()
+    if n_dev < 2:
+        return {
+            "skipped": True,
+            "n_devices": n_dev,
+            "note": (
+                "single-chip tunnel: sharded-vs-replicated replay needs "
+                "a slice; bitwise parity is covered on the 8-device "
+                "virtual mesh in tier-1 (tests/parallel/test_engine.py) "
+                "and the replay-work ratio on the CPU proxy (bench.py "
+                "multichip_sharded_replay)"
+            ),
+        }
+    mesh_spec = f"y={n_dev}"
+    rng = np.random.default_rng(0)
+    chunk = Chunk(rng.random(bench.CHUNK_SIZE, dtype=np.float32))
+    prev_replay = os.environ.get("CHUNKFLOW_SHARD_REPLAY")
+
+    def leg(replay_mode):
+        os.environ["CHUNKFLOW_SHARD_REPLAY"] = replay_mode
+        inferencer = Inferencer(
+            input_patch_size=bench.INPUT_PATCH,
+            output_patch_overlap=bench.OUTPUT_OVERLAP,
+            num_output_channels=bench.NUM_OUT,
+            framework="flax",
+            batch_size=4,
+            dtype="bfloat16",
+            model_variant="tpu",
+            mesh=mesh_spec,
+            crop_output_margin=False,
+        )
+        out = np.asarray(inferencer(chunk).array)  # warm (compile)
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = np.asarray(inferencer(chunk).array)
+            times.append(time.perf_counter() - t0)
+        mvox = float(np.prod(bench.CHUNK_SIZE)) / min(times) / 1e6
+        return mvox, out
+
+    try:
+        replicated_mvox, ref = leg("replicated")
+        sharded_mvox, out = leg("sharded")
+    finally:
+        if prev_replay is None:
+            os.environ.pop("CHUNKFLOW_SHARD_REPLAY", None)
+        else:
+            os.environ["CHUNKFLOW_SHARD_REPLAY"] = prev_replay
+    return {
+        "mvox_s": round(sharded_mvox, 3),
+        "replicated_mvox_s": round(replicated_mvox, 3),
+        "speedup": round(sharded_mvox / replicated_mvox, 2),
+        "mesh": mesh_spec,
+        "n_devices": n_dev,
+        "bit_identical": bool(np.array_equal(ref, out)),
+    }
+
+
 @step("entry_compile")
 def entry_compile():
     # pin the blend-kernel selection to auto (platform default) so the
@@ -1128,6 +1206,9 @@ def main():
              bench_multichip,  # unified-engine slice row (ISSUE 13):
              # cheap skip on a single-chip tunnel, the first real
              # multi-chip throughput number when a slice window opens
+             bench_sharded_replay,  # sharded-vs-replicated replay A/B
+             # in ONE row (ISSUE 19): the per-chip blend-HBM + replay-
+             # work measurement; cheap skip on a single-chip tunnel
              entry_compile]
     # NOTE: jax caches backend-init failure in-process, so a failed tunnel
     # cannot be retried here — rerun the whole script (fresh process) after

@@ -59,6 +59,7 @@ except ImportError:  # direct script run from anywhere: add the repo root
         load_telemetry_dir,
         worker_clock_offsets,
     )
+from chunkflow_tpu.core.telemetry import CHIP_METRIC_RE
 
 #: JSONL event kinds that render as instant markers on their plane track
 _INSTANT_KINDS = (
@@ -137,11 +138,31 @@ def export_chrome_trace(events: List[dict]) -> dict:
                 "args": _args_of(record),
             })
         elif kind == "gauge":
-            out.append({
-                "ph": "C", "name": name, "cat": "gauge",
-                "pid": pid, "tid": 0, "ts": ts_us(record),
-                "args": {"value": float(record.get("value", 0.0))},
-            })
+            chip_match = CHIP_METRIC_RE.match(name)
+            if chip_match:
+                # per-chip gauges (``<plane>/chip/<i>/<metric>``, ISSUE
+                # 19) render on a ``chip <i>`` thread track inside their
+                # worker, one counter per metric — so a mesh run shows
+                # replay-buffer bytes / HBM watermarks side by side per
+                # chip instead of interleaved on the global gauge track
+                chip = int(chip_match.group("chip"))
+                out.append({
+                    "ph": "C",
+                    "name": (f"{chip_match.group('plane')}/"
+                             f"{chip_match.group('metric')}"),
+                    "cat": "chip_gauge",
+                    "pid": pid,
+                    "tid": tid_of(worker, f"chip {chip}"),
+                    "ts": ts_us(record),
+                    "args": {"value": float(record.get("value", 0.0)),
+                             "chip": chip},
+                })
+            else:
+                out.append({
+                    "ph": "C", "name": name, "cat": "gauge",
+                    "pid": pid, "tid": 0, "ts": ts_us(record),
+                    "args": {"value": float(record.get("value", 0.0))},
+                })
         elif kind == "snapshot":
             for cname, value in (record.get("counters") or {}).items():
                 out.append({
@@ -222,13 +243,17 @@ def validate_chrome_trace(trace: dict) -> List[str]:
     * every flow id is paired — exactly one ``s``, at least one ``f``,
       and no step/finish earlier than its start (monotone chains);
     * ``cumulative`` counter tracks are monotone non-decreasing per
-      (pid, name)."""
+      (pid, name);
+    * ``chip_gauge`` counters (per-chip tracks, ISSUE 19) carry a
+      non-negative integer ``chip`` arg, and one thread track never
+      mixes samples from two different chips."""
     problems: List[str] = []
     events = trace.get("traceEvents")
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
     flows: Dict[object, Dict[str, list]] = {}
     counters: Dict[Tuple[object, str], List[Tuple[float, float]]] = {}
+    chip_tracks: Dict[Tuple[object, object], int] = {}
     for i, event in enumerate(events):
         for field in ("pid", "tid", "ts"):
             if not isinstance(event.get(field), (int, float)):
@@ -249,6 +274,20 @@ def validate_chrome_trace(trace: dict) -> List[str]:
             elif event.get("cat") == "cumulative":
                 counters.setdefault(key, []).append(
                     (float(event.get("ts", 0.0)), float(value)))
+            if event.get("cat") == "chip_gauge":
+                chip = (event.get("args") or {}).get("chip")
+                if not isinstance(chip, int) or chip < 0:
+                    problems.append(
+                        f"event {i}: chip_gauge counter "
+                        f"{event.get('name')!r} without a non-negative "
+                        f"integer chip arg")
+                    continue
+                track = (event.get("pid"), event.get("tid"))
+                seen = chip_tracks.setdefault(track, chip)
+                if seen != chip:
+                    problems.append(
+                        f"chip track pid={track[0]} tid={track[1]} "
+                        f"mixes chips {seen} and {chip}")
     for flow_id, entry in flows.items():
         if len(entry["s"]) != 1 or not entry["f"]:
             problems.append(
