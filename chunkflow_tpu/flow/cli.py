@@ -706,6 +706,7 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times,
                     raise
                 t["queue"] = queue
                 t["task_handle"] = lc.handle
+                t["task_body"] = lc.body
                 t["lifecycle"] = lc
                 t["trace_id"] = lc.trace_id
                 lc.task = t
@@ -730,6 +731,7 @@ def fetch_task_cmd(queue_name, visibility_timeout, retry_times,
                 t["bbox"] = BoundingBox.from_string(body)
                 t["queue"] = queue
                 t["task_handle"] = handle
+                t["task_body"] = body
                 t["trace_id"] = queue.trace_id(handle)
                 yield t
                 if consume_budget():
@@ -3346,6 +3348,165 @@ def evaluate_segmentation_cmd(op_name, segmentation_chunk_name,
         return task
 
     return stage(_name=op_name)
+
+
+# ---------------------------------------------------------------------------
+# whole-volume segmentation plane (chunkflow_tpu/segment/,
+# docs/segmentation.md)
+# ---------------------------------------------------------------------------
+def _segment_stage_cmd(kind: str, seg_dir: str, op_name: str):
+    """One worker stage of the stitching job: execute queue bodies of
+    ``kind`` against the job directory's store, pass every other task
+    through untouched (so one worker pipeline chains all three stages
+    and handles whatever the tree source emits)."""
+    from chunkflow_tpu.segment.driver import open_store
+    from chunkflow_tpu.segment.plan import SegmentPlan
+    from chunkflow_tpu.segment.stages import execute_body
+
+    cache = {}
+
+    @operator
+    def stage(task):
+        body = task.get("task_body")
+        if body is None:
+            return task
+        parsed = SegmentPlan.parse_body(body)
+        if parsed is None or parsed[0] != kind:
+            return task
+        if "store" not in cache:  # one store per worker process
+            cache["store"] = open_store(seg_dir)
+        execute_body(cache["store"], body)
+        return task
+
+    return stage(_name=op_name)
+
+
+@main.command("label-chunk")
+@name_option("label-chunk")
+@click.option("--seg-dir", "-d", type=str, required=True,
+              help="segmentation job directory (init-ed by segment-volume)")
+def label_chunk_cmd(op_name, seg_dir):
+    """Map stage 1 of the stitching job: handle ``seg-label_<bbox>``
+    queue tasks (label one chunk into the global id space + write its
+    boundary face sidecars)."""
+    return _segment_stage_cmd("label", seg_dir, op_name)
+
+
+@main.command("merge-seg")
+@name_option("merge-seg")
+@click.option("--seg-dir", "-d", type=str, required=True,
+              help="segmentation job directory (init-ed by segment-volume)")
+def merge_seg_cmd(op_name, seg_dir):
+    """Reduce stage of the stitching job: handle ``seg-merge_<bbox>``
+    queue tasks (one tree node's cross-chunk equivalence merge)."""
+    return _segment_stage_cmd("merge", seg_dir, op_name)
+
+
+@main.command("relabel")
+@name_option("relabel")
+@click.option("--seg-dir", "-d", type=str, required=True,
+              help="segmentation job directory (init-ed by segment-volume)")
+def relabel_cmd(op_name, seg_dir):
+    """Map stage 2 of the stitching job: handle ``seg-relabel_<bbox>``
+    queue tasks (apply the global remap to one chunk, mesh if
+    configured)."""
+    return _segment_stage_cmd("relabel", seg_dir, op_name)
+
+
+@main.command("segment-volume")
+@click.option("--input-npy", "-i", type=str, required=True,
+              help="source volume (.npy): probability map, binary mask "
+                   "or multi-valued ids")
+@click.option("--seg-dir", "-d", type=str, required=True,
+              help="job directory: spec.json + KV label volume + "
+                   "face/merge/remap sidecars")
+@cartesian_option("--chunk-size", "-c", required=True,
+                  help="grid chunk size (zyx)")
+@click.option("--threshold", "-t", type=float, default=0.5)
+@click.option("--connectivity", type=click.Choice(["6", "18", "26"]),
+              default="26")
+@click.option("--multivalue/--binary", default=False,
+              help="treat the input as multi-valued ids (equal-value "
+                   "connectivity) instead of thresholded/binary")
+@click.option("--device/--host", default=False,
+              help="label chunks on the accelerator "
+                   "(ops/connected_components.label_binary_device)")
+@click.option("--workers", "-w", type=int, default=4,
+              help="local mode: labeling/relabel thread fan-out")
+@click.option("--mesh-output", type=str, default=None,
+              help="also mesh the merged labels into this directory "
+                   "(fragments carry global ids: no chunk-seam splits)")
+@click.option("--queue-name", "-q", type=str, default=None,
+              help="coordinator mode: pump the task tree into this queue "
+                   "instead of executing locally (requires --ledger)")
+@click.option("--ledger", type=str, default=None,
+              help="coordinator mode: completion ledger the workers "
+                   "commit to (children's commits unlock parent merges)")
+@click.option("--timeout", type=float, default=None,
+              help="coordinator mode: give up after this many seconds")
+def segment_volume_cmd(input_npy, seg_dir, chunk_size, threshold,
+                       connectivity, multivalue, device, workers,
+                       mesh_output, queue_name, ledger, timeout):
+    """Whole-volume segmentation with exact cross-chunk stitching.
+
+    Local mode (default): label every chunk, merge bottom-up over the
+    spatial task tree, relabel — all in this process. Coordinator mode
+    (--queue-name + --ledger): enqueue the same work as queue tasks for
+    ``fetch-task-from-queue`` workers chaining ``label-chunk``,
+    ``merge-seg`` and ``relabel`` stages, and wait for the ledger.
+    """
+    from chunkflow_tpu.parallel.lifecycle import open_ledger
+    from chunkflow_tpu.parallel.queues import open_queue
+    from chunkflow_tpu.segment.driver import (
+        init_store,
+        run_coordinator,
+        run_local,
+    )
+
+    @generator
+    def stage(task):
+        store = init_store(
+            seg_dir,
+            input_npy,
+            chunk_size,
+            threshold=threshold,
+            connectivity=int(connectivity),
+            multivalue=multivalue,
+            device=device,
+            mesh_dir=mesh_output,
+        )
+        if queue_name is not None:
+            if ledger is None:
+                raise click.UsageError(
+                    "coordinator mode needs --ledger: children's ledger "
+                    "commits are what unlock the parent merges"
+                )
+            summary = run_coordinator(
+                store,
+                open_queue(queue_name),
+                open_ledger(ledger),
+                timeout=timeout,
+            )
+            print(
+                f"segment-volume: coordinated {summary['tree_tasks']} "
+                f"tree task(s) + {summary['relabel_tasks']} relabel "
+                f"task(s) over {len(store.plan.chunks)} chunk(s)"
+            )
+        else:
+            summary = run_local(store, workers=workers)
+            print(
+                f"segment-volume: {summary['chunks']} chunk(s) labeled, "
+                f"{summary['merge_nodes']} merge node(s), relabeled in "
+                f"place under {seg_dir}"
+            )
+        if mesh_output is not None:
+            from chunkflow_tpu.flow.mesh import write_manifests
+
+            write_manifests(mesh_output)
+        return
+        yield  # pragma: no cover
+
+    return stage()
 
 
 if __name__ == "__main__":

@@ -68,6 +68,18 @@ STORAGE_COUNTERS = (
     "storage/evictions",
 )
 
+#: segmentation-plane counters (chunkflow_tpu/segment/,
+#: docs/segmentation.md), reported as their own block: for a stitching
+#: job, "how many chunks labeled, faces moved, equivalence edges found
+#: and voxels rewritten" is the whole map -> reduce -> map story in five
+#: numbers — a run whose edges_found is zero on a connected volume has
+#: a face-exchange bug, not a labeling bug
+SEGMENT_COUNTERS = (
+    "segment/chunks_labeled", "segment/faces_written",
+    "segment/faces_exchanged", "segment/edges_found",
+    "segment/merges_applied", "segment/voxels_relabeled",
+)
+
 #: serving-plane counters (chunkflow_tpu/serve/, docs/serving.md),
 #: reported as their own block: under request traffic, "how many
 #: requests were admitted / shed / late and how full the device batches
@@ -613,6 +625,36 @@ def print_serving_block(agg: dict, indent: str = "") -> bool:
     return True
 
 
+def print_segment_block(agg: dict, indent: str = "") -> bool:
+    """The SEGMENT block (docs/segmentation.md): the map -> reduce ->
+    map counters of a whole-volume stitching job. Quiet (returns False)
+    for runs that never labeled a chunk."""
+    segment = {
+        name: agg["counters"][name]
+        for name in SEGMENT_COUNTERS if agg["counters"].get(name)
+    }
+    if not segment:
+        return False
+    print(f"{indent}segment (docs/segmentation.md):")
+    for name in SEGMENT_COUNTERS:
+        if name in segment:
+            print(f"{indent}  {name:<28} {segment[name]:>7g}")
+    labeled = segment.get("segment/chunks_labeled", 0)
+    relabeled = segment.get("segment/voxels_relabeled", 0)
+    parts = []
+    if labeled:
+        parts.append(f"{labeled:g} chunk(s) labeled")
+    if segment.get("segment/edges_found"):
+        parts.append(
+            f"{segment['segment/edges_found']:g} cross-chunk edge(s)"
+        )
+    if relabeled:
+        parts.append(f"{relabeled:g} voxel(s) rewritten")
+    if parts:
+        print(f"{indent}  -> " + ", ".join(parts))
+    return True
+
+
 def print_storage_block(agg: dict, indent: str = "") -> bool:
     """The STORAGE block (docs/storage.md): block cache hit rate, bytes
     moved, and the aligned/unaligned write split. Quiet (returns False)
@@ -922,6 +964,7 @@ def print_telemetry_summary(metrics_dir: str) -> Optional[dict]:
                 "  -> dead-lettered tasks pending triage: inspect with "
                 "`chunkflow dead-letter -q <queue>`"
             )
+    print_segment_block(agg)
     print_storage_block(agg)
     print_serving_block(agg)
     fleet = {

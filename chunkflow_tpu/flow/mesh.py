@@ -16,6 +16,7 @@ from __future__ import annotations
 import json
 import os
 import struct
+import threading
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -176,13 +177,13 @@ class MeshOperator:
                 )
             if self.output_format == "precomputed":
                 frag = f"{obj_id}:0:{bbox_str}"
-                with open(os.path.join(self.output_path, frag), "wb") as f:
+                fpath = os.path.join(self.output_path, frag)
+                tmp = f"{fpath}.tmp-{os.getpid()}-{threading.get_ident()}"
+                with open(tmp, "wb") as f:
                     f.write(to_precomputed_bytes(vertices, faces))
+                os.replace(tmp, fpath)
                 if self.manifest:
-                    with open(
-                        os.path.join(self.output_path, f"{obj_id}:0"), "w"
-                    ) as f:
-                        json.dump({"fragments": [frag]}, f)
+                    self._write_manifest(obj_id)
             elif self.output_format == "obj":
                 path = os.path.join(self.output_path, f"{obj_id}_{bbox_str}.obj")
                 with open(path, "w") as f:
@@ -192,6 +193,37 @@ class MeshOperator:
                 with open(path, "w") as f:
                     f.write(to_ply(vertices, faces))
         return len(meshes)
+
+    # all MeshOperator instances in a process share the lock: distinct
+    # relabel tasks meshing the same cross-chunk object concurrently
+    # must not interleave the list-then-write below
+    _manifest_lock = threading.Lock()
+
+    def _write_manifest(self, obj_id) -> None:
+        """Regenerate ``{obj_id}:0`` from the fragment files on disk.
+
+        The manifest is DERIVED state — a pure function of the
+        ``<id>:0:<bbox>`` fragments present — so re-meshing any chunk
+        rewrites it byte-identically (replay-idempotent), an object
+        spanning several chunks accumulates one fragment per chunk
+        (cross-chunk objects matter once labels are stitched,
+        segment/stages.py), and the atomic replace means a concurrent
+        reader never sees torn JSON. Cross-process, a manifest written
+        while another worker adds a fragment may momentarily omit it;
+        the post-hoc `write_manifests` sweep (which segment-volume runs
+        after the job) is the authoritative aggregation.
+        """
+        prefix = f"{obj_id}:0:"
+        with self._manifest_lock:
+            frags = sorted(
+                name for name in os.listdir(self.output_path)
+                if name.startswith(prefix) and ".tmp-" not in name
+            )
+            mpath = os.path.join(self.output_path, f"{obj_id}:0")
+            tmp = f"{mpath}.tmp-{os.getpid()}-{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                json.dump({"fragments": frags}, f)
+            os.replace(tmp, mpath)
 
 
 def write_manifests(mesh_dir: str, id_prefix: str = None) -> int:
@@ -205,7 +237,7 @@ def write_manifests(mesh_dir: str, id_prefix: str = None) -> int:
     fragments: Dict[str, list] = {}
     for name in os.listdir(mesh_dir):
         parts = name.split(":")
-        if len(parts) == 3 and parts[1] == "0":
+        if len(parts) == 3 and parts[1] == "0" and ".tmp-" not in name:
             if id_prefix and not parts[0].startswith(id_prefix):
                 continue
             fragments.setdefault(parts[0], []).append(name)

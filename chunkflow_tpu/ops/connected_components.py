@@ -48,12 +48,30 @@ def label_binary(binary: np.ndarray, connectivity: int = 26) -> np.ndarray:
     return labels.astype(np.uint32)
 
 
-def label_multivalue(arr: np.ndarray, connectivity: int = 26) -> np.ndarray:
-    """Label each distinct-value region separately (cc3d semantics)."""
-    native = _native()
-    if native is not None:
-        labels, _ = native.connected_components(arr, connectivity)
-        return labels
+def _half_offsets(connectivity: int):
+    """The lexicographically-positive half of the 3D neighborhood — one
+    shifted comparison per offset covers every neighbor pair once."""
+    offsets = []
+    for dz in (0, 1):
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if (dz, dy, dx) <= (0, 0, 0):
+                    continue
+                order = abs(dz) + abs(dy) + abs(dx)
+                if connectivity == 6 and order > 1:
+                    continue
+                if connectivity == 18 and order > 2:
+                    continue
+                offsets.append((dz, dy, dx))
+    return offsets
+
+
+def _label_multivalue_loop(
+    arr: np.ndarray, connectivity: int = 26
+) -> np.ndarray:
+    """The original O(unique-values) implementation — one scipy pass per
+    distinct id. Kept as the parity oracle for :func:`label_multivalue`
+    (tests/ops/test_connected_components.py); do not use on real data."""
     out = np.zeros(arr.shape, dtype=np.uint32)
     next_id = 0
     structure = _structure(connectivity)
@@ -65,6 +83,111 @@ def label_multivalue(arr: np.ndarray, connectivity: int = 26) -> np.ndarray:
         out[mask] = labels[mask] + next_id
         next_id += num
     return out
+
+
+def label_multivalue(arr: np.ndarray, connectivity: int = 26) -> np.ndarray:
+    """Label each distinct-value region separately (cc3d semantics).
+
+    Single scipy pass over the nonzero mask, independent of how many
+    distinct values the volume holds (the old per-value loop re-scanned
+    the whole array once per id — O(unique-values) full passes):
+
+    1. label the nonzero support once;
+    2. mask-components whose voxels all share one value are already
+       equal-value components;
+    3. only *mixed* components (several input values fused by mere
+       adjacency) are split further, by a vectorized union-find over
+       their equal-value neighbor edges.
+
+    Output ids are bitwise-identical to the per-value loop: components
+    are numbered 1..N in (value ascending, then first-voxel raster
+    index) order, which is exactly the order the loop emitted (values
+    via np.unique, scipy component ids raster-first within each value).
+    """
+    native = _native()
+    if native is not None:
+        labels, _ = native.connected_components(arr, connectivity)
+        return labels
+    out = np.zeros(arr.shape, dtype=np.uint32)
+    mask = arr != 0
+    if not mask.any():
+        return out
+    comp, num = ndimage.label(mask, structure=_structure(connectivity))
+    flat_vals = arr.ravel()
+    flat_comp = comp.ravel()
+    nz = np.flatnonzero(flat_comp)
+    comps_nz = flat_comp[nz]
+    vals_nz = flat_vals[nz]
+
+    # per-mask-component value range + first voxel, native dtype (no
+    # float round-trip through ndimage reductions)
+    vmin = np.full(num + 1, vals_nz.max(), dtype=arr.dtype)
+    vmax = np.full(num + 1, vals_nz.min(), dtype=arr.dtype)
+    first = np.full(num + 1, arr.size, dtype=np.int64)
+    np.minimum.at(vmin, comps_nz, vals_nz)
+    np.maximum.at(vmax, comps_nz, vals_nz)
+    np.minimum.at(first, comps_nz, nz)
+    pure = vmin == vmax
+    pure[0] = False
+
+    pure_ids = np.flatnonzero(pure)
+    pure_values = vmin[pure_ids]
+    pure_first = first[pure_ids]
+
+    mixed_roots = np.empty(0, dtype=np.int64)
+    mixed_inverse = np.empty(0, dtype=np.int64)
+    mixed_lin = np.empty(0, dtype=np.int64)
+    if not pure.all():
+        from chunkflow_tpu.segment.merge_table import union_find
+
+        mixed_voxel = ~pure[comp] & mask
+        mixed_lin = np.flatnonzero(mixed_voxel.ravel())
+        shape = arr.shape
+        lin = np.arange(arr.size, dtype=np.int64).reshape(shape)
+        edge_sets = []
+        for off in _half_offsets(connectivity):
+            a_sel = tuple(
+                slice(max(0, -d), shape[i] - max(0, d))
+                for i, d in enumerate(off)
+            )
+            b_sel = tuple(
+                slice(max(0, d), shape[i] - max(0, -d))
+                for i, d in enumerate(off)
+            )
+            pair = (
+                mixed_voxel[a_sel]
+                & mixed_voxel[b_sel]
+                & (arr[a_sel] == arr[b_sel])
+            )
+            if pair.any():
+                edge_sets.append(
+                    np.stack(
+                        [lin[a_sel][pair], lin[b_sel][pair]], axis=1
+                    )
+                )
+        root = mixed_lin.copy()  # isolated voxels root at themselves
+        if edge_sets:
+            ids, roots = union_find(np.concatenate(edge_sets, axis=0))
+            root[np.searchsorted(mixed_lin, ids.astype(np.int64))] = (
+                roots.astype(np.int64)
+            )
+        # root = min raster index of the equal-value sub-component
+        mixed_roots, mixed_inverse = np.unique(root, return_inverse=True)
+
+    values_all = np.concatenate(
+        [pure_values, flat_vals[mixed_roots]]
+    )
+    first_all = np.concatenate([pure_first, mixed_roots])
+    order = np.lexsort((first_all, values_all))
+    rank = np.empty(order.size, dtype=np.uint32)
+    rank[order] = np.arange(1, order.size + 1, dtype=np.uint32)
+
+    rank_of_comp = np.zeros(num + 1, dtype=np.uint32)
+    rank_of_comp[pure_ids] = rank[: pure_ids.size]
+    out_flat = rank_of_comp[flat_comp]
+    if mixed_lin.size:
+        out_flat[mixed_lin] = rank[pure_ids.size:][mixed_inverse]
+    return out_flat.reshape(arr.shape)
 
 
 def connected_components(

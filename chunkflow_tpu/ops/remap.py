@@ -63,6 +63,36 @@ def remap(arr: np.ndarray, mapping: Dict[int, int], preserve_missing: bool = Tru
     return out.astype(arr.dtype)
 
 
+def remap_arrays(
+    arr: np.ndarray,
+    keys: np.ndarray,
+    values: np.ndarray,
+    preserve_missing: bool = True,
+) -> np.ndarray:
+    """Apply an old->new mapping given as parallel arrays (the
+    segmentation plane's remap-table form: millions of rows would make
+    the dict path of :func:`remap` allocation-bound). ``keys`` must be
+    unique; they are sorted here (with ``values`` carried along) so
+    callers can pass tables in any order. Ids absent from ``keys`` pass
+    through unchanged (``preserve_missing``) or map to 0."""
+    keys = np.asarray(keys)
+    values = np.asarray(values)
+    if keys.size != values.size:
+        raise ValueError(
+            f"keys/values length mismatch: {keys.size} vs {values.size}"
+        )
+    if keys.size == 0:
+        return arr.copy() if preserve_missing else np.zeros_like(arr)
+    order = np.argsort(keys, kind="stable")
+    keys = keys[order].astype(arr.dtype, copy=False)
+    values = values[order].astype(arr.dtype, copy=False)
+    idx = np.searchsorted(keys, arr)
+    idx = np.clip(idx, 0, keys.size - 1)
+    found = keys[idx] == arr
+    out = np.where(found, values[idx], arr if preserve_missing else 0)
+    return out.astype(arr.dtype)
+
+
 def unique_ids(arr: np.ndarray, return_counts: bool = False):
     """Nonzero unique ids (and counts)."""
     if return_counts:

@@ -89,6 +89,22 @@ class SpatialTaskTree:
             yield from self.left.walk()
             yield from self.right.walk()
 
+    def post_order(self) -> Iterator["SpatialTaskTree"]:
+        """Children-before-parents traversal — the execution order of a
+        serial hierarchical merge (segment/driver.py)."""
+        if not self.is_leaf:
+            yield from self.left.post_order()
+            yield from self.right.post_order()
+        yield self
+
+    def find(self, bbox_string: str) -> Optional["SpatialTaskTree"]:
+        """The node whose bbox renders as ``bbox_string`` (task bodies
+        round-trip through bbox strings), or None."""
+        for node in self.walk():
+            if node.bbox.string == bbox_string:
+                return node
+        return None
+
     # ---- state machine -------------------------------------------------
     @property
     def is_done(self) -> bool:

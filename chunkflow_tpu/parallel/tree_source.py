@@ -1,0 +1,115 @@
+"""TreeTaskSource: a SpatialTaskTree feeding the EXISTING queue loop.
+
+The flat queue cannot express "the parent merge must wait for both
+children" — but nothing about the supervised worker loop
+(``fetch-task-from-queue`` + parallel/lifecycle.py) needs to change to
+get there. This source keeps the dependency state on the *submit* side:
+
+* the tree's ready frontier is enqueued as ordinary queue bodies
+  (leaves first, then interior nodes as their subtrees complete);
+* a node counts as done exactly when its body has a **ledger marker**
+  — the same durable commit the worker's ``delete-task-in-queue`` ack
+  writes — so children's ledger commits are literally what unlocks the
+  parent task;
+* :meth:`sync` folds the ledger into the tree, then claims-and-enqueues
+  every newly runnable node. Run it in a loop (:meth:`run`) and the
+  whole reduce schedules itself through the standard machinery: workers
+  just drain the queue, retries/lease expiry/dead-letter/exactly-once
+  all come from the lifecycle layer unchanged.
+
+Crash story (docs/fault_tolerance.md "Task graphs"): a killed WORKER is
+the queue's problem (visibility timeout -> redelivery -> ledger-skip or
+idempotent re-execution). A killed COORDINATOR rebuilds the tree from
+the plan, folds the ledger (every committed node goes straight to done)
+and re-claims the frontier; re-enqueued duplicates of messages still
+sitting in the queue are absorbed by the ledger-skip path. Mid-job
+serialize/restore of a live tree (``tree.to_dict``) is also supported —
+restored ``working on`` nodes are NOT re-enqueued (their messages are
+still in flight).
+
+Ready-set ordering is deterministic: ``next_ready_task`` claims in
+pre-order walk order, so leaves go out left-to-right along the split
+axes and every interior node strictly after both children.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from chunkflow_tpu.parallel.lifecycle import LedgerBase
+from chunkflow_tpu.parallel.task_tree import SpatialTaskTree
+
+
+class TreeTaskSource:
+    """Pump a dependency tree into an ordinary task queue.
+
+    ``body`` maps a node to its queue body / ledger key (default: the
+    node's bbox string). One coordinator instance drives one tree; the
+    instance itself is single-threaded — cross-process safety comes
+    from the queue and ledger underneath, not from locks here.
+    """
+
+    def __init__(
+        self,
+        tree: SpatialTaskTree,
+        queue,
+        ledger: LedgerBase,
+        body: Optional[Callable[[SpatialTaskTree], str]] = None,
+    ):
+        if ledger is None:
+            raise ValueError(
+                "TreeTaskSource needs a ledger: children's ledger "
+                "commits are what unlock the parent task"
+            )
+        self.tree = tree
+        self.queue = queue
+        self.ledger = ledger
+        self._body = body or (lambda node: node.bbox.string)
+        self.enqueued = 0
+
+    def sync(self) -> int:
+        """One scheduling round: fold ledger commits into the tree,
+        then enqueue every newly runnable node. Returns how many were
+        enqueued."""
+        for node in self.tree.walk():
+            if not node.is_done and self.ledger.is_done(self._body(node)):
+                node.set_state_done()
+        bodies: List[str] = []
+        while True:
+            node = self.tree.next_ready_task()
+            if node is None:
+                break
+            bodies.append(self._body(node))
+        if bodies:
+            # send OUTSIDE any tree claim: queue sends may block on IO
+            self.queue.send_messages(bodies)
+            self.enqueued += len(bodies)
+        return len(bodies)
+
+    @property
+    def all_done(self) -> bool:
+        return self.tree.all_done
+
+    def pending(self) -> int:
+        return sum(1 for node in self.tree.walk() if not node.is_done)
+
+    def run(
+        self,
+        poll_interval: float = 0.05,
+        timeout: Optional[float] = None,
+    ) -> int:
+        """Pump until the whole tree is done; returns the total number
+        of bodies enqueued by this source. Raises TimeoutError when the
+        deadline passes with nodes still outstanding (workers dead or
+        never started — the queue keeps the claimed work either way)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            self.sync()
+            if self.tree.all_done:
+                return self.enqueued
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"task tree incomplete after {timeout}s: "
+                    f"{self.pending()} nodes outstanding"
+                )
+            time.sleep(poll_interval)

@@ -1,0 +1,40 @@
+"""The whole-volume segmentation plane: cross-chunk label stitching.
+
+The reference pipeline's production output is mask -> **segment** -> mesh
+(PAPER.md, the cc3d/fastremap/zmesh C++ leg); this package closes the
+cross-chunk story that ops/connected_components.py (one chunk at a time)
+could not express. The job is the repo's first real task *graph* — a
+map -> reduce -> map pipeline over a chunk grid:
+
+1. **Map — label** (:func:`segment.stages.label_chunk`): each grid chunk
+   is labeled independently, labels lifted into a collision-free global
+   id space by a deterministic per-chunk offset, interior labels written
+   ``blockwise_save``, the six boundary faces written as sidecar KV
+   objects.
+2. **Reduce — merge tree** (:func:`segment.stages.merge_node`):
+   adjacent face planes produce equivalence edges; merges run bottom-up
+   over a :class:`parallel.task_tree.SpatialTaskTree` (one interface
+   plane per interior node), culminating in a root union-find that
+   emits the global remap table to KV.
+3. **Map — relabel** (:func:`segment.stages.relabel_chunk`): the remap
+   is applied per chunk via ops/remap.py and the final segmentation
+   written back (idempotently — canonical ids are fixpoints of the
+   table, so a replayed relabel is a no-op rewrite).
+
+See docs/segmentation.md for the full phase diagram, the global-id
+scheme and the exactly-once merge argument.
+"""
+from chunkflow_tpu.segment.merge_table import (  # noqa: F401
+    face_pair_edges,
+    labels_isomorphic,
+    union_find,
+)
+from chunkflow_tpu.segment.plan import SegmentPlan  # noqa: F401
+from chunkflow_tpu.segment.stages import SegmentStore, execute_body  # noqa: F401
+from chunkflow_tpu.segment.driver import (  # noqa: F401
+    init_store,
+    open_store,
+    run_coordinator,
+    run_local,
+    segment_volume,
+)

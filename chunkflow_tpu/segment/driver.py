@@ -1,0 +1,246 @@
+"""Drivers composing the map -> reduce -> map into one job.
+
+Two execution shapes over the same stage functions (segment/stages.py):
+
+* :func:`run_local` — in-process: the label and relabel map phases fan
+  out over a thread pool (per-chunk storage I/O overlaps; the native
+  labeling kernel releases the GIL), the reduce runs as a post-order
+  tree walk. This is the bench leg and the single-machine CLI path.
+* :func:`run_coordinator` — distributed: a
+  :class:`parallel.tree_source.TreeTaskSource` pumps the label+merge
+  tree through an ordinary queue+ledger, then the relabel wave goes out
+  as flat tasks gated on the root's ledger commit. Workers are plain
+  ``fetch-task-from-queue`` pipelines chaining the ``label-chunk`` /
+  ``merge-seg`` / ``relabel`` stages (flow/cli.py) — the coordinator
+  never executes a task itself and can die and resume at any point
+  (everything it does is derived from the plan + the ledger).
+
+:func:`init_store` / :func:`open_store` persist a job spec
+(``spec.json``) in a job directory so every worker process rebuilds the
+identical :class:`SegmentStore` from the directory alone; the label
+volume lives in a :class:`volume.storage.KVArrayBackend` under the same
+root, faces/merge tables/remap in the sibling KV namespace.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.segment.plan import SegmentPlan
+from chunkflow_tpu.segment.stages import (
+    LABEL_DTYPE,
+    SegmentStore,
+    label_chunk,
+    merge_node,
+    relabel_chunk,
+)
+from chunkflow_tpu.volume.storage import (
+    FileKV,
+    KVArrayBackend,
+    MemoryBackend,
+    MemoryKV,
+    blockwise_cutout,
+)
+
+SPEC_NAME = "spec.json"
+
+
+# ---------------------------------------------------------------------------
+# local (in-process) execution
+# ---------------------------------------------------------------------------
+def _map_phase(fn, store: SegmentStore, bboxes, workers: int) -> None:
+    if workers <= 1:
+        for bbox in bboxes:
+            fn(store, bbox)
+        return
+    with ThreadPoolExecutor(
+        max_workers=workers, thread_name_prefix="chunkflow-segment"
+    ) as pool:
+        futures = [pool.submit(fn, store, bbox) for bbox in bboxes]
+        for future in futures:
+            future.result()
+
+
+def run_local(store: SegmentStore, workers: int = 4) -> dict:
+    """The whole job in this process. Returns phase counters."""
+    plan = store.plan
+    _map_phase(label_chunk, store, plan.chunks, workers)
+    tree = plan.make_tree()
+    merges = 0
+    for node in tree.post_order():
+        if not node.is_leaf:
+            merge_node(store, node.bbox)
+            merges += 1
+    _map_phase(relabel_chunk, store, plan.chunks, workers)
+    return {
+        "chunks": len(plan.chunks),
+        "merge_nodes": merges,
+    }
+
+
+def segment_volume(
+    array: np.ndarray,
+    chunk_size,
+    *,
+    threshold: float = 0.5,
+    connectivity: int = 26,
+    multivalue: bool = False,
+    device: bool = False,
+    workers: int = 4,
+    mesh_dir: Optional[str] = None,
+) -> np.ndarray:
+    """Convenience one-shot: stitch-label a host array through an
+    in-memory store and return the merged uint64 segmentation. The
+    heavy lifting (and every knob) is :func:`run_local`; tests and the
+    bench build their own stores for latency-charged backends."""
+    bbox = BoundingBox((0, 0, 0), tuple(int(s) for s in array.shape))
+    plan = SegmentPlan(bbox, chunk_size)
+    seg_array = np.zeros(array.shape, dtype=LABEL_DTYPE)
+    store = SegmentStore(
+        plan,
+        input_backend=MemoryBackend(array, block_shape=plan.chunk_size),
+        seg_backend=MemoryBackend(seg_array, block_shape=plan.chunk_size),
+        kv=MemoryKV(),
+        threshold=threshold,
+        connectivity=connectivity,
+        multivalue=multivalue,
+        device=device,
+        mesh_dir=mesh_dir,
+    )
+    run_local(store, workers=workers)
+    return seg_array
+
+
+# ---------------------------------------------------------------------------
+# job directory (spec + file-backed store) for multi-process runs
+# ---------------------------------------------------------------------------
+def init_store(
+    seg_dir: str,
+    input_npy: str,
+    chunk_size,
+    *,
+    threshold: float = 0.5,
+    connectivity: int = 26,
+    multivalue: bool = False,
+    device: bool = False,
+    mesh_dir: Optional[str] = None,
+) -> SegmentStore:
+    """Create a job directory: write ``spec.json`` and return the
+    opened store. ``input_npy`` is kept as a path so worker processes
+    map it read-only instead of copying the volume around."""
+    os.makedirs(seg_dir, exist_ok=True)
+    shape = np.load(input_npy, mmap_mode="r").shape
+    if len(shape) != 3:
+        raise ValueError(f"segmentation input must be 3D, got {shape}")
+    spec = {
+        "bbox": BoundingBox((0, 0, 0), tuple(int(s) for s in shape)).string,
+        "chunk_size": [int(v) for v in chunk_size],
+        "input_npy": os.path.abspath(input_npy),
+        "threshold": float(threshold),
+        "connectivity": int(connectivity),
+        "multivalue": bool(multivalue),
+        "device": bool(device),
+        "mesh_dir": mesh_dir,
+    }
+    path = os.path.join(seg_dir, SPEC_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(spec, f, indent=1)
+    os.replace(tmp, path)
+    return open_store(seg_dir)
+
+
+def open_store(seg_dir: str) -> SegmentStore:
+    """Rebuild the store of a job directory from its ``spec.json`` —
+    what every worker stage does once per process."""
+    with open(os.path.join(seg_dir, SPEC_NAME)) as f:
+        spec = json.load(f)
+    plan = SegmentPlan(
+        BoundingBox.from_string(spec["bbox"]), spec["chunk_size"]
+    )
+    source = np.load(spec["input_npy"], mmap_mode="r")
+    kv = FileKV(os.path.join(seg_dir, "kv"))
+    seg_backend = KVArrayBackend(
+        kv,
+        domain=(plan.bbox.start, plan.bbox.stop),
+        dtype=LABEL_DTYPE,
+        block_shape=plan.chunk_size,
+        prefix="seg",
+    )
+    return SegmentStore(
+        plan,
+        input_backend=MemoryBackend(
+            source, block_shape=plan.chunk_size
+        ),
+        seg_backend=seg_backend,
+        kv=kv,
+        threshold=spec["threshold"],
+        connectivity=spec["connectivity"],
+        multivalue=spec["multivalue"],
+        device=spec.get("device", False),
+        mesh_dir=spec.get("mesh_dir"),
+    )
+
+
+def export_segmentation(store: SegmentStore) -> np.ndarray:
+    """Materialize the (relabeled) whole-volume segmentation."""
+    return blockwise_cutout(
+        store.seg_backend, store.plan.bbox.start, store.plan.bbox.stop
+    )
+
+
+# ---------------------------------------------------------------------------
+# distributed coordination
+# ---------------------------------------------------------------------------
+def run_coordinator(
+    store: SegmentStore,
+    queue,
+    ledger,
+    *,
+    poll_interval: float = 0.05,
+    timeout: Optional[float] = None,
+) -> dict:
+    """Drive the job through a queue + ledger: the label+merge tree via
+    :class:`TreeTaskSource`, then the relabel wave gated on the root's
+    commit. Fully resumable — a restarted coordinator re-derives its
+    whole state from plan + ledger (already-committed nodes fold to
+    done; duplicate enqueues ledger-skip at the workers)."""
+    from chunkflow_tpu.parallel.tree_source import TreeTaskSource
+
+    plan = store.plan
+    deadline = None if timeout is None else time.monotonic() + timeout
+
+    source = TreeTaskSource(
+        plan.make_tree(), queue, ledger, body=plan.node_body
+    )
+    source.run(
+        poll_interval=poll_interval,
+        timeout=None if deadline is None else deadline - time.monotonic(),
+    )
+
+    relabel_bodies: List[str] = [
+        plan.relabel_body(chunk) for chunk in plan.chunks
+    ]
+    outstanding = [
+        body for body in relabel_bodies if not ledger.is_done(body)
+    ]
+    if outstanding:
+        queue.send_messages(outstanding)
+    while any(not ledger.is_done(body) for body in relabel_bodies):
+        if deadline is not None and time.monotonic() > deadline:
+            raise TimeoutError(
+                "relabel wave incomplete: "
+                f"{sum(1 for b in relabel_bodies if not ledger.is_done(b))}"
+                " chunks outstanding"
+            )
+        time.sleep(poll_interval)
+    return {
+        "tree_tasks": source.enqueued,
+        "relabel_tasks": len(outstanding),
+    }

@@ -1,0 +1,202 @@
+"""Merge tables: face-pair equivalence edges + vectorized union-find.
+
+The reduce half of the stitching algebra, all numpy, no python loops
+over voxels or ids:
+
+* :func:`face_pair_edges` — two adjacent one-voxel label planes in, the
+  unique set of (low-side id, high-side id) equivalence edges out. The
+  in-plane neighborhood per connectivity matters: with 26-connectivity
+  a voxel touches the far side of the interface diagonally, so chunks
+  adjacent only across a grid *edge or corner* still exchange edges —
+  provided the planes compared are the FULL interface planes of a tree
+  node, not single chunk-pair strips (segment/stages.py assembles them
+  per node; every grid interface is the split plane of exactly one
+  interior node, so coverage is exact — the label-isomorphism tests
+  pin this for 6 and 26 on ragged grids).
+* :func:`union_find` — path-compressed, fully vectorized: pointer
+  jumping to a fixpoint, then edge-root relinking by minimum, repeated
+  until no edge spans two roots. Canonical representative = the minimum
+  global id of the component, which makes the final remap table a
+  *fixpoint* table (roots map to themselves) — the property the
+  idempotent relabel pass rests on (docs/segmentation.md).
+* :func:`labels_isomorphic` — exact bijective agreement between two
+  labelings (the acceptance oracle: stitched vs monolithic).
+"""
+from __future__ import annotations
+
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+EDGE_DTYPE = np.uint64
+
+_EMPTY_EDGES = np.empty((0, 2), dtype=EDGE_DTYPE)
+
+
+def _inplane_offsets(connectivity: int) -> Tuple[Tuple[int, int], ...]:
+    """In-plane (du, dv) neighbor offsets a voxel reaches on the far
+    side of a face, per 3D connectivity: crossing the face spends one
+    axis step, leaving Chebyshev<=1 (26), Manhattan<=1 (18) or exactly
+    zero (6) in-plane displacement."""
+    if connectivity == 6:
+        return ((0, 0),)
+    if connectivity == 18:
+        return ((0, 0), (1, 0), (-1, 0), (0, 1), (0, -1))
+    if connectivity == 26:
+        return tuple((du, dv) for du in (-1, 0, 1) for dv in (-1, 0, 1))
+    raise ValueError(
+        f"connectivity must be 6, 18 or 26, got {connectivity}"
+    )
+
+
+def face_pair_edges(
+    low: np.ndarray,
+    high: np.ndarray,
+    connectivity: int = 26,
+    low_values: np.ndarray = None,
+    high_values: np.ndarray = None,
+) -> np.ndarray:
+    """Equivalence edges across one interface: ``low`` is the label
+    plane on the low-coordinate side (the chunks' ``+`` faces), ``high``
+    the plane one voxel across (the ``-`` faces). Returns the unique
+    ``(N, 2)`` uint64 edge set; zero (background) and identity pairs are
+    dropped. Vectorized: one shifted-overlap comparison per in-plane
+    offset, then one ``np.unique`` over the stacked pairs.
+
+    ``low_values``/``high_values`` (multivalue mode) carry the INPUT ids
+    under the same planes: an edge then also requires the two voxels to
+    hold the same input value — two touching but differently-valued
+    objects must stay separate, exactly as within one chunk."""
+    low = np.asarray(low)
+    high = np.asarray(high)
+    if low.shape != high.shape or low.ndim != 2:
+        raise ValueError(
+            f"face planes must be equal-shape 2D, got {low.shape} "
+            f"vs {high.shape}"
+        )
+    if (low_values is None) != (high_values is None):
+        raise ValueError("value planes must come as a pair")
+    h, w = low.shape
+    pairs = []
+    for du, dv in _inplane_offsets(connectivity):
+        lo_sel = (
+            slice(max(0, -du), h - max(0, du)),
+            slice(max(0, -dv), w - max(0, dv)),
+        )
+        hi_sel = (
+            slice(max(0, du), h - max(0, -du)),
+            slice(max(0, dv), w - max(0, -dv)),
+        )
+        a = low[lo_sel]
+        b = high[hi_sel]
+        mask = (a != 0) & (b != 0)
+        if low_values is not None:
+            mask &= low_values[lo_sel] == high_values[hi_sel]
+        if mask.any():
+            pairs.append(
+                np.stack(
+                    [a[mask].astype(EDGE_DTYPE),
+                     b[mask].astype(EDGE_DTYPE)],
+                    axis=1,
+                )
+            )
+    if not pairs:
+        return _EMPTY_EDGES.copy()
+    edges = np.unique(np.concatenate(pairs, axis=0), axis=0)
+    return edges[edges[:, 0] != edges[:, 1]]
+
+
+def merge_edge_sets(edge_sets: Iterable[np.ndarray]) -> np.ndarray:
+    """Concatenate + dedupe edge sets (a child's merge table is itself
+    a set of equivalence pairs, so tables and fresh face edges combine
+    through the same path)."""
+    stacked = [
+        np.asarray(e, dtype=EDGE_DTYPE).reshape(-1, 2)
+        for e in edge_sets
+    ]
+    stacked = [e for e in stacked if e.size]
+    if not stacked:
+        return _EMPTY_EDGES.copy()
+    return np.unique(np.concatenate(stacked, axis=0), axis=0)
+
+
+def union_find(edges: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized union-find over an ``(N, 2)`` edge set.
+
+    Returns ``(ids, roots)``: the sorted unique ids appearing in any
+    edge and, positionally, each id's canonical representative — the
+    MINIMUM id of its connected component. Implementation: compress ids
+    to dense indices (searchsorted), then alternate full pointer-jumping
+    path compression with min-relinking of every edge's two roots until
+    no edge spans two components. Each outer round at least halves the
+    surviving component count along every merging chain, so convergence
+    is logarithmic in the longest merge chain."""
+    edges = np.asarray(edges, dtype=EDGE_DTYPE).reshape(-1, 2)
+    ids = np.unique(edges)
+    if ids.size == 0:
+        return ids, ids.copy()
+    idx = np.searchsorted(ids, edges)
+    parent = np.arange(ids.size, dtype=np.int64)
+    while True:
+        while True:  # full path compression by pointer jumping
+            jumped = parent[parent]
+            if np.array_equal(jumped, parent):
+                break
+            parent = jumped
+        root_a = parent[idx[:, 0]]
+        root_b = parent[idx[:, 1]]
+        merged = root_a != root_b
+        if not merged.any():
+            break
+        lo = np.minimum(root_a[merged], root_b[merged])
+        hi = np.maximum(root_a[merged], root_b[merged])
+        # min-relink: several edges may target one root — np.minimum.at
+        # keeps the smallest, the next compression round absorbs chains
+        np.minimum.at(parent, hi, lo)
+    return ids, ids[parent]
+
+
+def merge_table(edge_sets: Iterable[np.ndarray]) -> np.ndarray:
+    """The reduce step of one tree node: combine edge sets, run
+    union-find, return the non-identity ``(N, 2)`` (id -> canonical)
+    rows. A pure function of its inputs — re-running a replayed merge
+    writes byte-identical output (the idempotence argument,
+    docs/segmentation.md)."""
+    edges = merge_edge_sets(edge_sets)
+    ids, roots = union_find(edges)
+    moved = ids != roots
+    return np.stack([ids[moved], roots[moved]], axis=1)
+
+
+def labels_isomorphic(a: np.ndarray, b: np.ndarray) -> bool:
+    """Exact bijective agreement of two labelings: same background
+    support, and the nonzero (a, b) value pairs form a one-to-one
+    mapping in both directions."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    a = a.ravel()
+    b = b.ravel()
+    zero_a = a == 0
+    if not np.array_equal(zero_a, b == 0):
+        return False
+    nz = ~zero_a
+    pairs = np.stack(
+        [a[nz].astype(np.uint64), b[nz].astype(np.uint64)], axis=1
+    )
+    pairs = np.unique(pairs, axis=0)
+    return bool(
+        np.unique(pairs[:, 0]).size == pairs.shape[0]
+        and np.unique(pairs[:, 1]).size == pairs.shape[0]
+    )
+
+
+def apply_mapping(
+    arr: np.ndarray, keys: Sequence[int], values: Sequence[int]
+) -> np.ndarray:
+    """Thin re-export of :func:`ops.remap.remap_arrays` kept here so the
+    reduce plane has one import surface (stages, bench, tests)."""
+    from chunkflow_tpu.ops.remap import remap_arrays
+
+    return remap_arrays(arr, keys, values, preserve_missing=True)

@@ -1,0 +1,343 @@
+"""The three task stages of the stitching job, as pure(-ish) functions
+over one :class:`SegmentStore`.
+
+Every stage is **idempotent by construction** — the exactly-once story
+(docs/fault_tolerance.md) only dedupes the ledger *commit*; the effect
+must survive a replay after a mid-task SIGKILL:
+
+* ``label_chunk`` writes are pure functions of (input chunk, plan) —
+  a replay rewrites identical bytes.
+* ``merge_node`` output is a pure function of its children's tables and
+  the face sidecars (all written before the children committed) — a
+  replay rewrites identical bytes. The ``segment/merge`` chaos point
+  sits mid-merge, after the reads and before the table write, so
+  ``CHUNKFLOW_CHAOS=once=segment/merge:action=kill`` exercises exactly
+  the replay the argument covers.
+* ``relabel_chunk`` applies a fixpoint table (canonical ids map to
+  themselves, every other id maps onto a canonical one, and no
+  canonical id appears as a non-identity key) — applying it to
+  already-relabeled data is the identity, so an in-place replay is a
+  no-op rewrite.
+
+Telemetry (docs/observability.md SEGMENT block): ``segment/chunks_labeled``,
+``segment/faces_written``, ``segment/faces_exchanged``,
+``segment/edges_found``, ``segment/merges_applied``,
+``segment/voxels_relabeled``.
+"""
+from __future__ import annotations
+
+import io
+from typing import Optional
+
+import numpy as np
+
+from chunkflow_tpu.core import telemetry
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.segment import merge_table as mt
+from chunkflow_tpu.segment.plan import (
+    REMAP_KEY,
+    SegmentPlan,
+    face_key,
+    merge_key,
+    value_face_key,
+)
+from chunkflow_tpu.testing import chaos
+from chunkflow_tpu.volume.storage import (
+    KVBackend,
+    StorageBackend,
+    blockwise_cutout,
+    blockwise_save,
+)
+
+LABEL_DTYPE = np.uint64
+
+
+def _to_npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _from_npy_bytes(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class SegmentStore:
+    """One stitching job's state: plan + backends + labeling knobs.
+
+    ``input_backend`` holds the source volume (probability map, binary
+    mask or multi-valued ids), ``seg_backend`` the evolving uint64 label
+    volume (block grid == chunk grid, so parallel chunk writes are
+    aligned and conflict-free), ``kv`` the sidecar plane (faces, merge
+    tables, the remap table)."""
+
+    def __init__(
+        self,
+        plan: SegmentPlan,
+        input_backend: StorageBackend,
+        seg_backend: StorageBackend,
+        kv: KVBackend,
+        *,
+        threshold: float = 0.5,
+        connectivity: int = 26,
+        multivalue: bool = False,
+        device: bool = False,
+        mesh_dir: Optional[str] = None,
+        voxel_size=(1, 1, 1),
+    ):
+        if connectivity not in (6, 18, 26):
+            raise ValueError(
+                f"connectivity must be 6, 18 or 26, got {connectivity}"
+            )
+        self.plan = plan
+        self.input_backend = input_backend
+        self.seg_backend = seg_backend
+        self.kv = kv
+        self.threshold = float(threshold)
+        self.connectivity = int(connectivity)
+        self.multivalue = bool(multivalue)
+        self.device = bool(device)
+        self.mesh_dir = mesh_dir
+        self.voxel_size = tuple(voxel_size)
+        self._remap_cache: Optional[tuple] = None
+
+    # ---- sidecar helpers ----------------------------------------------
+    def write_array(self, key: str, arr: np.ndarray) -> None:
+        self.kv.write_bytes(key, _to_npy_bytes(arr))
+
+    def read_array(self, key: str) -> Optional[np.ndarray]:
+        data = self.kv.read_bytes(key)
+        return None if data is None else _from_npy_bytes(data)
+
+    def remap_table(self) -> tuple:
+        """The root's (keys, values) remap table; cached per process —
+        it is written exactly once, before any relabel task exists."""
+        if self._remap_cache is None:
+            table = self.read_array(REMAP_KEY)
+            if table is None:
+                if len(self.plan.chunks) == 1:
+                    # degenerate single-chunk grid: no interface, no
+                    # merge node, nothing to remap
+                    table = np.empty((0, 2), dtype=LABEL_DTYPE)
+                else:
+                    raise RuntimeError(
+                        "remap table not written yet — the root merge "
+                        "must commit before relabel tasks run"
+                    )
+            self._remap_cache = (table[:, 0], table[:, 1])
+        return self._remap_cache
+
+
+# ---------------------------------------------------------------------------
+# map 1: per-chunk labeling
+# ---------------------------------------------------------------------------
+def _label_local(store: SegmentStore, src: np.ndarray) -> np.ndarray:
+    """One chunk's local labels (host scipy/native union-find, or the
+    device min-propagation leg for binary-eligible input)."""
+    from chunkflow_tpu.ops import connected_components as cc
+
+    kind = np.dtype(src.dtype).kind
+    if store.multivalue:
+        return cc.label_multivalue(src, connectivity=store.connectivity)
+    if kind == "f":
+        binary = src > store.threshold
+    else:
+        binary = src != 0
+    if store.device:
+        return np.asarray(
+            cc.label_binary_device(binary, connectivity=store.connectivity)
+        )
+    return cc.label_binary(binary, connectivity=store.connectivity)
+
+
+def label_chunk(store: SegmentStore, bbox: BoundingBox) -> int:
+    """Map stage 1: label one grid chunk, lift into the global id
+    space, save the interior blockwise and the boundary faces as KV
+    sidecars. Returns the number of local labels."""
+    plan = store.plan
+    offset = plan.id_offset(bbox)
+    src = blockwise_cutout(store.input_backend, bbox.start, bbox.stop)
+    local = _label_local(store, src)
+    labels = local.astype(LABEL_DTYPE)
+    nonzero = labels != 0
+    labels[nonzero] += LABEL_DTYPE(offset)
+    blockwise_save(store.seg_backend, bbox.start, labels)
+    faces = 0
+    for axis in range(3):
+        for positive in (False, True):
+            edge = (
+                int(bbox.stop[axis]) < int(plan.bbox.stop[axis])
+                if positive
+                else int(bbox.start[axis]) > int(plan.bbox.start[axis])
+            )
+            if not edge:
+                continue  # roi boundary: nothing on the far side
+            sel = [slice(None)] * 3
+            sel[axis] = -1 if positive else 0
+            store.write_array(
+                face_key(bbox, axis, positive), labels[tuple(sel)]
+            )
+            if store.multivalue:
+                # merge eligibility across the face needs the INPUT ids
+                # too: touching-but-different objects must stay separate
+                store.write_array(
+                    value_face_key(bbox, axis, positive),
+                    src[tuple(sel)].astype(LABEL_DTYPE),
+                )
+            faces += 1
+    count = int(np.unique(local).size - (1 if nonzero.any() else 0))
+    telemetry.inc("segment/chunks_labeled")
+    if faces:
+        telemetry.inc("segment/faces_written", faces)
+    return count
+
+
+# ---------------------------------------------------------------------------
+# reduce: hierarchical merge over the spatial task tree
+# ---------------------------------------------------------------------------
+def _interface_planes(store: SegmentStore, node) -> tuple:
+    """Assemble the two FULL label planes of one interior node's split
+    interface from the chunk face sidecars (low side ``+`` faces, high
+    side ``-`` faces). Full planes — not per-chunk-pair strips — so
+    diagonal contacts across grid edges/corners fall out of the
+    in-plane neighborhood for free (merge_table.face_pair_edges)."""
+    plan = store.plan
+    axis, _split, low_chunks, high_chunks = plan.plane_chunks(node)
+    inplane = [d for d in range(3) if d != axis]
+    shape = tuple(
+        int(node.bbox.stop[d]) - int(node.bbox.start[d]) for d in inplane
+    )
+    planes = []
+    value_planes = []
+    exchanged = 0
+    for side_chunks, positive in ((low_chunks, True), (high_chunks, False)):
+        plane = np.zeros(shape, dtype=LABEL_DTYPE)
+        values = (
+            np.zeros(shape, dtype=LABEL_DTYPE) if store.multivalue else None
+        )
+        for chunk in side_chunks:
+            strip = store.read_array(face_key(chunk, axis, positive))
+            if strip is None:  # pragma: no cover — scheduling bug guard
+                raise RuntimeError(
+                    f"missing face sidecar {face_key(chunk, axis, positive)}"
+                )
+            anchor = tuple(
+                int(chunk.start[d]) - int(node.bbox.start[d])
+                for d in inplane
+            )
+            window = (
+                slice(anchor[0], anchor[0] + strip.shape[0]),
+                slice(anchor[1], anchor[1] + strip.shape[1]),
+            )
+            plane[window] = strip
+            if values is not None:
+                vstrip = store.read_array(
+                    value_face_key(chunk, axis, positive)
+                )
+                if vstrip is None:  # pragma: no cover — scheduling guard
+                    raise RuntimeError(
+                        "missing value face sidecar "
+                        f"{value_face_key(chunk, axis, positive)}"
+                    )
+                values[window] = vstrip
+            exchanged += 1
+        planes.append(plane)
+        value_planes.append(values)
+    telemetry.inc("segment/faces_exchanged", exchanged)
+    return planes[0], planes[1], value_planes[0], value_planes[1]
+
+
+def merge_node(store: SegmentStore, bbox: BoundingBox) -> int:
+    """Reduce stage: one interior node's merge — its interface edges
+    combined with both children's tables through union-find; the root
+    additionally emits the global remap table. Returns the number of
+    non-identity rows in the node's table."""
+    plan = store.plan
+    node = plan.node(bbox)
+    low, high, low_values, high_values = _interface_planes(store, node)
+    edges = mt.face_pair_edges(
+        low,
+        high,
+        connectivity=store.connectivity,
+        low_values=low_values,
+        high_values=high_values,
+    )
+    telemetry.inc("segment/edges_found", int(edges.shape[0]))
+    edge_sets = [edges]
+    for child in (node.left, node.right):
+        if child.is_leaf:
+            continue
+        table = store.read_array(merge_key(child.bbox))
+        if table is None:  # pragma: no cover — scheduling bug guard
+            raise RuntimeError(
+                f"missing child merge table {merge_key(child.bbox)}"
+            )
+        edge_sets.append(table)
+    # the kill window of the chaos satellite: inputs read, output not
+    # yet written — a SIGKILL here replays to byte-identical output
+    chaos.chaos_point("segment/merge")
+    table = mt.merge_table(edge_sets)
+    store.write_array(merge_key(bbox), table)
+    if node.parent is None:  # root: the table IS the global remap
+        store.write_array(REMAP_KEY, table)
+        telemetry.inc("segment/merges_applied", int(table.shape[0]))
+    return int(table.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# map 2: streaming relabel (+ optional meshing)
+# ---------------------------------------------------------------------------
+def relabel_chunk(store: SegmentStore, bbox: BoundingBox) -> int:
+    """Map stage 2: apply the root remap to one chunk in place, then
+    mesh the merged labels when a mesh sink is configured. Returns the
+    number of voxels whose id changed."""
+    from chunkflow_tpu.ops.remap import remap_arrays
+
+    keys, values = store.remap_table()
+    labels = blockwise_cutout(store.seg_backend, bbox.start, bbox.stop)
+    merged = remap_arrays(labels, keys, values, preserve_missing=True)
+    changed = int((merged != labels).sum())
+    if changed:
+        blockwise_save(store.seg_backend, bbox.start, merged)
+    telemetry.inc("segment/voxels_relabeled", changed)
+    if store.mesh_dir is not None:
+        _mesh_chunk(store, bbox, merged)
+    return changed
+
+
+def _mesh_chunk(store: SegmentStore, bbox: BoundingBox,
+                merged: np.ndarray) -> None:
+    """Mesh one relabeled chunk: fragments carry the merged global ids,
+    so one object's fragments from different chunks share a manifest —
+    no chunk-seam splits (flow/mesh.py)."""
+    from chunkflow_tpu.chunk.base import Chunk, LayerType
+    from chunkflow_tpu.flow.mesh import MeshOperator
+
+    seg = Chunk(
+        merged,
+        voxel_offset=tuple(int(v) for v in bbox.start),
+        voxel_size=store.voxel_size,
+        layer_type=LayerType.SEGMENTATION,
+    )
+    MeshOperator(store.mesh_dir, manifest=True)(seg)
+
+
+# ---------------------------------------------------------------------------
+# body dispatch (the CLI stages and the local driver share this)
+# ---------------------------------------------------------------------------
+_STAGES = {
+    "label": label_chunk,
+    "merge": merge_node,
+    "relabel": relabel_chunk,
+}
+
+
+def execute_body(store: SegmentStore, body: str) -> bool:
+    """Run the stage a queue body names; False for non-segmentation
+    traffic (callers pass the task through untouched)."""
+    parsed = SegmentPlan.parse_body(body)
+    if parsed is None:
+        return False
+    kind, bbox = parsed
+    _STAGES[kind](store, bbox)
+    return True

@@ -75,7 +75,8 @@ __all__ = [
     "storage_mode", "cache_bytes_limit", "read_concurrency",
     "set_read_concurrency", "BlockCache", "shared_cache",
     "reset_shared_cache", "StorageBackend", "TensorStoreBackend",
-    "MemoryBackend", "KVBackend", "FileKV", "TensorStoreKV", "open_kv",
+    "MemoryBackend", "KVBackend", "FileKV", "MemoryKV", "TensorStoreKV",
+    "open_kv", "KVArrayBackend",
     "blockwise_cutout", "blockwise_save", "serial_cutout", "GatherFuture",
 ]
 
@@ -534,16 +535,42 @@ class FileKV(KVBackend):
             return f.read()
 
     def write_bytes(self, name: str, data: bytes) -> None:
+        # tmp + rename: a concurrent reader (another worker assembling
+        # an interface plane from face sidecars, or a replayed task
+        # rewriting the same object) must never observe a torn value
         path = os.path.join(self.root, name)
         os.makedirs(os.path.dirname(path) or self.root, exist_ok=True)
-        with open(path, "wb") as f:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             f.write(data)
+        os.replace(tmp, path)
 
     def exists_many(self, names: Sequence[str]) -> Dict[str, bool]:
         return {
             name: os.path.exists(os.path.join(self.root, name))
             for name in names
         }
+
+
+class MemoryKV(KVBackend):
+    """In-process KV plane (tests, the bench's sidecar stand-in).
+    Thread-safe; values are immutable bytes so reads need no copies."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: Dict[str, bytes] = {}
+
+    def read_bytes(self, name: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(name)
+
+    def write_bytes(self, name: str, data: bytes) -> None:
+        with self._lock:
+            self._data[name] = bytes(data)
+
+    def exists_many(self, names: Sequence[str]) -> Dict[str, bool]:
+        with self._lock:
+            return {name: name in self._data for name in names}
 
 
 class TensorStoreKV(KVBackend):
@@ -629,6 +656,127 @@ def open_kv(spec: dict) -> KVBackend:
     if spec.get("driver") == "file":
         return FileKV(spec["path"])
     return TensorStoreKV(spec)
+
+
+class KVArrayBackend(StorageBackend):
+    """A :class:`StorageBackend` persisting one npy object per storage
+    block through any :class:`KVBackend` — the dependency-free shared
+    array store of the segmentation plane (docs/segmentation.md): a
+    FileKV root gives multi-process workers a common label volume with
+    no tensorstore requirement, a :class:`MemoryKV` gives tests one.
+
+    Blocks are keyed ``<prefix>/<lo..hi bbox string>.npy`` on the grid
+    anchored at the domain origin; absent blocks read as ``fill``
+    (labels default to background). Writes covering whole (clamped)
+    blocks store them directly; partial writes read-modify-write the
+    covered blocks — safe under the aligned-chunk contract (parallel
+    writers never share a block), and the FileKV tmp+rename write keeps
+    concurrent readers untorn either way."""
+
+    _SEQ = itertools.count()
+
+    def __init__(self, kv: KVBackend, domain, dtype,
+                 block_shape: Sequence[int], prefix: str = "blocks",
+                 fill=0, max_workers: int = 4):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._kv = kv
+        lo, hi = domain
+        self._domain = (
+            tuple(int(v) for v in lo), tuple(int(v) for v in hi)
+        )
+        self._dtype = np.dtype(dtype)
+        self._block_shape = tuple(int(v) for v in block_shape)
+        self._prefix = prefix
+        self._fill = fill
+        root = getattr(kv, "root", None)
+        self.cache_token = (
+            f"kvarray:{root}:{prefix}" if root is not None
+            else f"kvarray:mem{next(self._SEQ)}:{prefix}"
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="chunkflow-kvarray",
+        )
+
+    @property
+    def domain(self):
+        return self._domain
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def block_shape(self):
+        return self._block_shape
+
+    def _block_key(self, blo, bhi) -> str:
+        span = "_".join(f"{l}-{h}" for l, h in zip(blo, bhi))
+        return f"{self._prefix}/{span}.npy"
+
+    def _read_block(self, blo, bhi) -> np.ndarray:
+        import io
+
+        data = self._kv.read_bytes(self._block_key(blo, bhi))
+        if data is None:
+            return np.full(
+                tuple(h - l for l, h in zip(blo, bhi)),
+                self._fill, dtype=self._dtype,
+            )
+        return np.load(io.BytesIO(data), allow_pickle=False)
+
+    def _write_block(self, blo, bhi, arr: np.ndarray) -> None:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr, dtype=self._dtype),
+                allow_pickle=False)
+        self._kv.write_bytes(self._block_key(blo, bhi), buf.getvalue())
+
+    def _read(self, lo, hi) -> np.ndarray:
+        out = np.empty(
+            tuple(h - l for l, h in zip(lo, hi)), dtype=self._dtype
+        )
+        dlo, dhi = self._domain
+        for blo, bhi in _covering_blocks(
+            lo, hi, self._block_shape, self.grid_offset, dlo, dhi
+        ):
+            _copy_block(out, lo, hi, self._read_block(blo, bhi), blo, bhi)
+        return out
+
+    def _write(self, lo, hi, arr: np.ndarray) -> None:
+        arr = np.asarray(arr)
+        dlo, dhi = self._domain
+        for blo, bhi in _covering_blocks(
+            lo, hi, self._block_shape, self.grid_offset, dlo, dhi
+        ):
+            covers = all(
+                l <= bl and bh <= h
+                for l, h, bl, bh in zip(lo, hi, blo, bhi)
+            )
+            sel = tuple(
+                slice(max(l, bl) - l, min(h, bh) - l)
+                for l, h, bl, bh in zip(lo, hi, blo, bhi)
+            )
+            if covers:
+                self._write_block(blo, bhi, arr[sel])
+                continue
+            block = self._read_block(blo, bhi)  # partial: RMW
+            block[tuple(
+                slice(max(l, bl) - bl, min(h, bh) - bl)
+                for l, h, bl, bh in zip(lo, hi, blo, bhi)
+            )] = arr[sel]
+            self._write_block(blo, bhi, block)
+
+    def read_async(self, lo, hi):
+        return self._pool.submit(self._read, tuple(lo), tuple(hi))
+
+    def write_async(self, lo, hi, arr):
+        return self._pool.submit(self._write, tuple(lo), tuple(hi), arr)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
 
 
 _BACKEND_LOCK = threading.Lock()

@@ -190,6 +190,17 @@ env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python bench.py storage_throughput --ledger || rc=$((rc == 0 ? 1 : rc))
 stage_time "storage throughput gate"
 
+# --- segmentation stitch gate -------------------------------------------------
+# Stitched map->reduce->map whole-volume labeling vs one monolithic pass
+# against latency-charged storage (docs/segmentation.md). Reports the
+# >=1.3x target as gate_pass (asserted best-of-3 in tests/test_bench.py);
+# the process only fails below 1.1x. The run itself raises unless the
+# stitched output is label-isomorphic to the monolithic labeling.
+echo "== segmentation stitch gate =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python bench.py segmentation_stitch --ledger || rc=$((rc == 0 ? 1 : rc))
+stage_time "segmentation stitch gate"
+
 # --- slo overhead gate --------------------------------------------------------
 # Time-series sampler + burn-rate evaluator on-vs-off over the e2e
 # scheduled workload (docs/observability.md "SLO view"): the SLO plane

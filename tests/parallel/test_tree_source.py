@@ -1,0 +1,218 @@
+"""TreeTaskSource: the SpatialTaskTree as a LIVE task source feeding
+the ordinary queue/ledger machinery (ISSUE 20). Covers ready-set
+ordering, parent unlock strictly after BOTH children's ledger commits,
+mid-job serialize/restore resume, and a two-worker run where the tree
+is the only coordinator."""
+import threading
+
+import pytest
+
+from chunkflow_tpu.core.bbox import BoundingBox
+from chunkflow_tpu.parallel.lifecycle import MemoryLedger, open_ledger
+from chunkflow_tpu.parallel.queues import open_queue
+from chunkflow_tpu.parallel.task_tree import SpatialTaskTree
+from chunkflow_tpu.parallel.tree_source import TreeTaskSource
+
+
+def _tree(stop=(16, 8, 8), block=(4, 4, 4)):
+    return SpatialTaskTree(BoundingBox((0, 0, 0), stop), block)
+
+
+def _drain(queue):
+    bodies = []
+    while True:
+        got = queue.receive()
+        if got is None:
+            return bodies
+        handle, body = got
+        bodies.append(body)
+        queue.delete(handle)
+
+
+def test_requires_a_ledger():
+    with pytest.raises(ValueError):
+        TreeTaskSource(_tree(), open_queue("memory://ts-noledger"), None)
+
+
+def test_first_sync_enqueues_exactly_the_leaves_in_preorder():
+    tree = _tree()
+    queue = open_queue("memory://ts-leaves")
+    source = TreeTaskSource(tree, queue, MemoryLedger())
+    assert source.sync() == len(tree.leaf_list)
+    # pre-order claim => leaves go out left-to-right along the walk,
+    # and no interior node leaks into the first wave
+    expected = [n.bbox.string for n in tree.walk() if n.is_leaf]
+    assert _drain(queue) == expected
+    assert source.sync() == 0  # nothing committed yet: no new work
+
+
+def test_parent_unlocks_only_after_both_children_commit():
+    tree = _tree(stop=(8, 4, 4))  # two leaves, one root merge
+    queue = open_queue("memory://ts-unlock")
+    ledger = MemoryLedger()
+    source = TreeTaskSource(tree, queue, ledger)
+    source.sync()
+    left, right = tree.left, tree.right
+    assert _drain(queue) == [left.bbox.string, right.bbox.string]
+
+    ledger.mark_done(left.bbox.string)
+    assert source.sync() == 0          # one child is NOT enough
+    assert _drain(queue) == []
+    assert not tree.is_done
+
+    ledger.mark_done(right.bbox.string)
+    assert source.sync() == 1          # ...both commits are
+    assert _drain(queue) == [tree.bbox.string]
+    ledger.mark_done(tree.bbox.string)
+    source.sync()
+    assert source.all_done and source.pending() == 0
+
+
+def test_interior_nodes_enqueue_strictly_after_their_subtrees():
+    tree = _tree()
+    queue = open_queue("memory://ts-order")
+    ledger = MemoryLedger()
+    source = TreeTaskSource(tree, queue, ledger)
+    seen = []
+    while not source.all_done:
+        source.sync()
+        for body in _drain(queue):
+            node = tree.find(body)
+            if not node.is_leaf:  # children must already be in `seen`
+                assert node.left.bbox.string in seen
+                assert node.right.bbox.string in seen
+            seen.append(body)
+            ledger.mark_done(body)
+    assert len(seen) == sum(1 for _ in tree.walk())
+    assert seen[-1] == tree.bbox.string  # the root merge goes last
+
+
+def test_custom_body_is_both_queue_body_and_ledger_key():
+    tree = _tree(stop=(8, 4, 4))
+    queue = open_queue("memory://ts-body")
+    ledger = MemoryLedger()
+    source = TreeTaskSource(
+        tree, queue, ledger, body=lambda n: f"merge_{n.bbox.string}"
+    )
+    source.sync()
+    bodies = _drain(queue)
+    assert all(b.startswith("merge_") for b in bodies)
+    for body in bodies:
+        ledger.mark_done(body)
+    source.sync()
+    assert _drain(queue) == [f"merge_{tree.bbox.string}"]
+
+
+def test_serialize_restore_mid_job_keeps_working_nodes_in_flight():
+    tree = _tree(stop=(8, 4, 4))
+    queue = open_queue("memory://ts-serialize")
+    ledger = MemoryLedger()
+    source = TreeTaskSource(tree, queue, ledger)
+    source.sync()
+    # left leaf committed; right leaf's message still IN FLIGHT
+    ledger.mark_done(tree.left.bbox.string)
+
+    restored = SpatialTaskTree.from_dict(tree.to_dict())
+    assert [n.state for n in restored.walk()] == [
+        n.state for n in tree.walk()
+    ]
+    resumed = TreeTaskSource(restored, queue, ledger)
+    # restored WORKING nodes are NOT re-enqueued: their messages are
+    # still in the queue; only the ledger fold advances state
+    assert resumed.sync() == 0
+    assert restored.left.is_done and not restored.right.is_done
+
+    # the in-flight message completes -> the root unlocks on resume
+    ledger.mark_done(tree.right.bbox.string)
+    assert resumed.sync() == 1
+    ledger.mark_done(tree.bbox.string)
+    resumed.sync()
+    assert resumed.all_done
+
+
+def test_coordinator_crash_rebuild_from_plan_plus_ledger():
+    """The harder crash: the coordinator dies losing ALL tree state.
+    A fresh tree + ledger fold re-claims the frontier; duplicates of
+    messages still sitting in the queue are absorbed downstream by the
+    worker's ledger-skip, so re-enqueueing them is safe — the tree
+    must still converge."""
+    tree = _tree()
+    queue = open_queue("memory://ts-rebuild")
+    ledger = MemoryLedger()
+    TreeTaskSource(tree, queue, ledger).sync()
+    bodies = _drain(queue)
+    for body in bodies[: len(bodies) // 2]:
+        ledger.mark_done(body)
+
+    rebuilt = TreeTaskSource(_tree(), queue, ledger)  # fresh READY tree
+    rebuilt.sync()
+    dup = _drain(queue)
+    # committed leaves were folded to done and NOT re-sent; every
+    # uncommitted leaf was; any extra bodies are interior merges whose
+    # subtrees completed before the crash (a legal frontier)
+    assert set(dup).isdisjoint(bodies[: len(bodies) // 2])
+    assert set(bodies[len(bodies) // 2:]) <= set(dup)
+    for body in set(dup) - set(bodies):
+        node = rebuilt.tree.find(body)
+        assert not node.is_leaf
+        assert node.left.is_done and node.right.is_done
+    for body in dup:
+        ledger.mark_done(body)
+    while not rebuilt.all_done:
+        if rebuilt.sync() == 0:
+            break
+        for body in _drain(queue):
+            ledger.mark_done(body)
+    assert rebuilt.all_done
+
+
+def test_two_workers_with_the_tree_as_only_coordinator():
+    """End to end with REAL concurrency: two worker threads drain the
+    queue and write ledger commits; the only scheduling authority is
+    TreeTaskSource.run() in the main thread."""
+    tree = _tree(stop=(16, 16, 8), block=(4, 4, 4))
+    queue = open_queue("memory://ts-two-workers")
+    ledger = open_ledger("memory://ts-two-workers-ledger")
+    source = TreeTaskSource(tree, queue, ledger)
+    stop = threading.Event()
+    done_by = {}
+
+    def worker(name):
+        while not stop.is_set():
+            got = queue.receive()
+            if got is None:
+                stop.wait(0.005)
+                continue
+            handle, body = got
+            done_by[body] = name  # last writer wins; keys are what matter
+            ledger.mark_done(body)
+            queue.delete(handle)
+
+    threads = [
+        threading.Thread(target=worker, args=(f"w{i}",), daemon=True)
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        enqueued = source.run(poll_interval=0.005, timeout=30)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+
+    total = sum(1 for _ in tree.walk())
+    assert source.all_done
+    assert enqueued == total
+    assert set(done_by) == {n.bbox.string for n in tree.walk()}
+    assert queue.stats()["pending"] == 0
+    assert queue.stats()["inflight"] == 0
+
+
+def test_run_times_out_with_no_workers():
+    source = TreeTaskSource(
+        _tree(stop=(8, 8, 8)), open_queue("memory://ts-timeout"),
+        MemoryLedger(),
+    )
+    with pytest.raises(TimeoutError, match="outstanding"):
+        source.run(poll_interval=0.01, timeout=0.05)

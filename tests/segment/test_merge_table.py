@@ -1,0 +1,165 @@
+"""Unit tests of the reduce algebra: face-pair edges, union-find and
+the label-isomorphism oracle (chunkflow_tpu/segment/merge_table.py)."""
+import numpy as np
+import pytest
+
+from chunkflow_tpu.segment.merge_table import (
+    face_pair_edges,
+    labels_isomorphic,
+    merge_edge_sets,
+    merge_table,
+    union_find,
+)
+
+
+# ---------------------------------------------------------------------------
+# face_pair_edges
+# ---------------------------------------------------------------------------
+def test_face_edges_direct_contact():
+    low = np.array([[1, 0], [0, 2]], dtype=np.uint64)
+    high = np.array([[5, 0], [0, 0]], dtype=np.uint64)
+    edges = face_pair_edges(low, high, connectivity=6)
+    assert edges.tolist() == [[1, 5]]
+
+
+def test_face_edges_diagonal_only_visible_at_26():
+    # the two nonzero voxels touch only corner-to-corner across the face
+    low = np.zeros((3, 3), dtype=np.uint64)
+    high = np.zeros((3, 3), dtype=np.uint64)
+    low[0, 0] = 7
+    high[1, 1] = 9
+    assert face_pair_edges(low, high, connectivity=6).size == 0
+    assert face_pair_edges(low, high, connectivity=18).size == 0
+    edges = face_pair_edges(low, high, connectivity=26)
+    assert edges.tolist() == [[7, 9]]
+
+
+def test_face_edges_inplane_offset_at_18():
+    # offset by one along a single in-plane axis: an edge-contact, which
+    # 18-connectivity sees but 6 does not
+    low = np.zeros((3, 3), dtype=np.uint64)
+    high = np.zeros((3, 3), dtype=np.uint64)
+    low[1, 1] = 3
+    high[1, 2] = 4
+    assert face_pair_edges(low, high, connectivity=6).size == 0
+    assert face_pair_edges(low, high, connectivity=18).tolist() == [[3, 4]]
+    assert face_pair_edges(low, high, connectivity=26).tolist() == [[3, 4]]
+
+
+def test_face_edges_dedupe_and_zero_dropped():
+    low = np.full((4, 4), 2, dtype=np.uint64)
+    high = np.full((4, 4), 8, dtype=np.uint64)
+    high[0, :] = 0
+    edges = face_pair_edges(low, high, connectivity=26)
+    assert edges.tolist() == [[2, 8]]
+
+
+def test_face_edges_value_mask():
+    # multivalue mode: equal labels but DIFFERENT input values on the
+    # two sides must not merge
+    low = np.array([[1, 1]], dtype=np.uint64)
+    high = np.array([[2, 2]], dtype=np.uint64)
+    low_vals = np.array([[5, 6]], dtype=np.uint64)
+    high_vals = np.array([[5, 7]], dtype=np.uint64)
+    edges = face_pair_edges(
+        low, high, connectivity=6,
+        low_values=low_vals, high_values=high_vals,
+    )
+    assert edges.tolist() == [[1, 2]]  # only the value-5 column
+    with pytest.raises(ValueError):
+        face_pair_edges(low, high, connectivity=6, low_values=low_vals)
+
+
+def test_face_edges_shape_mismatch_raises():
+    with pytest.raises(ValueError):
+        face_pair_edges(
+            np.zeros((2, 2), np.uint64), np.zeros((2, 3), np.uint64)
+        )
+    with pytest.raises(ValueError):
+        face_pair_edges(
+            np.zeros((2, 2), np.uint64), np.zeros((2, 2), np.uint64),
+            connectivity=4,
+        )
+
+
+# ---------------------------------------------------------------------------
+# union_find / merge_table
+# ---------------------------------------------------------------------------
+def test_union_find_chain_compresses_to_min():
+    edges = np.array([[2, 3], [3, 4], [4, 5]], dtype=np.uint64)
+    ids, roots = union_find(edges)
+    assert ids.tolist() == [2, 3, 4, 5]
+    assert roots.tolist() == [2, 2, 2, 2]
+
+
+def test_union_find_disjoint_components():
+    edges = np.array([[10, 11], [20, 21], [21, 22]], dtype=np.uint64)
+    ids, roots = union_find(edges)
+    assert dict(zip(ids.tolist(), roots.tolist())) == {
+        10: 10, 11: 10, 20: 20, 21: 20, 22: 20,
+    }
+
+
+def test_union_find_random_against_scipy():
+    rng = np.random.default_rng(0)
+    n = 200
+    edges = rng.integers(1, 60, size=(n, 2)).astype(np.uint64)
+    ids, roots = union_find(edges)
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components as sp_cc
+
+    idx = np.searchsorted(ids, edges)
+    graph = coo_matrix(
+        (np.ones(n), (idx[:, 0], idx[:, 1])), shape=(ids.size, ids.size)
+    )
+    _, comp = sp_cc(graph, directed=False)
+    # same partition, and each root is the min id of its component
+    for c in np.unique(comp):
+        members = ids[comp == c]
+        assert (roots[comp == c] == members.min()).all()
+
+
+def test_union_find_empty():
+    ids, roots = union_find(np.empty((0, 2), dtype=np.uint64))
+    assert ids.size == 0 and roots.size == 0
+
+
+def test_merge_table_is_fixpoint():
+    table = merge_table([np.array([[5, 9], [9, 12], [3, 4]], np.uint64)])
+    keys, values = table[:, 0], table[:, 1]
+    # non-identity rows only, and no value ever appears as a key: the
+    # table is a fixpoint, so applying it twice equals applying it once
+    # (the idempotent-relabel property, docs/segmentation.md)
+    assert (keys != values).all()
+    assert not np.isin(values, keys).any()
+
+
+def test_merge_edge_sets_combines_tables_and_edges():
+    a = np.array([[1, 2]], dtype=np.uint64)
+    b = np.array([[2, 3], [1, 2]], dtype=np.uint64)
+    merged = merge_edge_sets([a, b, np.empty((0, 2), np.uint64)])
+    assert merged.tolist() == [[1, 2], [2, 3]]
+
+
+# ---------------------------------------------------------------------------
+# labels_isomorphic
+# ---------------------------------------------------------------------------
+def test_isomorphic_accepts_renamed_labels():
+    a = np.array([[0, 1, 1], [2, 2, 0]])
+    b = np.array([[0, 9, 9], [4, 4, 0]])
+    assert labels_isomorphic(a, b)
+
+
+def test_isomorphic_rejects_split_and_merge():
+    a = np.array([[1, 1, 2]])
+    merged = np.array([[7, 7, 7]])   # two objects fused
+    split = np.array([[1, 3, 2]])    # one object split
+    assert not labels_isomorphic(a, merged)
+    assert not labels_isomorphic(merged, a)
+    assert not labels_isomorphic(a, split)
+
+
+def test_isomorphic_rejects_background_mismatch_and_shape():
+    a = np.array([[0, 1]])
+    assert not labels_isomorphic(a, np.array([[1, 1]]))
+    assert not labels_isomorphic(a, np.array([[0, 1, 0]]))
